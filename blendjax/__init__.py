@@ -1,0 +1,45 @@
+"""blendjax — a TPU-native synthetic-data streaming framework.
+
+blendjax connects fleets of renderer processes (Blender, or any producer
+speaking the wire protocol) to JAX/TPU training loops: images and
+annotations stream over sockets straight into double-buffered, mesh-sharded
+device arrays — no intermediate disk — with bidirectional control channels
+for simulation-parameter optimization and remote-controlled environments
+for reinforcement learning.
+
+Capability parity target: blendtorch v0.2.0 (see SURVEY.md). Docstrings
+cite the reference tree as ``path:line`` so parity can be audited. The
+architecture is not a port: the consumer side is built JAX-first (schema'd
+zero-copy wire format, host->HBM double buffering, ``jax.sharding`` global
+arrays, jit-compiled train steps) rather than torch DataLoader semantics.
+
+Subpackage map (reference counterpart in parens):
+
+- ``blendjax.transport`` — wire codecs + socket patterns (inlined ZMQ use in
+  reference ``publisher.py``/``dataset.py``/``duplex.py``/``env.py``).
+- ``blendjax.launcher`` — process orchestration (``pkg_pytorch/blendtorch/btt/
+  launcher.py``, ``launch_info.py``, ``finder.py``, ``apps/launch.py``).
+- ``blendjax.data`` — ingest pipeline + record/replay (``btt/dataset.py``,
+  ``btt/file.py``), rebuilt as schema'd stream -> host batcher -> device feeder.
+- ``blendjax.producer`` — renderer-side runtime (``pkg_blender/blendtorch/btb``):
+  animation lifecycle, camera math, publisher, duplex, env base; ``bpy``-gated
+  with a headless simulation engine for hermetic tests.
+- ``blendjax.env`` — RL integration (``btt/env.py``, ``btt/env_rendering.py``)
+  with a Gymnasium adapter and batched env support.
+- ``blendjax.parallel`` — mesh/sharding/collectives + ring attention (net-new;
+  the reference has no ICI-plane counterpart, SURVEY.md §2.4).
+- ``blendjax.models`` / ``blendjax.train`` — flax models + pjit train loops
+  (replaces the examples' torch models, e.g. ``examples/densityopt``).
+- ``blendjax.ops`` — Pallas/XLA image ops (gamma, normalize; the reference
+  does these on CPU, ``offscreen.py:105-112``).
+
+Import policy: this root module stays light and never imports ``jax`` or
+``bpy`` so that producer processes (Blender's embedded Python) can import
+``blendjax.producer`` without the JAX stack, and vice versa.
+"""
+
+__version__ = "0.1.0"
+
+from blendjax import constants  # noqa: F401
+
+__all__ = ["constants", "__version__"]

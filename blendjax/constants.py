@@ -1,0 +1,29 @@
+"""Shared constants.
+
+Reference parity: ``pkg_pytorch/blendtorch/btt/constants.py:4`` sets the
+consumer default timeout to 10000 ms while ``pkg_blender/blendtorch/btb/
+constants.py:4`` uses 5000 ms on the producer side; both are preserved.
+"""
+
+# Consumer-side default receive timeout (ms). A timeout is treated as a
+# failure signal (fail-fast, SURVEY.md §5 "failure detection").
+DEFAULT_TIMEOUTMS = 10_000
+
+# Producer-side default timeout (ms).
+DEFAULT_PRODUCER_TIMEOUTMS = 5_000
+
+# Default high-water marks: small queues give natural backpressure between
+# renderers and the training host (reference: publisher SNDHWM=10,
+# ``publisher.py:24``; consumer RCVHWM=queue_size default 10, ``dataset.py:45``).
+DEFAULT_SEND_HWM = 10
+DEFAULT_QUEUE_SIZE = 10
+
+# First data port the launcher's address generator hands out
+# (reference: ``launcher.py:63``).
+DEFAULT_START_PORT = 11_000
+
+# Wire-format magic for the zero-copy tensor codec (net-new; the reference
+# pickles whole dicts, ``publisher.py:43``).
+WIRE_MAGIC = b"BJX1"
+
+LOGGER_NAME = "blendjax"

@@ -1,0 +1,37 @@
+"""Transport layer: wire codecs + socket pattern wrappers.
+
+The reference inlines raw ZMQ use at each component (``publisher.py:22-27``,
+``dataset.py:73-78``, ``duplex.py:12-18``, ``env.py:36-42``); blendjax
+factors it into one layer so the ingest pipeline, control channels, and RL
+RPC all share codec, backpressure, and failure semantics.
+"""
+
+from blendjax.transport.wire import (
+    TensorCodec,
+    PickleCodec,
+    encode_message,
+    decode_message,
+    sizeof_frames,
+)
+from blendjax.transport.channels import (
+    DataPublisherSocket,
+    DataReceiverSocket,
+    PairChannel,
+    RpcClient,
+    RpcServer,
+    ReceiveTimeoutError,
+)
+
+__all__ = [
+    "TensorCodec",
+    "PickleCodec",
+    "encode_message",
+    "decode_message",
+    "sizeof_frames",
+    "DataPublisherSocket",
+    "DataReceiverSocket",
+    "PairChannel",
+    "RpcClient",
+    "RpcServer",
+    "ReceiveTimeoutError",
+]

@@ -1,0 +1,327 @@
+"""Socket pattern wrappers with reference-equivalent semantics.
+
+Patterns and options mirror the reference's "backend API" (SURVEY.md §5):
+
+- PUSH(bind, SNDHWM, IMMEDIATE) -> PULL(connect-to-all, RCVHWM) for the data
+  stream: backpressure via small HWMs, fair fan-in, at-most-once delivery,
+  no ordering guarantee (``publisher.py:22-27`` <-> ``dataset.py:73-78``).
+- PAIR(bind) <-> PAIR(connect) duplex control with HWM 10, linger and
+  send/recv timeouts (``btb/duplex.py:12-18`` <-> ``btt/duplex.py:12-18``),
+  message ids ``btmid`` + instance ids ``btid`` stamped on send
+  (``btt/duplex.py:44-67``).
+- REQ(RELAXED, CORRELATE) <-> REP for environment RPC
+  (``btt/env.py:36-42`` <-> ``btb/env.py:212-216``).
+
+Failure semantics are fail-fast: a poll timeout raises
+``ReceiveTimeoutError`` (the reference asserts/raises on ``zmq.error.Again``,
+``dataset.py:98-99``, ``btt/env.py:116-124``).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+
+import zmq
+
+from blendjax import constants
+from blendjax.transport.wire import decode_message, encode_message
+
+
+class ReceiveTimeoutError(TimeoutError):
+    """No message arrived within the timeout — treat the peer as failed/hung."""
+
+
+_context_lock = threading.Lock()
+_context = None
+_context_pid = None
+
+
+def zmq_context() -> zmq.Context:
+    """Process-wide ZMQ context (re-created after fork for DataLoader-style
+    worker processes, matching the reference's lazy per-worker socket
+    construction in ``dataset.py:64-78``)."""
+    global _context, _context_pid
+    with _context_lock:
+        if _context is None or _context_pid != os.getpid():
+            _context = zmq.Context()
+            _context_pid = os.getpid()
+        return _context
+
+
+def _as_frames(raw) -> list:
+    return raw if isinstance(raw, list) else [raw]
+
+
+class DataPublisherSocket:
+    """Producer end of the data stream: PUSH, bind side.
+
+    Reference: ``pkg_blender/blendtorch/btb/publisher.py:4-43``. The small
+    send HWM blocks the renderer when consumers fall behind, which is the
+    framework's backpressure mechanism (``examples/datagen/Readme.md:168-175``).
+
+    Zero-copy hazard: with ``copy=False`` (the default) ndarray payloads are
+    handed to the socket by reference and transmitted asynchronously after
+    ``publish`` returns. A producer that mutates or reuses its buffer (e.g.
+    an offscreen render target) must pass ``copy=True`` — the reference
+    always copied implicitly by pickling at send time (``publisher.py:43``).
+    """
+
+    def __init__(
+        self,
+        bind_addr: str,
+        btid: int | None = None,
+        send_hwm: int = constants.DEFAULT_SEND_HWM,
+        codec: str = "tensor",
+        lingerms: int = 0,
+        copy: bool = False,
+    ):
+        self.codec = codec
+        self.btid = btid
+        self.copy = copy
+        self.sock = zmq_context().socket(zmq.PUSH)
+        self.sock.setsockopt(zmq.SNDHWM, send_hwm)
+        self.sock.setsockopt(zmq.IMMEDIATE, 1)
+        self.sock.setsockopt(zmq.LINGER, lingerms)
+        self.sock.bind(bind_addr)
+        # Wildcard ports ("tcp://host:*") resolve at bind time; expose the
+        # effective address so launchers/tests can hand it to consumers.
+        self.addr = self.sock.getsockopt_string(zmq.LAST_ENDPOINT)
+
+    def publish(self, **kwargs):
+        """Publish a message dict; stamps ``btid`` for provenance
+        (reference stamps every payload, ``publisher.py:42``)."""
+        data = {"btid": self.btid, **kwargs}
+        self.sock.send_multipart(
+            encode_message(data, codec=self.codec), copy=self.copy
+        )
+
+    def close(self):
+        self.sock.close(0)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+class DataReceiverSocket:
+    """Consumer end: PULL, connects to *all* producer addresses.
+
+    Reference: ``pkg_pytorch/blendtorch/btt/dataset.py:68-111``. Fair-queued
+    fan-in across producers; at-most-once per consumer; raises on timeout.
+    ``recv`` returns ``(message, raw_frames)`` so a recorder can tee the
+    exact wire bytes without re-encoding (reference tees raw pickles in the
+    hot loop, ``dataset.py:100-103``).
+    """
+
+    def __init__(
+        self,
+        addresses,
+        queue_size: int = constants.DEFAULT_QUEUE_SIZE,
+        timeoutms: int = constants.DEFAULT_TIMEOUTMS,
+        allow_pickle: bool = True,
+    ):
+        if isinstance(addresses, str):
+            addresses = [addresses]
+        self.addresses = list(addresses)
+        self.timeoutms = timeoutms
+        self.allow_pickle = allow_pickle
+        self.sock = zmq_context().socket(zmq.PULL)
+        self.sock.setsockopt(zmq.RCVHWM, queue_size)
+        self.sock.setsockopt(zmq.LINGER, 0)
+        for addr in self.addresses:
+            self.sock.connect(addr)
+        self.poller = zmq.Poller()
+        self.poller.register(self.sock, zmq.POLLIN)
+
+    def recv(self, timeoutms: int | None = None, copy_arrays: bool = False):
+        t = self.timeoutms if timeoutms is None else timeoutms
+        socks = dict(self.poller.poll(t))
+        if self.sock not in socks:
+            raise ReceiveTimeoutError(
+                f"no message within {t} ms from {self.addresses}"
+            )
+        frames = _as_frames(self.sock.recv_multipart(copy=False))
+        buffers = [f.buffer for f in frames]
+        return (
+            decode_message(
+                buffers, copy_arrays=copy_arrays, allow_pickle=self.allow_pickle
+            ),
+            buffers,
+        )
+
+    def close(self):
+        self.sock.close(0)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+class PairChannel:
+    """Duplex control channel (PAIR<->PAIR), producer binds / consumer connects.
+
+    Reference: ``btt/duplex.py:8-67`` and ``btb/duplex.py:8-66``. ``send``
+    stamps ``btid`` plus a fresh random message id ``btmid``; ``recv``
+    returns ``None`` on timeout (densityopt polls with ``timeoutms=0`` each
+    frame, ``supershape.blend.py:26-37``).
+    """
+
+    def __init__(
+        self,
+        addr: str,
+        btid: int | None = None,
+        bind: bool = False,
+        hwm: int = constants.DEFAULT_SEND_HWM,
+        lingerms: int = 0,
+        codec: str = "tensor",
+        default_timeoutms: int = constants.DEFAULT_TIMEOUTMS,
+        allow_pickle: bool = True,
+    ):
+        self.btid = btid
+        self.codec = codec
+        self.default_timeoutms = default_timeoutms
+        self.allow_pickle = allow_pickle
+        self.sock = zmq_context().socket(zmq.PAIR)
+        self.sock.setsockopt(zmq.SNDHWM, hwm)
+        self.sock.setsockopt(zmq.RCVHWM, hwm)
+        self.sock.setsockopt(zmq.LINGER, lingerms)
+        if bind:
+            self.sock.bind(addr)
+            self.addr = self.sock.getsockopt_string(zmq.LAST_ENDPOINT)
+        else:
+            self.sock.connect(addr)
+            self.addr = addr
+        self.poller = zmq.Poller()
+        self.poller.register(self.sock, zmq.POLLIN)
+
+    def send(self, **kwargs) -> bytes:
+        """Send a message; returns the generated ``btmid`` message id.
+
+        Control messages are small, so payloads are copied at send time
+        (no buffer-reuse hazard, unlike the bulk data stream).
+        """
+        btmid = os.urandom(4)
+        data = {"btid": self.btid, "btmid": btmid, **kwargs}
+        self.sock.send_multipart(encode_message(data, codec=self.codec), copy=True)
+        return btmid
+
+    def recv(self, timeoutms: int | None = None):
+        """Receive one message or ``None`` if nothing arrives in time."""
+        t = self.default_timeoutms if timeoutms is None else timeoutms
+        socks = dict(self.poller.poll(t))
+        if self.sock not in socks:
+            return None
+        frames = _as_frames(self.sock.recv_multipart(copy=False))
+        return decode_message(
+            [f.buffer for f in frames],
+            copy_arrays=True,
+            allow_pickle=self.allow_pickle,
+        )
+
+    def close(self):
+        self.sock.close(0)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+class RpcClient:
+    """Blocking request/reply client (REQ with RELAXED+CORRELATE).
+
+    Reference: ``btt/env.py:36-42,111-124``. RELAXED+CORRELATE let the REQ
+    socket recover from a lost reply instead of wedging, and timeouts raise
+    so a dead environment fails fast.
+    """
+
+    def __init__(self, addr: str, timeoutms: int = constants.DEFAULT_TIMEOUTMS,
+                 codec: str = "tensor", allow_pickle: bool = True):
+        self.codec = codec
+        self.timeoutms = timeoutms
+        self.addr = addr
+        self.allow_pickle = allow_pickle
+        self.sock = zmq_context().socket(zmq.REQ)
+        self.sock.setsockopt(zmq.REQ_RELAXED, 1)
+        self.sock.setsockopt(zmq.REQ_CORRELATE, 1)
+        self.sock.setsockopt(zmq.SNDTIMEO, timeoutms)
+        self.sock.setsockopt(zmq.RCVTIMEO, timeoutms)
+        self.sock.setsockopt(zmq.LINGER, 0)
+        self.sock.connect(addr)
+
+    def call(self, **kwargs) -> dict:
+        try:
+            self.sock.send_multipart(
+                encode_message(kwargs, codec=self.codec), copy=True
+            )
+            frames = _as_frames(self.sock.recv_multipart(copy=False))
+        except zmq.error.Again as e:
+            raise ReceiveTimeoutError(f"rpc to {self.addr} timed out") from e
+        return decode_message(
+            [f.buffer for f in frames],
+            copy_arrays=True,
+            allow_pickle=self.allow_pickle,
+        )
+
+    def close(self):
+        self.sock.close(0)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+class RpcServer:
+    """Reply side of the RPC pattern (REP, bind).
+
+    Reference: ``btb/env.py:212-216``. ``recv``/``reply`` are split so the
+    producer's STATE_REQ/STATE_REP machine (``btb/env.py:206-252``) can
+    interleave them with frame callbacks; ``recv`` supports non-blocking
+    polls for the ``real_time`` degradation mode (``btb/env.py:222-233``).
+    """
+
+    def __init__(self, bind_addr: str, codec: str = "tensor",
+                 default_timeoutms: int = constants.DEFAULT_TIMEOUTMS,
+                 allow_pickle: bool = True):
+        self.codec = codec
+        self.default_timeoutms = default_timeoutms
+        self.allow_pickle = allow_pickle
+        self.sock = zmq_context().socket(zmq.REP)
+        self.sock.setsockopt(zmq.LINGER, 0)
+        self.sock.bind(bind_addr)
+        self.addr = self.sock.getsockopt_string(zmq.LAST_ENDPOINT)
+        self.poller = zmq.Poller()
+        self.poller.register(self.sock, zmq.POLLIN)
+
+    def recv(self, timeoutms: int | None = None):
+        """Receive one request, or ``None`` on timeout (``timeoutms=0`` polls)."""
+        t = self.default_timeoutms if timeoutms is None else timeoutms
+        socks = dict(self.poller.poll(t))
+        if self.sock not in socks:
+            return None
+        frames = _as_frames(self.sock.recv_multipart(copy=False))
+        return decode_message(
+            [f.buffer for f in frames],
+            copy_arrays=True,
+            allow_pickle=self.allow_pickle,
+        )
+
+    def reply(self, **kwargs):
+        self.sock.send_multipart(encode_message(kwargs, codec=self.codec), copy=True)
+
+    def close(self):
+        self.sock.close(0)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

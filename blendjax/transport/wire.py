@@ -1,0 +1,166 @@
+"""Wire formats.
+
+Two codecs share one decode entry point:
+
+- ``TensorCodec`` ("bjx1"): a multipart message — one msgpack header frame
+  prefixed with magic ``BJX1``, followed by one raw frame per ndarray.
+  Arrays travel as raw bytes and are reconstructed with ``np.frombuffer``
+  on receive, so a 640x480 RGBA image crosses the stack with zero copies
+  and zero pickling. This is the blendjax-native format and the reason the
+  ingest path can feed ``jax.device_put`` without a Python-object hop
+  (SURVEY.md §5 "distributed communication backend").
+
+- ``PickleCodec``: single-frame pickled dict, byte-compatible with the
+  reference producers (``pkg_blender/blendtorch/btb/publisher.py:43`` uses
+  ``send_pyobj``; consumer ``dataset.py:105`` uses ``recv_pyobj``), so
+  unmodified ``btb`` Blender scripts can publish into a blendjax consumer.
+
+Decode autodetects: pickled frames begin with the pickle PROTO opcode
+``b"\\x80"`` while tensor-codec headers begin with ``BJX1``, and the two can
+never collide.
+
+Semantics and safety notes:
+
+- msgpack has no tuple type, so non-array tuples arrive as lists under the
+  tensor codec (``(640, 480)`` -> ``[640, 480]``); use ndarrays or lists on
+  the wire if the distinction matters. The pickle codec preserves tuples.
+- Unpickling is remote code execution by design. Receivers accept pickled
+  payloads by default for compatibility with unmodified reference producers
+  (``send_pyobj``); on untrusted networks pass ``allow_pickle=False`` to
+  reject both legacy pickle frames and embedded ``pkl`` fallback entries.
+"""
+
+from __future__ import annotations
+
+import pickle
+
+import numpy as np
+
+try:  # msgpack ships in the image; guard anyway so producers degrade to pickle.
+    import msgpack
+except ImportError:  # pragma: no cover
+    msgpack = None
+
+from blendjax.constants import WIRE_MAGIC
+
+# Pickle protocol 4: readable by every Python >= 3.4 (the reference pins 3
+# for Blender 2.8's py3.7, ``file.py:58-63``; any modern Blender reads 4).
+PICKLE_PROTOCOL = 4
+
+
+def _np_scalar_to_py(value):
+    if isinstance(value, np.generic):
+        return value.item()
+    return value
+
+
+class TensorCodec:
+    """Zero-copy multipart codec: msgpack header + raw ndarray frames."""
+
+    name = "tensor"
+
+    @staticmethod
+    def encode(message: dict) -> list:
+        """Encode ``message`` into a list of frames (bytes / memoryview).
+
+        ndarray values (non-object dtype) are shipped as raw frames;
+        msgpack-native values ride in the header; anything else falls back
+        to an embedded pickle so arbitrary metadata still round-trips.
+        """
+        if msgpack is None:  # pragma: no cover
+            return PickleCodec.encode(message)
+        entries = []
+        buffers = []
+        for key, value in message.items():
+            if isinstance(value, np.ndarray) and value.dtype != object:
+                arr = np.ascontiguousarray(value)
+                entries.append(
+                    ["nd", key, list(arr.shape), arr.dtype.str, len(buffers)]
+                )
+                buffers.append(arr.data if arr.size else b"")
+            else:
+                value = _np_scalar_to_py(value)
+                try:
+                    packed = msgpack.packb(value, use_bin_type=True)
+                    entries.append(["obj", key, packed])
+                except (TypeError, ValueError, OverflowError):
+                    entries.append(
+                        ["pkl", key, pickle.dumps(value, protocol=PICKLE_PROTOCOL)]
+                    )
+        header = WIRE_MAGIC + msgpack.packb([1, entries], use_bin_type=True)
+        return [header, *buffers]
+
+    @staticmethod
+    def decode(frames: list, copy_arrays: bool = False,
+               allow_pickle: bool = True) -> dict:
+        header = bytes(frames[0][: len(WIRE_MAGIC)])
+        if header != WIRE_MAGIC:
+            raise ValueError("not a tensor-codec message")
+        version, entries = msgpack.unpackb(
+            bytes(frames[0])[len(WIRE_MAGIC):], raw=False, strict_map_key=False
+        )
+        if version != 1:
+            raise ValueError(f"unsupported wire version {version}")
+        out = {}
+        for entry in entries:
+            kind, key = entry[0], entry[1]
+            if kind == "nd":
+                _, _, shape, dtype, idx = entry
+                buf = frames[1 + idx]
+                arr = np.frombuffer(buf, dtype=np.dtype(dtype)).reshape(shape)
+                out[key] = arr.copy() if copy_arrays else arr
+            elif kind == "obj":
+                out[key] = msgpack.unpackb(entry[2], raw=False, strict_map_key=False)
+            elif kind == "pkl":
+                if not allow_pickle:
+                    raise ValueError(
+                        f"refusing embedded pickle for key {key!r} "
+                        "(allow_pickle=False)"
+                    )
+                out[key] = pickle.loads(entry[2])
+            else:
+                raise ValueError(f"unknown wire entry kind {kind!r}")
+        return out
+
+
+class PickleCodec:
+    """Reference-compatible single-frame pickle codec."""
+
+    name = "pickle"
+
+    @staticmethod
+    def encode(message: dict) -> list:
+        return [pickle.dumps(message, protocol=PICKLE_PROTOCOL)]
+
+    @staticmethod
+    def decode(frames: list, copy_arrays: bool = False,
+               allow_pickle: bool = True) -> dict:
+        del copy_arrays  # pickle always materializes copies
+        if not allow_pickle:
+            raise ValueError("refusing pickled message (allow_pickle=False)")
+        return pickle.loads(bytes(frames[0]))
+
+
+CODECS = {TensorCodec.name: TensorCodec, PickleCodec.name: PickleCodec}
+
+
+def encode_message(message: dict, codec: str = "tensor") -> list:
+    return CODECS[codec].encode(message)
+
+
+def decode_message(frames: list, copy_arrays: bool = False,
+                   allow_pickle: bool = True) -> dict:
+    """Decode frames from either codec (autodetected by leading bytes)."""
+    head = bytes(frames[0][: len(WIRE_MAGIC)])
+    if head == WIRE_MAGIC:
+        return TensorCodec.decode(
+            frames, copy_arrays=copy_arrays, allow_pickle=allow_pickle
+        )
+    return PickleCodec.decode(
+        frames, copy_arrays=copy_arrays, allow_pickle=allow_pickle
+    )
+
+
+def sizeof_frames(frames: list) -> int:
+    """Total payload bytes of an encoded message (for metrics/recording)."""
+    return sum(len(f) if isinstance(f, (bytes, bytearray)) else f.nbytes if isinstance(f, memoryview) else len(bytes(f)) for f in frames)

@@ -1,0 +1,4 @@
+from blendjax.utils.ipaddr import get_primary_ip
+from blendjax.utils.logging import get_logger
+
+__all__ = ["get_primary_ip", "get_logger"]

@@ -1,0 +1,24 @@
+"""Network helpers.
+
+Reference: ``pkg_pytorch/blendtorch/btt/utils.py:2-16`` — the UDP-connect
+trick to find the primary (default-route) interface IP, used by the
+launcher's ``bind_addr='primaryip'`` mode for two-machine setups
+(``launcher.py:187-188``).
+"""
+
+from __future__ import annotations
+
+import socket
+
+
+def get_primary_ip() -> str:
+    """IP of the default-route interface; falls back to loopback offline."""
+    s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    try:
+        # The address does not need to be reachable; no packet is sent.
+        s.connect(("10.255.255.255", 1))
+        return s.getsockname()[0]
+    except OSError:
+        return "127.0.0.1"
+    finally:
+        s.close()

@@ -1,0 +1,13 @@
+"""Logging under one logger name (reference uses ``'blendtorch'``,
+``launcher.py:12``, ``file.py:8``, ``finder.py:9``)."""
+
+from __future__ import annotations
+
+import logging
+
+from blendjax.constants import LOGGER_NAME
+
+
+def get_logger(suffix: str | None = None) -> logging.Logger:
+    name = LOGGER_NAME if not suffix else f"{LOGGER_NAME}.{suffix}"
+    return logging.getLogger(name)

@@ -1,0 +1,180 @@
+"""Transport layer tests: codecs, stream semantics, duplex, RPC.
+
+These are hermetic (no Blender, no GPU): producers are plain Python on the
+other end of real TCP sockets, per SURVEY.md §4's "fake producer" strategy.
+"""
+
+import pickle
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from blendjax.transport import (
+    DataPublisherSocket,
+    DataReceiverSocket,
+    PairChannel,
+    ReceiveTimeoutError,
+    RpcClient,
+    RpcServer,
+    decode_message,
+    encode_message,
+)
+
+WILD = "tcp://127.0.0.1:*"
+
+
+def test_tensor_codec_roundtrip():
+    msg = {
+        "image": np.arange(2 * 3 * 4, dtype=np.uint8).reshape(2, 3, 4),
+        "xy": np.ones((5, 2), dtype=np.float32),
+        "frameid": 7,
+        "name": "cube",
+        "nested": {"a": [1, 2, 3], "b": None},
+        "weird": {1, 2, 3},  # a set: falls back to embedded pickle
+        "npscalar": np.int64(42),
+    }
+    frames = encode_message(msg, codec="tensor")
+    out = decode_message(frames)
+    assert out["image"].dtype == np.uint8 and out["image"].shape == (2, 3, 4)
+    np.testing.assert_array_equal(out["image"], msg["image"])
+    np.testing.assert_array_equal(out["xy"], msg["xy"])
+    assert out["frameid"] == 7 and out["name"] == "cube"
+    assert out["nested"] == {"a": [1, 2, 3], "b": None}
+    assert out["weird"] == {1, 2, 3}
+    assert out["npscalar"] == 42
+
+
+def test_tensor_codec_zero_size_array():
+    msg = {"empty": np.zeros((0, 4), dtype=np.float32)}
+    out = decode_message(encode_message(msg, codec="tensor"))
+    assert out["empty"].shape == (0, 4)
+
+
+def test_pickle_codec_autodetect():
+    msg = {"image": np.zeros((4, 4), np.uint8), "btid": 3}
+    frames = encode_message(msg, codec="pickle")
+    assert len(frames) == 1
+    out = decode_message(frames)
+    np.testing.assert_array_equal(out["image"], msg["image"])
+    assert out["btid"] == 3
+
+
+def test_push_pull_stream_and_fan_in():
+    pub_a = DataPublisherSocket(WILD, btid=0)
+    pub_b = DataPublisherSocket(WILD, btid=1)
+    recv = DataReceiverSocket([pub_a.addr, pub_b.addr], timeoutms=5000)
+    img = np.random.randint(0, 255, (8, 8, 4), dtype=np.uint8)
+    for i in range(4):
+        pub_a.publish(image=img, frameid=i)
+        pub_b.publish(image=img, frameid=i)
+    seen = set()
+    for _ in range(8):
+        msg, raw = recv.recv()
+        assert msg["image"].shape == (8, 8, 4)
+        seen.add((msg["btid"], msg["frameid"]))
+    assert seen == {(b, i) for b in (0, 1) for i in range(4)}
+    recv.close(); pub_a.close(); pub_b.close()
+
+
+def test_receiver_timeout_raises():
+    pub = DataPublisherSocket(WILD, btid=0)
+    recv = DataReceiverSocket([pub.addr], timeoutms=50)
+    with pytest.raises(ReceiveTimeoutError):
+        recv.recv()
+    recv.close(); pub.close()
+
+
+def test_legacy_pickle_producer_interop():
+    """An unmodified btb-style producer (send_pyobj) feeds our receiver."""
+    import zmq
+
+    from blendjax.transport.channels import zmq_context
+
+    sock = zmq_context().socket(zmq.PUSH)
+    sock.setsockopt(zmq.SNDHWM, 10)
+    sock.setsockopt(zmq.IMMEDIATE, 1)
+    sock.bind(WILD)
+    addr = sock.getsockopt_string(zmq.LAST_ENDPOINT)
+    recv = DataReceiverSocket([addr], timeoutms=5000)
+    payload = {"btid": 9, "image": np.ones((2, 2), np.uint8), "frameid": 0}
+    sock.send(pickle.dumps(payload, protocol=3))  # exactly what send_pyobj does
+    msg, _ = recv.recv()
+    assert msg["btid"] == 9
+    np.testing.assert_array_equal(msg["image"], payload["image"])
+    recv.close(); sock.close(0)
+
+
+def test_backpressure_hwm_blocks_producer():
+    """With no consumer draining, a small HWM must block the producer
+    (reference behavior: Blender blocks when consumers are slow,
+    ``examples/datagen/Readme.md:168-175``)."""
+    pub = DataPublisherSocket(WILD, btid=0, send_hwm=1)
+    recv = DataReceiverSocket([pub.addr], queue_size=1, timeoutms=5000)
+    # Give the connection a moment to establish so IMMEDIATE doesn't drop.
+    time.sleep(0.2)
+    # Payloads must dwarf kernel TCP buffers; HWM counts messages, the OS
+    # buffer absorbs bytes.
+    blob = np.zeros(4 * 1024 * 1024, dtype=np.uint8)
+    n = 12
+    sent = []
+
+    def producer():
+        for i in range(n):
+            pub.publish(frameid=i, blob=blob)
+            sent.append(i)
+
+    t = threading.Thread(target=producer, daemon=True)
+    t.start()
+    time.sleep(0.7)
+    # Queues hold SNDHWM 1 + RCVHWM 1 + a TCP buffer's worth; the producer
+    # must be far from done while nothing drains.
+    assert len(sent) < n
+    for _ in range(n):
+        recv.recv()
+    t.join(timeout=10)
+    assert len(sent) == n
+    recv.close(); pub.close()
+
+
+def test_pair_channel_duplex_echo():
+    prod = PairChannel(WILD, btid=1, bind=True)
+    cons = PairChannel(prod.addr, btid=None, bind=False)
+    mid = cons.send(shape_params=np.zeros((4, 2), np.float32), shape_ids=[1, 2])
+    got = prod.recv(timeoutms=5000)
+    assert got is not None and got["btmid"] == mid
+    assert got["shape_ids"] == [1, 2]
+    prod.send(echo=got["btmid"])
+    back = cons.recv(timeoutms=5000)
+    assert back["echo"] == mid and back["btid"] == 1
+    assert cons.recv(timeoutms=0) is None  # poll-style non-blocking recv
+    prod.close(); cons.close()
+
+
+def test_rpc_req_rep():
+    server = RpcServer(WILD)
+    client = RpcClient(server.addr, timeoutms=5000)
+    result = {}
+
+    def serve():
+        req = server.recv(timeoutms=5000)
+        result.update(req)
+        server.reply(obs=np.zeros(4, np.float32), reward=1.0, done=False)
+
+    t = threading.Thread(target=serve, daemon=True)
+    t.start()
+    rep = client.call(cmd="step", action=0.5)
+    t.join(timeout=5)
+    assert result["cmd"] == "step" and result["action"] == 0.5
+    assert rep["reward"] == 1.0 and rep["done"] is False
+    assert rep["obs"].shape == (4,)
+    client.close(); server.close()
+
+
+def test_rpc_client_timeout():
+    server = RpcServer(WILD)  # never replies
+    client = RpcClient(server.addr, timeoutms=100)
+    with pytest.raises(ReceiveTimeoutError):
+        client.call(cmd="reset")
+    client.close(); server.close()
