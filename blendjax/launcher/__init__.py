@@ -1,0 +1,25 @@
+"""Process orchestration: discover, spawn, monitor, and tear down fleets of
+producer processes (Blender or any executable speaking the handshake).
+
+Reference counterparts: ``pkg_pytorch/blendtorch/btt/{launcher.py,
+launch_info.py, finder.py, apps/launch.py}`` and the producer-side argument
+protocol ``pkg_blender/blendtorch/btb/arguments.py``.
+"""
+
+from blendjax.launcher.arguments import parse_launch_args
+from blendjax.launcher.finder import discover_blender
+from blendjax.launcher.launch_info import LaunchInfo
+from blendjax.launcher.launcher import (
+    BlenderLauncher,
+    ProcessLauncher,
+    PythonProducerLauncher,
+)
+
+__all__ = [
+    "ProcessLauncher",
+    "BlenderLauncher",
+    "PythonProducerLauncher",
+    "LaunchInfo",
+    "discover_blender",
+    "parse_launch_args",
+]

@@ -1,0 +1,60 @@
+"""``blendjax-launch`` — launcher-as-a-service CLI.
+
+Reference: ``pkg_pytorch/blendtorch/btt/apps/launch.py:26-43``. Reads a
+JSON file of launcher kwargs, starts the fleet, writes the resulting
+``LaunchInfo`` JSON (addresses/commands/pids) for another machine to
+connect to, and blocks until the producers exit.
+
+JSON keys = :class:`ProcessLauncher`/:class:`BlenderLauncher` kwargs, plus
+``"kind"``: ``"blender"`` (default) or ``"python"`` (headless producer via
+:class:`PythonProducerLauncher`).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import signal
+
+from blendjax.launcher.launcher import BlenderLauncher, PythonProducerLauncher
+
+
+def main(argv=None) -> int:
+    logging.basicConfig(level=logging.INFO)
+    # Producers live in their own sessions, so a signal to this CLI does not
+    # reach them; convert SIGTERM (docker stop, systemd, .terminate()) into
+    # an exception so the launcher context unwinds and reaps the fleet.
+    def _sigterm(signum, frame):
+        raise KeyboardInterrupt
+
+    signal.signal(signal.SIGTERM, _sigterm)
+    parser = argparse.ArgumentParser(
+        "blendjax-launch",
+        description="Launch a fleet of blendjax producers from a JSON config.",
+    )
+    parser.add_argument(
+        "config", help="path to JSON file containing launcher arguments"
+    )
+    parser.add_argument(
+        "--out", default="launch_info.json",
+        help="where to write LaunchInfo JSON (default: launch_info.json)",
+    )
+    args = parser.parse_args(argv)
+
+    with open(args.config) as f:
+        cfg = json.load(f)
+    kind = cfg.pop("kind", "blender")
+    cls = {"blender": BlenderLauncher, "python": PythonProducerLauncher}[kind]
+    with cls(**cfg) as launcher:
+        launcher.launch_info.save_json(args.out)
+        print(f"wrote {args.out}; waiting for producers (ctrl-c to stop)")
+        try:
+            launcher.wait()
+        except KeyboardInterrupt:
+            pass
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

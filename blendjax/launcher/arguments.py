@@ -1,0 +1,91 @@
+"""The launcher<->producer CLI handshake protocol.
+
+Wire-compatible with the reference protocol so existing Blender scene
+scripts keep working: the launcher appends ``-- -btid <int> -btseed <int>
+-btsockets NAME=ADDR [NAME=ADDR ...] <user args...>`` to the producer
+command line (``launcher.py:114-122``), and the producer splits its argv at
+``--`` and parses those flags (``pkg_blender/blendtorch/btb/arguments.py:
+5-46``), receiving any remaining user flags back as a remainder list.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+
+@dataclass
+class LaunchArgs:
+    """Parsed producer-side handshake args."""
+
+    btid: int
+    btseed: int
+    btsockets: dict = field(default_factory=dict)
+
+    # Attribute aliases so code written against the reference's argparse
+    # namespace keeps reading naturally.
+    @property
+    def instance_id(self) -> int:
+        return self.btid
+
+    @property
+    def seed(self) -> int:
+        return self.btseed
+
+    @property
+    def sockets(self) -> dict:
+        return self.btsockets
+
+
+def parse_launch_args(argv: list[str]):
+    """Split ``argv`` at ``--`` and parse the handshake flags.
+
+    Returns ``(LaunchArgs, remainder)`` where ``remainder`` holds the user
+    args the launcher passed through per instance (reference
+    ``arguments.py:29-46``). Parsing is a hand-rolled scan rather than
+    argparse: the ``-btsockets`` value list ends at the first token that is
+    not ``NAME=ADDR``-shaped, so positional user args (e.g. a scene path)
+    survive into the remainder instead of being swallowed.
+    """
+    if "--" in argv:
+        argv = argv[argv.index("--") + 1:]
+    btid = btseed = None
+    btsockets: dict = {}
+    remainder: list[str] = []
+    i = 0
+    while i < len(argv):
+        tok = argv[i]
+        if tok == "-btid" and i + 1 < len(argv):
+            btid = int(argv[i + 1])
+            i += 2
+        elif tok == "-btseed" and i + 1 < len(argv):
+            btseed = int(argv[i + 1])
+            i += 2
+        elif tok == "-btsockets":
+            i += 1
+            while i < len(argv) and not argv[i].startswith("-"):
+                name, sep, addr = argv[i].partition("=")
+                if not sep or not addr:
+                    break
+                btsockets[name] = addr
+                i += 1
+        else:
+            remainder.append(tok)
+            i += 1
+    if btid is None or btseed is None:
+        raise ValueError(
+            f"missing -btid/-btseed in producer argv {argv!r}; was this "
+            "process started by a blendjax launcher?"
+        )
+    return LaunchArgs(btid=btid, btseed=btseed, btsockets=btsockets), remainder
+
+
+def format_launch_args(btid: int, btseed: int, btsockets: dict,
+                       extra: list[str] | None = None) -> list[str]:
+    """Launcher-side inverse of :func:`parse_launch_args`."""
+    argv = ["-btid", str(btid), "-btseed", str(btseed)]
+    if btsockets:
+        argv.append("-btsockets")
+        argv.extend(f"{name}={addr}" for name, addr in btsockets.items())
+    if extra:
+        argv.extend(str(e) for e in extra)
+    return argv

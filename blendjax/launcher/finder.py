@@ -1,0 +1,78 @@
+"""Locate a Blender executable and validate its embedded Python.
+
+Reference: ``pkg_pytorch/blendtorch/btt/finder.py:16-76`` — search PATH
+plus user-supplied additional paths, parse ``blender --version``, and
+smoke-test that the producer package's dependencies import inside
+Blender's bundled Python.
+"""
+
+from __future__ import annotations
+
+import re
+import shutil
+import subprocess
+
+from blendjax.utils.logging import get_logger
+
+logger = get_logger("finder")
+
+_VERSION_RE = re.compile(r"Blender\s+(\d+)\.(\d+)", re.IGNORECASE)
+
+# The producer runtime needs zmq (+ optionally msgpack for the tensor
+# codec) inside Blender's Python (reference smoke-tests zmq only,
+# ``finder.py:11-14``).
+_SMOKE_SCRIPT = (
+    "import zmq; "
+    "import importlib.util as u; "
+    "print('BJX-OK', 'msgpack' if u.find_spec('msgpack') else 'pickle-only')"
+)
+
+
+def discover_blender(additional_blender_paths=None, timeout: float = 30.0):
+    """Find Blender and return ``{'path', 'major', 'minor', 'codec'}``,
+    or ``None`` when missing/unusable (mirrors the reference contract of
+    returning None rather than raising, ``finder.py:16-76``)."""
+    path_env = None
+    if additional_blender_paths:
+        import os
+
+        path_env = os.pathsep.join(
+            list(additional_blender_paths) + [os.environ.get("PATH", "")]
+        )
+    exe = shutil.which("blender", path=path_env)
+    if exe is None:
+        logger.warning("could not find a blender executable on PATH")
+        return None
+    try:
+        out = subprocess.run(
+            [exe, "--version"], capture_output=True, text=True, timeout=timeout
+        ).stdout
+    except (OSError, subprocess.TimeoutExpired) as e:
+        logger.warning("failed to run '%s --version': %s", exe, e)
+        return None
+    m = _VERSION_RE.search(out or "")
+    if not m:
+        logger.warning("could not parse blender version from %r", out[:200])
+        return None
+    try:
+        smoke = subprocess.run(
+            [exe, "--background", "--python-use-system-env",
+             "--python-expr", _SMOKE_SCRIPT],
+            capture_output=True, text=True, timeout=timeout,
+        ).stdout
+    except (OSError, subprocess.TimeoutExpired) as e:
+        logger.warning("blender python smoke test failed to run: %s", e)
+        return None
+    if "BJX-OK" not in (smoke or ""):
+        logger.warning(
+            "blender found at %s but its Python cannot import zmq; "
+            "install producer deps into Blender's Python first", exe
+        )
+        return None
+    codec = "tensor" if "msgpack" in smoke else "pickle"
+    return {
+        "path": exe,
+        "major": int(m.group(1)),
+        "minor": int(m.group(2)),
+        "codec": codec,
+    }

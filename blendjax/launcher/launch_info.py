@@ -1,0 +1,60 @@
+"""Serializable record of a launch, for cross-machine workflows.
+
+Reference: ``pkg_pytorch/blendtorch/btt/launch_info.py:4-62`` — save the
+socket addresses/commands of a running fleet as JSON on machine A, load on
+machine B and connect a consumer to the addresses
+(``examples/datagen/Readme.md:108-156``). The reference's file-object
+branch referenced an undefined ``nullcontext`` (``launch_info.py:38,59``, a
+latent bug); here both paths just work.
+"""
+
+from __future__ import annotations
+
+import json
+from contextlib import nullcontext
+from dataclasses import dataclass, field
+
+
+@dataclass
+class LaunchInfo:
+    """Addresses (``{socket_name: [addr_per_instance]}``), the spawn
+    commands, and optional process ids of a launched fleet."""
+
+    addresses: dict
+    commands: list = field(default_factory=list)
+    processes: list = field(default_factory=list)
+
+    def to_json(self) -> str:
+        return json.dumps(
+            {
+                "addresses": self.addresses,
+                "commands": self.commands,
+                "processes": self.processes,
+            },
+            indent=2,
+        )
+
+    def save_json(self, file) -> None:
+        """Write to a path or an open file-like object."""
+        ctx = open(file, "w") if isinstance(file, (str, bytes)) or hasattr(
+            file, "__fspath__"
+        ) else nullcontext(file)
+        with ctx as f:
+            f.write(self.to_json())
+
+    @staticmethod
+    def from_json(text: str) -> "LaunchInfo":
+        d = json.loads(text)
+        return LaunchInfo(
+            addresses=d["addresses"],
+            commands=d.get("commands", []),
+            processes=d.get("processes", []),
+        )
+
+    @staticmethod
+    def load_json(file) -> "LaunchInfo":
+        ctx = open(file, "r") if isinstance(file, (str, bytes)) or hasattr(
+            file, "__fspath__"
+        ) else nullcontext(file)
+        with ctx as f:
+            return LaunchInfo.from_json(f.read())

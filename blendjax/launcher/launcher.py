@@ -1,0 +1,259 @@
+"""Producer fleet launcher.
+
+Reference: ``pkg_pytorch/blendtorch/btt/launcher.py:15-197``. Same contract
+— a context manager that allocates one address per (named socket x
+instance), derives per-instance seeds ``seed+i``, spawns each producer in
+its own process group with the CLI handshake appended after ``--``, polls
+liveness, and kills everything on exit — generalized beyond Blender:
+
+- :class:`ProcessLauncher` spawns any command template, so headless
+  simulation producers (tests, benchmarks; SURVEY.md §4 "fake producer")
+  and Blender use one code path.
+- Optional ``respawn`` brings dead producers back (the data stream is
+  stateless DP, so restart is safe); the reference is strictly fail-fast
+  (``launcher.py:166-171``) and that remains the default.
+- Note: the reference computed popen kwargs but passed a stale variable
+  (``launcher.py:126-132``, latent bug) — not reproduced here.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import socket as pysocket
+import subprocess
+import sys
+
+from blendjax.launcher.arguments import format_launch_args
+from blendjax.launcher.launch_info import LaunchInfo
+from blendjax.utils.ipaddr import get_primary_ip
+from blendjax.utils.logging import get_logger
+
+logger = get_logger("launcher")
+
+
+def _free_port(host: str) -> int:
+    """Probe a free TCP port by binding port 0 (small race window; fine for
+    single-host use — fixed ``start_port`` mode exists for multi-machine)."""
+    with pysocket.socket(pysocket.AF_INET, pysocket.SOCK_STREAM) as s:
+        s.setsockopt(pysocket.SOL_SOCKET, pysocket.SO_REUSEADDR, 1)
+        s.bind((host, 0))
+        return s.getsockname()[1]
+
+
+class ProcessLauncher:
+    """Launch ``num_instances`` producer processes speaking the handshake.
+
+    Parameters mirror the reference's ``BlenderLauncher`` (``launcher.py:
+    58-70``): ``named_sockets`` get one ``tcp://`` address per instance,
+    ``seed`` derives per-instance seeds ``seed+i`` (``launcher.py:109-112``),
+    ``instance_args`` appends per-instance user flags, ``bind_addr`` may be
+    ``'primaryip'`` to expose producers to other machines
+    (``launcher.py:187-188``).
+
+    ``command`` is a callable ``(instance_index, handshake_argv) ->
+    list[str]`` producing the full argv for one instance.
+    """
+
+    def __init__(
+        self,
+        command,
+        num_instances: int = 1,
+        named_sockets=("DATA",),
+        seed: int = 0,
+        bind_addr: str = "127.0.0.1",
+        start_port: int | None = None,
+        instance_args=None,
+        respawn: bool = False,
+        proto: str = "tcp",
+    ):
+        assert num_instances > 0, "need at least one instance"
+        self.command = command
+        self.num_instances = num_instances
+        self.named_sockets = list(named_sockets)
+        self.seed = seed
+        self.instance_args = instance_args or [[] for _ in range(num_instances)]
+        assert len(self.instance_args) == num_instances
+        self.respawn = respawn
+        self.proto = proto
+        self.bind_addr = (
+            get_primary_ip() if bind_addr == "primaryip" else bind_addr
+        )
+        self.start_port = start_port
+        self.processes: list = []
+        self.launch_info: LaunchInfo | None = None
+        self._argvs: list = []
+
+    # -- address plan -------------------------------------------------------
+
+    def _allocate_addresses(self) -> dict:
+        """One address per (socket name x instance): ``{name: [addr, ...]}``.
+
+        With ``start_port`` set, ports are deterministic ``start_port+k``
+        in socket-major order (reference starts at 11000,
+        ``launcher.py:63,104-107``); otherwise free ports are probed.
+        """
+        addresses: dict = {}
+        port = self.start_port
+        for name in self.named_sockets:
+            addrs = []
+            for _ in range(self.num_instances):
+                if port is not None:
+                    p, port = port, port + 1
+                else:
+                    p = _free_port(self.bind_addr)
+                addrs.append(f"{self.proto}://{self.bind_addr}:{p}")
+            addresses[name] = addrs
+        return addresses
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def __enter__(self) -> "ProcessLauncher":
+        addresses = self._allocate_addresses()
+        self._argvs = []
+        try:
+            for i in range(self.num_instances):
+                sockets = {n: addresses[n][i] for n in self.named_sockets}
+                handshake = ["--"] + format_launch_args(
+                    btid=i,
+                    btseed=self.seed + i,
+                    btsockets=sockets,
+                    extra=self.instance_args[i],
+                )
+                argv = self.command(i, handshake)
+                self._argvs.append(argv)
+                self.processes.append(self._spawn(argv))
+                logger.info(
+                    "launched instance %d: %s", i, " ".join(map(str, argv))
+                )
+        except BaseException:
+            # __exit__ never runs when __enter__ raises; reap what we
+            # already spawned before propagating.
+            self.__exit__(None, None, None)
+            raise
+        self.launch_info = LaunchInfo(
+            addresses=addresses,
+            commands=[" ".join(map(str, a)) for a in self._argvs],
+            processes=[p.pid for p in self.processes],
+        )
+        return self
+
+    def _spawn(self, argv):
+        # Own session/process group so the whole producer tree can be
+        # signalled together (reference launches in a new process group,
+        # ``launcher.py:124-132``).
+        return subprocess.Popen(argv, start_new_session=True)
+
+    @property
+    def addresses(self) -> dict:
+        assert self.launch_info is not None, "not launched"
+        return self.launch_info.addresses
+
+    def poll(self) -> list:
+        """Return per-instance exit codes (None = running); with
+        ``respawn=True`` dead instances are relaunched first."""
+        codes = [p.poll() for p in self.processes]
+        if self.respawn:
+            for i, code in enumerate(codes):
+                if code is not None:
+                    logger.warning(
+                        "instance %d exited with %s; respawning", i, code
+                    )
+                    self.processes[i] = self._spawn(self._argvs[i])
+                    codes[i] = None
+        return codes
+
+    def assert_alive(self) -> None:
+        """Raise if any instance died (reference ``launcher.py:166-171``)."""
+        if not self.processes:
+            return
+        codes = self.poll()
+        dead = {i: c for i, c in enumerate(codes) if c is not None}
+        if dead:
+            raise RuntimeError(f"producer instances died (id: exitcode) {dead}")
+
+    def wait(self) -> list:
+        """Block until all instances exit; returns exit codes
+        (reference ``launcher.py:173-175``)."""
+        return [p.wait() for p in self.processes]
+
+    def __exit__(self, *exc) -> bool:
+        for p in self.processes:
+            if p.poll() is None:
+                try:
+                    os.killpg(os.getpgid(p.pid), signal.SIGTERM)
+                except (ProcessLookupError, PermissionError):
+                    pass
+        for p in self.processes:
+            try:
+                p.wait(timeout=5.0)
+            except subprocess.TimeoutExpired:
+                try:
+                    os.killpg(os.getpgid(p.pid), signal.SIGKILL)
+                except (ProcessLookupError, PermissionError):
+                    pass
+                try:
+                    p.wait(timeout=5.0)
+                except subprocess.TimeoutExpired:
+                    # Unkillable (e.g. D-state) child; fall through to the
+                    # liveness assert rather than masking the original error.
+                    pass
+        # All children must be gone (reference asserts, ``launcher.py:181``).
+        still = [p.pid for p in self.processes if p.poll() is None]
+        assert not still, f"producers still alive after teardown: {still}"
+        self.processes = []
+        logger.info("all producer instances terminated")
+        return False
+
+
+class PythonProducerLauncher(ProcessLauncher):
+    """Launch headless Python producers (``python script -- handshake``) —
+    the hermetic stand-in for Blender in tests/benchmarks (SURVEY.md §4)."""
+
+    def __init__(self, script: str, script_args=None, **kwargs):
+        self.script = script
+        self.script_args = [str(a) for a in (script_args or [])]
+        super().__init__(command=self._build, **kwargs)
+
+    def _build(self, index, handshake):
+        return [sys.executable, self.script, *self.script_args, *handshake]
+
+
+class BlenderLauncher(ProcessLauncher):
+    """Launch Blender instances running a scene + producer script.
+
+    Reference: ``launcher.py:15-164``. Command shape preserved:
+    ``blender <scene> [--background] --python-use-system-env --python
+    <script> -- <handshake>`` so unmodified ``*.blend.py`` producer scripts
+    work against a blendjax consumer.
+    """
+
+    def __init__(
+        self,
+        scene: str = "",
+        script: str = "",
+        background: bool = False,
+        blend_path=None,
+        **kwargs,
+    ):
+        from blendjax.launcher.finder import discover_blender
+
+        self.blender_info = discover_blender(blend_path)
+        if self.blender_info is None:
+            raise FileNotFoundError(
+                "no usable Blender found; install Blender and its producer "
+                "deps, or use PythonProducerLauncher for headless producers"
+            )
+        self.scene = str(scene)
+        self.script = str(script)
+        self.background = background
+        super().__init__(command=self._build, **kwargs)
+
+    def _build(self, index, handshake):
+        argv = [self.blender_info["path"]]
+        if self.scene:
+            argv.append(self.scene)
+        if self.background:
+            argv.append("--background")
+        argv += ["--python-use-system-env", "--python", self.script]
+        return argv + handshake
