@@ -62,9 +62,13 @@ def parse_launch_args(argv: list[str]):
             i += 2
         elif tok == "-btsockets":
             i += 1
+            # Socket values are NAME=ADDR where ADDR is a zmq endpoint
+            # (always contains '://'); anything else — including user args
+            # like 'scene=warehouse.blend' — ends the list and stays in the
+            # remainder.
             while i < len(argv) and not argv[i].startswith("-"):
                 name, sep, addr = argv[i].partition("=")
-                if not sep or not addr:
+                if not sep or "://" not in addr:
                     break
                 btsockets[name] = addr
                 i += 1

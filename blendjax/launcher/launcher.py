@@ -177,7 +177,7 @@ class ProcessLauncher:
         (reference ``launcher.py:173-175``)."""
         return [p.wait() for p in self.processes]
 
-    def __exit__(self, *exc) -> bool:
+    def __exit__(self, exc_type=None, exc=None, tb=None) -> bool:
         for p in self.processes:
             if p.poll() is None:
                 try:
@@ -200,9 +200,16 @@ class ProcessLauncher:
                     pass
         # All children must be gone (reference asserts, ``launcher.py:181``).
         still = [p.pid for p in self.processes if p.poll() is None]
-        assert not still, f"producers still alive after teardown: {still}"
         self.processes = []
-        logger.info("all producer instances terminated")
+        if still:
+            # Never mask an in-flight exception with the leak report.
+            if exc_type is None:
+                raise RuntimeError(
+                    f"producers still alive after teardown: {still}"
+                )
+            logger.error("producers still alive after teardown: %s", still)
+        else:
+            logger.info("all producer instances terminated")
         return False
 
 

@@ -1,0 +1,32 @@
+"""Producer-side runtime: runs inside renderer processes.
+
+Reference counterpart: ``pkg_blender/blendtorch/btb`` (the package installed
+into Blender's embedded Python). blendjax generalizes it behind an *engine*
+interface so the same lifecycle/publishing/env code drives either
+
+- Blender (``blendjax.producer.bpy_engine``, importable only under ``bpy``), or
+- the headless simulation engine (``blendjax.producer.sim``) used by tests,
+  benchmarks, and any non-Blender renderer.
+
+Import policy: nothing here imports ``jax`` or ``bpy`` at package level;
+Blender-only modules are imported lazily/gated.
+"""
+
+from blendjax.launcher.arguments import parse_launch_args
+from blendjax.producer.animation import AnimationController
+from blendjax.producer.camera import Camera
+from blendjax.producer.duplex import DuplexChannel
+from blendjax.producer.env import BaseEnv, RemoteControlledAgent
+from blendjax.producer.publisher import DataPublisher
+from blendjax.producer.signal import Signal
+
+__all__ = [
+    "parse_launch_args",
+    "AnimationController",
+    "Camera",
+    "DataPublisher",
+    "DuplexChannel",
+    "Signal",
+    "BaseEnv",
+    "RemoteControlledAgent",
+]

@@ -1,0 +1,146 @@
+"""Animation lifecycle controller — the producer's main loop.
+
+Reference: ``pkg_blender/blendtorch/btb/animation.py:9-212``. It turns a
+frame-stepped simulation into deterministic lifecycle events, asserted in
+the reference's ``tests/test_animation.py:7-26``::
+
+    pre_play -> [pre_animation -> (pre_frame -> post_frame) x N
+                 -> post_animation] x E -> post_play
+
+where an *episode* is one replay of the frame range. blendjax drives the
+loop through an :class:`Engine` so the identical controller runs against
+Blender (``BpyEngine``, non-blocking via ``bpy`` handlers, see
+``bpy_engine.py``) or any headless simulator (``sim.SimEngine`` — the
+blocking strategy the reference uses under ``--background``,
+``animation.py:153-164``).
+"""
+
+from __future__ import annotations
+
+from blendjax.producer.signal import Signal
+
+
+class Engine:
+    """What the controller needs from a renderer/simulator.
+
+    ``frame_set(i)`` must advance the scene/physics to frame ``i``; the
+    controller invokes ``pre_frame`` before and ``post_frame`` after, so
+    physics resolves between action application and observation — the
+    contract the env layer depends on (reference ``btb/env.py:144-159``).
+    """
+
+    def frame_set(self, frame: int) -> None:
+        raise NotImplementedError
+
+    def reset(self) -> None:
+        """Rewind scene state to the start of the frame range (reference
+        syncs rigid-body point caches here, ``animation.py:108-134``)."""
+
+
+class CancelledError(Exception):
+    """Raised internally to unwind a cancelled play loop."""
+
+
+class AnimationController:
+    """Drives episodes of a frame range over an :class:`Engine`.
+
+    Signals (reference ``animation.py:33-40``): ``pre_play``,
+    ``pre_animation``, ``pre_frame``, ``post_frame``, ``post_animation``,
+    ``post_play``. Frame handlers receive the current frame number.
+    """
+
+    def __init__(self, engine: Engine):
+        self.engine = engine
+        self.pre_play = Signal()
+        self.pre_animation = Signal()
+        self.pre_frame = Signal()
+        self.post_frame = Signal()
+        self.post_animation = Signal()
+        self.post_play = Signal()
+        self.frameid: int | None = None
+        self.episode = 0
+        self._playing = False
+        self._rewind_requested = False
+        self._cancel_requested = False
+
+    @property
+    def playing(self) -> bool:
+        return self._playing
+
+    @property
+    def cancelled(self) -> bool:
+        """True once :meth:`cancel` was requested (thread-safe flag read;
+        lets long-blocking frame handlers — e.g. the env RPC rendezvous —
+        bail out promptly)."""
+        return self._cancel_requested
+
+    def rewind(self) -> None:
+        """Restart the current episode's frame range at the next frame
+        boundary (reference ``animation.py:166-184``); callable from
+        within ``pre_frame``/``post_frame`` handlers."""
+        self._rewind_requested = True
+
+    def cancel(self) -> None:
+        """Stop playing after the current frame (reference teardown
+        ``animation.py:186-212``)."""
+        self._cancel_requested = True
+
+    def play(
+        self,
+        frame_range=(1, 250),
+        num_episodes: int = -1,
+        use_animation: bool | None = None,
+    ) -> None:
+        """Blocking play loop. ``num_episodes=-1`` plays forever (until
+        :meth:`cancel`). ``use_animation`` is accepted for reference API
+        compatibility (``animation.py:73-106``); engines that own their own
+        clock (Blender UI mode) override :meth:`_run_loop` instead.
+        """
+        del use_animation
+        assert not self._playing, "already playing"
+        start, end = int(frame_range[0]), int(frame_range[1])
+        assert end >= start, f"invalid frame range {frame_range}"
+        self._playing = True
+        self._cancel_requested = False
+        self.episode = 0
+        self.pre_play.invoke()
+        try:
+            self._run_loop(start, end, num_episodes)
+        except CancelledError:
+            pass
+        finally:
+            self._playing = False
+            self.post_play.invoke()
+
+    # -- internals ----------------------------------------------------------
+
+    def _run_loop(self, start: int, end: int, num_episodes: int) -> None:
+        while num_episodes < 0 or self.episode < num_episodes:
+            self._play_episode(start, end)
+            self.episode += 1
+            if self._cancel_requested:
+                break
+
+    def _play_episode(self, start: int, end: int) -> None:
+        self.engine.reset()
+        self.pre_animation.invoke()
+        frame = start
+        while frame <= end:
+            self._rewind_requested = False
+            self.frameid = frame
+            self.pre_frame.invoke(frame)
+            self.engine.frame_set(frame)
+            self.post_frame.invoke(frame)
+            if self._cancel_requested:
+                raise CancelledError
+            if self._rewind_requested:
+                # Restart this episode's range without closing the episode
+                # (reference ``rewind``, ``animation.py:166-184``).
+                # ``pre_animation`` re-fires so env-layer reset hooks run
+                # (reference resets env state there, ``btb/env.py:111-115``).
+                self.engine.reset()
+                self.pre_animation.invoke()
+                frame = start
+                continue
+            frame += 1
+        self.post_animation.invoke()

@@ -1,0 +1,149 @@
+"""Camera model: project scene geometry to pixel-space annotations.
+
+Reference: ``pkg_blender/blendtorch/btb/camera.py:8-204`` — view/projection
+matrices from the Blender camera, ``world_to_ndc`` (+ linear depth),
+``ndc_to_pixel`` with upper-left/lower-left origins, ``object_to_pixel`` /
+``bbox_object_to_pixel`` compositions, and ``look_at``.
+
+blendjax's camera is a standalone numpy model (Blender conventions: camera
+looks down -Z, +Y is up) constructed from explicit intrinsics/extrinsics,
+with a ``from_bpy`` hook for real Blender cameras (see ``bpy_engine.py``).
+That makes annotation math testable against analytic ground truth instead
+of a ``.blend`` fixture (reference ``tests/test_camera.py`` + ``cam.blend``).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from blendjax.producer.utils import dehom, hom, look_at_matrix
+
+
+class Camera:
+    """Pinhole or orthographic camera.
+
+    Parameters
+    ----------
+    position, rotation:
+        World-space camera origin and 3x3 world-from-camera rotation.
+    shape:
+        Image ``(height, width)`` (reference ``camera.py:57-66`` derives it
+        from render settings x resolution_percentage).
+    focal_mm / sensor_mm:
+        Pinhole intrinsics, Blender-style (perspective only).
+    ortho_scale:
+        World-units width of the view volume (orthographic only).
+    """
+
+    def __init__(
+        self,
+        position=(0.0, 0.0, 0.0),
+        rotation=None,
+        shape=(480, 640),
+        focal_mm: float = 50.0,
+        sensor_mm: float = 36.0,
+        ortho_scale: float | None = None,
+        clip_near: float = 0.1,
+        clip_far: float = 100.0,
+    ):
+        self.position = np.asarray(position, np.float64)
+        self.rotation = (
+            np.eye(3) if rotation is None else np.asarray(rotation, np.float64)
+        )
+        self.shape = (int(shape[0]), int(shape[1]))
+        self.focal_mm = float(focal_mm)
+        self.sensor_mm = float(sensor_mm)
+        self.ortho_scale = None if ortho_scale is None else float(ortho_scale)
+        self.clip_near = float(clip_near)
+        self.clip_far = float(clip_far)
+
+    # -- constructors -------------------------------------------------------
+
+    @classmethod
+    def look_at(cls, eye, target, up=(0, 0, 1), **kwargs) -> "Camera":
+        """Camera positioned at ``eye`` aimed at ``target`` (reference
+        ``camera.py:191-204``)."""
+        return cls(
+            position=eye, rotation=look_at_matrix(eye, target, up), **kwargs
+        )
+
+    @classmethod
+    def from_bpy(cls, bpy_camera=None, shape=None) -> "Camera":
+        """Build from a Blender camera object (requires ``bpy``; reference
+        ``camera.py:8-82``)."""
+        from blendjax.producer.bpy_engine import camera_from_bpy
+
+        return camera_from_bpy(cls, bpy_camera, shape)
+
+    # -- matrices -----------------------------------------------------------
+
+    @property
+    def view_matrix(self) -> np.ndarray:
+        """4x4 camera-from-world (reference ``camera.py:68-74``)."""
+        m = np.eye(4)
+        rt = self.rotation.T
+        m[:3, :3] = rt
+        m[:3, 3] = -rt @ self.position
+        return m
+
+    @property
+    def proj_matrix(self) -> np.ndarray:
+        """4x4 OpenGL-style projection (reference ``camera.py:76-82``)."""
+        h, w = self.shape
+        aspect = w / h
+        n, f = self.clip_near, self.clip_far
+        p = np.zeros((4, 4))
+        if self.ortho_scale is not None:
+            r = self.ortho_scale / 2.0
+            t = r / aspect
+            p[0, 0] = 1.0 / r
+            p[1, 1] = 1.0 / t
+            p[2, 2] = -2.0 / (f - n)
+            p[2, 3] = -(f + n) / (f - n)
+            p[3, 3] = 1.0
+        else:
+            sx = self.sensor_mm
+            sy = self.sensor_mm / aspect
+            p[0, 0] = 2.0 * self.focal_mm / sx
+            p[1, 1] = 2.0 * self.focal_mm / sy
+            p[2, 2] = -(f + n) / (f - n)
+            p[2, 3] = -2.0 * f * n / (f - n)
+            p[3, 2] = -1.0
+        return p
+
+    # -- projections --------------------------------------------------------
+
+    def world_to_ndc(self, xyz_world) -> tuple[np.ndarray, np.ndarray]:
+        """Project world points to NDC; also return linear depth (positive
+        distance along the view direction; reference ``camera.py:84-112``)."""
+        xyz_world = np.atleast_2d(np.asarray(xyz_world, np.float64))
+        cam = hom(xyz_world) @ self.view_matrix.T
+        depth = -cam[:, 2]
+        ndc = dehom(cam @ self.proj_matrix.T)
+        return ndc, depth
+
+    def ndc_to_pixel(self, ndc, origin: str = "upper-left") -> np.ndarray:
+        """NDC -> pixel coordinates (reference ``camera.py:115-136``)."""
+        assert origin in ("upper-left", "lower-left")
+        h, w = self.shape
+        ndc = np.atleast_2d(np.asarray(ndc, np.float64))
+        x = (ndc[:, 0] + 1.0) * 0.5 * w
+        y01 = (ndc[:, 1] + 1.0) * 0.5
+        y = (1.0 - y01) * h if origin == "upper-left" else y01 * h
+        return np.stack([x, y], axis=1)
+
+    def world_to_pixel(
+        self, xyz_world, origin: str = "upper-left", return_depth: bool = False
+    ):
+        """Compose projection to pixels (reference ``object_to_pixel``,
+        ``camera.py:138-189``, without the bpy object dereference)."""
+        ndc, depth = self.world_to_ndc(xyz_world)
+        px = self.ndc_to_pixel(ndc, origin=origin)
+        return (px, depth) if return_depth else px
+
+    def bbox_world_to_pixel(self, xyz_world, origin: str = "upper-left"):
+        """Axis-aligned pixel bbox ``(xmin, ymin, xmax, ymax)`` of points
+        (reference ``bbox_object_to_pixel``, ``camera.py:162-189``)."""
+        px = self.world_to_pixel(xyz_world, origin=origin)
+        mins, maxs = px.min(axis=0), px.max(axis=0)
+        return np.array([mins[0], mins[1], maxs[0], maxs[1]])

@@ -1,0 +1,31 @@
+"""Producer end of the duplex control channel (PAIR, bind side).
+
+Reference: ``pkg_blender/blendtorch/btb/duplex.py:8-66`` — identical to the
+consumer twin except it binds, and uses the shorter producer default
+timeout (``btb/constants.py:4``).
+"""
+
+from __future__ import annotations
+
+from blendjax import constants
+from blendjax.transport import PairChannel
+
+
+class DuplexChannel(PairChannel):
+    def __init__(
+        self,
+        addr: str,
+        btid: int | None = None,
+        lingerms: int = 0,
+        hwm: int = constants.DEFAULT_SEND_HWM,
+        codec: str = "tensor",
+    ):
+        super().__init__(
+            addr,
+            btid=btid,
+            bind=True,
+            hwm=hwm,
+            lingerms=lingerms,
+            codec=codec,
+            default_timeoutms=constants.DEFAULT_PRODUCER_TIMEOUTMS,
+        )

@@ -1,0 +1,200 @@
+"""Producer-side environment base: remote-controlled simulation episodes.
+
+Reference: ``pkg_blender/blendtorch/btb/env.py``. The defining pattern
+(SURVEY.md §3.2): a *blocking* REQ/REP rendezvous embedded in a frame-
+callback world. One remote ``step()`` = one simulated frame; ``step`` is
+split into a pre-frame half (apply action) and a post-frame half (collect
+observation) so physics resolves in between (``btb/env.py:144-159``).
+
+:class:`RemoteControlledAgent` is the REP-side state machine
+(``btb/env.py:179-252``): it owes a reply after every accepted request
+(STATE_REP), sends the freshly-computed context at the next frame
+boundary, then waits for the next command (STATE_REQ). ``real_time=True``
+degrades to non-blocking receives, substituting ``(CMD_STEP, None)`` when
+the consumer is slow (``btb/env.py:222-233``) so the simulation clock never
+stalls.
+"""
+
+from __future__ import annotations
+
+import time
+
+from blendjax import constants
+from blendjax.producer.animation import AnimationController, Engine
+from blendjax.transport import RpcServer
+
+CMD_STEP = "step"
+CMD_RESTART = "restart"
+
+
+class BaseEnv:
+    """Wire an agent into the animation lifecycle.
+
+    Subclasses implement (reference ``btb/env.py:137-176``):
+
+    - ``_env_reset()`` — reset scene state at episode start.
+    - ``_env_prepare_step(action)`` — apply the action before physics.
+    - ``_env_post_step()`` — return the post-physics context dict
+      (``obs``/``reward``/``done``/extras).
+    """
+
+    def __init__(self, agent):
+        self.agent = agent
+        self.events: AnimationController | None = None
+        self.ctx: dict = {}
+        self.renderer = None
+        self.render_every: int = 1
+
+    # -- lifecycle wiring ---------------------------------------------------
+
+    def run(self, engine: Engine, frame_range=(1, 2_147_483_647)) -> None:
+        """Play frames forever under ``engine`` (reference ``run`` plays to
+        INT32_MAX, ``btb/env.py:55-77``)."""
+        self.events = AnimationController(engine)
+        self.events.pre_frame.add(self._pre_frame)
+        self.events.pre_animation.add(self._pre_animation)
+        self.events.post_frame.add(self._post_frame)
+        self.events.play(frame_range=frame_range, num_episodes=-1)
+
+    def attach_default_renderer(self, every_nth: int = 1, renderer=None):
+        """Attach an rgb renderer whose output rides along as
+        ``rgb_array`` every ``every_nth`` frames (reference
+        ``btb/env.py:79-95``). With ``renderer=None`` the env's default is
+        used: :meth:`_default_renderer`, which subclasses backed by a sim
+        scene override (Blender envs get an ``OffScreenRenderer``)."""
+        self.renderer = renderer or self._default_renderer()
+        if self.renderer is None:
+            raise ValueError(
+                "no renderer: pass renderer=... or override _default_renderer"
+            )
+        self.render_every = max(1, int(every_nth))
+
+    def _default_renderer(self):
+        """Return a zero-arg callable producing an HxWxC uint8 frame, or
+        None. Under Blender, builds the offscreen Eevee renderer."""
+        try:
+            from blendjax.producer.offscreen import OffScreenRenderer
+
+            return OffScreenRenderer().render
+        except ImportError:
+            return None
+
+    def stop(self) -> None:
+        if self.events is not None:
+            self.events.cancel()
+
+    # -- signal handlers ----------------------------------------------------
+
+    def _pre_animation(self) -> None:
+        # Episode start: reset env state + context (``btb/env.py:111-115``).
+        self.ctx = {}
+        self._env_reset()
+
+    def _pre_frame(self, frame: int) -> None:
+        # (``btb/env.py:97-109``)
+        cmd, action = self.agent(self, **self.ctx)
+        if cmd == CMD_RESTART:
+            self.events.rewind()
+        elif cmd == CMD_STEP:
+            if action is not None:
+                self._env_prepare_step(action)
+            # Simulation time = frame id (``btb/env.py:99``).
+            self.ctx["time"] = frame
+
+    def _post_frame(self, frame: int) -> None:
+        # (``btb/env.py:117-131``)
+        if self.renderer is not None and frame % self.render_every == 0:
+            self.ctx["rgb_array"] = self.renderer()
+        self.ctx.update(self._env_post_step())
+
+    # -- to be implemented by scene envs ------------------------------------
+
+    def _env_reset(self) -> None:
+        raise NotImplementedError
+
+    def _env_prepare_step(self, action) -> None:
+        raise NotImplementedError
+
+    def _env_post_step(self) -> dict:
+        raise NotImplementedError
+
+
+class RemoteControlledAgent:
+    """REP-side state machine bridging blocking remote calls to frames.
+
+    Reference: ``btb/env.py:179-252``.
+    """
+
+    STATE_INIT = 0  # nothing received yet this episode
+    STATE_REQ = 1  # waiting for the next command
+    STATE_REP = 2  # a reply is owed after the current frame
+
+    def __init__(
+        self,
+        bind_addr: str,
+        real_time: bool = False,
+        timeoutms: int = constants.DEFAULT_PRODUCER_TIMEOUTMS,
+    ):
+        self.server = RpcServer(bind_addr)
+        self.addr = self.server.addr
+        self.real_time = real_time
+        self.timeoutms = timeoutms
+        self.state = self.STATE_INIT
+
+    def __call__(self, env: BaseEnv, **ctx):
+        if self.state == self.STATE_REP:
+            if not ctx:
+                # A reply is owed but the fresh episode hasn't produced an
+                # observation yet (ctx was reset in pre_animation): run one
+                # defaults-step so post_frame fills ctx, reply next frame.
+                return CMD_STEP, None
+            self.server.reply(**self._wire_ctx(ctx))
+            self.state = self.STATE_REQ
+
+        req = self._next_request(env)
+        if req is None:
+            # real_time only: consumer too slow — free-run the simulation
+            # with a default step (``btb/env.py:222-233``).
+            return CMD_STEP, None
+
+        cmd = req.get("cmd")
+        if cmd == "reset":
+            if self.state == self.STATE_INIT:
+                # Episode just started and nothing was stepped: don't
+                # rewind again; step once so fresh obs exist to reply with
+                # (reset-dedup, ``btb/env.py:241-246``).
+                self.state = self.STATE_REP
+                return CMD_STEP, None
+            self.state = self.STATE_REP
+            return CMD_RESTART, None
+        if cmd == "step":
+            self.state = self.STATE_REP
+            return CMD_STEP, req.get("action")
+        # Unknown command: reply with an error, keep waiting next frame.
+        self.server.reply(error=f"unknown cmd {cmd!r}")
+        return CMD_STEP, None
+
+    def _next_request(self, env: BaseEnv):
+        if self.real_time:
+            return self.server.recv(timeoutms=0)
+        # Blocking mode: wait (in pollable slices so cancel/ctrl-c work)
+        # until the consumer sends the next command.
+        while True:
+            req = self.server.recv(timeoutms=min(self.timeoutms, 100))
+            if req is not None:
+                return req
+            if env.events is not None and env.events.cancelled:
+                return None
+            time.sleep(0)  # yield; keep waiting like the reference REP
+
+    @staticmethod
+    def _wire_ctx(ctx: dict) -> dict:
+        # ``done`` must be a plain bool for the wire; numpy bools arrive
+        # from user env code.
+        out = dict(ctx)
+        if "done" in out:
+            out["done"] = bool(out["done"])
+        return out
+
+    def close(self) -> None:
+        self.server.close()

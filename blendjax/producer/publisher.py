@@ -1,0 +1,32 @@
+"""Producer data publisher.
+
+Reference: ``pkg_blender/blendtorch/btb/publisher.py:4-43``. Thin facade
+over :class:`blendjax.transport.DataPublisherSocket` keeping the reference
+call shape ``DataPublisher(bind_addr, btid).publish(**kwargs)`` while
+defaulting to the zero-copy tensor codec instead of pickle.
+"""
+
+from __future__ import annotations
+
+from blendjax import constants
+from blendjax.transport import DataPublisherSocket
+
+
+class DataPublisher(DataPublisherSocket):
+    def __init__(
+        self,
+        bind_addr: str,
+        btid: int | None = None,
+        send_hwm: int = constants.DEFAULT_SEND_HWM,
+        lingerms: int = 0,
+        codec: str = "tensor",
+        copy: bool = False,
+    ):
+        super().__init__(
+            bind_addr,
+            btid=btid,
+            send_hwm=send_hwm,
+            codec=codec,
+            lingerms=lingerms,
+            copy=copy,
+        )

@@ -1,0 +1,37 @@
+"""Minimal slot/callback dispatcher.
+
+Reference: ``pkg_blender/blendtorch/btb/signal.py:3-54`` — ``add`` with
+partial argument binding, ``remove``, ``invoke``. Used by the animation
+controller to expose lifecycle events.
+"""
+
+from __future__ import annotations
+
+import functools
+
+
+class Signal:
+    """An observable event: handlers are invoked in registration order."""
+
+    def __init__(self):
+        self._slots: list = []
+
+    def add(self, fn, *args, **kwargs):
+        """Register ``fn``; extra args are partially bound (reference
+        ``signal.py:20-37``). Returns the registered handle for removal."""
+        handle = functools.partial(fn, *args, **kwargs) if args or kwargs else fn
+        self._slots.append(handle)
+        return handle
+
+    def remove(self, handle) -> None:
+        self._slots.remove(handle)
+
+    def clear(self) -> None:
+        self._slots.clear()
+
+    def invoke(self, *args, **kwargs) -> None:
+        for slot in list(self._slots):
+            slot(*args, **kwargs)
+
+    def __len__(self) -> int:
+        return len(self._slots)

@@ -1,0 +1,422 @@
+"""Headless simulation engine: a Blender stand-in for tests/benchmarks.
+
+The reference can only produce data through a real Blender process, which
+makes its whole test suite Blender-bound (SURVEY.md §4). blendjax ships
+this small software renderer + physics so the full stack — launcher,
+transport, ingest, training, RL — exercises hermetically, and so the
+benchmark producer is CPU-cheap enough to saturate the TPU ingest path.
+
+Scenes mirror the reference examples:
+
+- :class:`CubeScene` — the benchmark scene (``benchmarks/benchmark.py``,
+  ``examples/datagen/cube.blend.py``): one rotating colored cube, publishes
+  ``image`` + corner-pixel annotations ``xy``.
+- :class:`FallingCubesScene` — ``examples/datagen/falling_cubes.blend.py``:
+  N cubes under gravity with ground bounce.
+- :class:`SupershapeScene` — ``examples/densityopt/supershape.blend.py``:
+  a 2D supershape (superformula) whose parameters arrive over the duplex
+  channel.
+- :class:`CartpoleScene` — ``examples/control/cartpole.blend.py``: cart +
+  pole dynamics with a motor action, for the RL env layer.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from blendjax.producer.animation import Engine
+from blendjax.producer.camera import Camera
+
+# ---------------------------------------------------------------------------
+# Rasterizer
+# ---------------------------------------------------------------------------
+
+_CUBE_FACES = np.array(
+    [  # quads as vertex indices into the (-1,+1)^3 corner ordering of
+        # producer.utils.cube_vertices (x-major): 0:(---) 1:(--+) 2:(-+-)
+        # 3:(-++) 4:(+--) 5:(+-+) 6:(++-) 7:(+++)
+        [0, 1, 3, 2],  # -x
+        [4, 6, 7, 5],  # +x
+        [0, 4, 5, 1],  # -y
+        [2, 3, 7, 6],  # +y
+        [0, 2, 6, 4],  # -z
+        [1, 5, 7, 3],  # +z
+    ]
+)
+
+
+def cube_triangles(center, half_extent: float, rotation=None):
+    """World-space triangles (12,3,3) + face index per triangle (12,)."""
+    from blendjax.producer.utils import cube_vertices
+
+    verts = cube_vertices((0, 0, 0), half_extent)
+    if rotation is not None:
+        verts = verts @ np.asarray(rotation, np.float64).T
+    verts = verts + np.asarray(center, np.float64)
+    tris, faces = [], []
+    for f, quad in enumerate(_CUBE_FACES):
+        a, b, c, d = verts[quad]
+        tris.append([a, b, c])
+        tris.append([a, c, d])
+        faces.extend([f, f])
+    return np.array(tris), np.array(faces)
+
+
+def rotation_xyz(rx: float, ry: float, rz: float) -> np.ndarray:
+    cx, sx = np.cos(rx), np.sin(rx)
+    cy, sy = np.cos(ry), np.sin(ry)
+    cz, sz = np.cos(rz), np.sin(rz)
+    mx = np.array([[1, 0, 0], [0, cx, -sx], [0, sx, cx]])
+    my = np.array([[cy, 0, sy], [0, 1, 0], [-sy, 0, cy]])
+    mz = np.array([[cz, -sz, 0], [sz, cz, 0], [0, 0, 1]])
+    return mz @ my @ mx
+
+
+class Rasterizer:
+    """Tiny z-buffered flat-shaded triangle rasterizer (numpy)."""
+
+    def __init__(self, shape=(480, 640), background=(0, 0, 0, 255)):
+        self.shape = (int(shape[0]), int(shape[1]))
+        self.background = np.array(background, np.uint8)
+        h, w = self.shape
+        self._color = np.empty((h, w, 4), np.uint8)
+        self._depth = np.empty((h, w), np.float64)
+        self._light = np.array([0.4, -0.35, 0.85])
+        self._light = self._light / np.linalg.norm(self._light)
+
+    def render(self, camera: Camera, triangles, colors) -> np.ndarray:
+        """Render world-space ``triangles`` (N,3,3) filled with ``colors``
+        (N,3|4 uint8); returns HxWx4 uint8 (origin upper-left, like the
+        reference's flipped GL readback, ``offscreen.py:95-96``)."""
+        h, w = self.shape
+        self._color[:] = self.background
+        self._depth[:] = np.inf
+        triangles = np.asarray(triangles, np.float64)
+        if triangles.size == 0:
+            return self._color.copy()
+        colors = np.asarray(colors)
+        if colors.shape[1] == 3:
+            colors = np.concatenate(
+                [colors, np.full((len(colors), 1), 255, colors.dtype)], axis=1
+            )
+
+        flat = triangles.reshape(-1, 3)
+        px, depth = camera.world_to_pixel(
+            flat, origin="upper-left", return_depth=True
+        )
+        px = px.reshape(-1, 3, 2)
+        depth = depth.reshape(-1, 3)
+
+        # Flat shading from world-space normals.
+        e1 = triangles[:, 1] - triangles[:, 0]
+        e2 = triangles[:, 2] - triangles[:, 0]
+        n = np.cross(e1, e2)
+        nn = np.linalg.norm(n, axis=1, keepdims=True)
+        n = np.divide(n, nn, out=np.zeros_like(n), where=nn > 1e-12)
+        shade = 0.35 + 0.65 * np.abs(n @ self._light)
+
+        for i in range(len(triangles)):
+            if np.any(depth[i] <= camera.clip_near):
+                continue  # behind/too close: skip (no near-plane clipping)
+            self._fill(px[i], depth[i], colors[i], shade[i])
+        return self._color.copy()
+
+    def _fill(self, tri_px, tri_depth, color, shade):
+        h, w = self.shape
+        xmin = max(int(np.floor(tri_px[:, 0].min())), 0)
+        xmax = min(int(np.ceil(tri_px[:, 0].max())) + 1, w)
+        ymin = max(int(np.floor(tri_px[:, 1].min())), 0)
+        ymax = min(int(np.ceil(tri_px[:, 1].max())) + 1, h)
+        if xmin >= xmax or ymin >= ymax:
+            return
+        xs = np.arange(xmin, xmax) + 0.5
+        ys = np.arange(ymin, ymax) + 0.5
+        gx, gy = np.meshgrid(xs, ys)
+        (x0, y0), (x1, y1), (x2, y2) = tri_px
+        area = (x1 - x0) * (y2 - y0) - (x2 - x0) * (y1 - y0)
+        if abs(area) < 1e-12:
+            return
+        w0 = ((x1 - gx) * (y2 - gy) - (x2 - gx) * (y1 - gy)) / area
+        w1 = ((x2 - gx) * (y0 - gy) - (x0 - gx) * (y2 - gy)) / area
+        w2 = 1.0 - w0 - w1
+        inside = (w0 >= 0) & (w1 >= 0) & (w2 >= 0)
+        if not inside.any():
+            return
+        # Screen-space affine depth interpolation (adequate for annotation
+        # ground truth; not perspective-correct).
+        z = w0 * tri_depth[0] + w1 * tri_depth[1] + w2 * tri_depth[2]
+        zbuf = self._depth[ymin:ymax, xmin:xmax]
+        cbuf = self._color[ymin:ymax, xmin:xmax]
+        closer = inside & (z < zbuf)
+        if not closer.any():
+            return
+        zbuf[closer] = z[closer]
+        shaded = np.array(
+            [*(np.asarray(color[:3], np.float64) * shade), color[3]]
+        ).astype(np.uint8)
+        cbuf[closer] = shaded
+
+
+# ---------------------------------------------------------------------------
+# Scenes
+# ---------------------------------------------------------------------------
+
+
+class SimScene:
+    """Base: a camera, a rasterizer, and per-frame state."""
+
+    def __init__(self, shape=(480, 640), seed: int = 0, camera: Camera = None):
+        self.rng = np.random.default_rng(seed)
+        self.camera = camera or Camera.look_at(
+            eye=(6.0, -6.0, 4.0), target=(0, 0, 0), shape=shape
+        )
+        self.raster = Rasterizer(shape=shape)
+        self.reset()
+
+    def reset(self) -> None:  # rewind hook (AnimationController/Engine)
+        pass
+
+    def step(self, frame: int) -> None:
+        """Advance physics/randomization to ``frame``."""
+        raise NotImplementedError
+
+    def render(self) -> np.ndarray:
+        raise NotImplementedError
+
+
+class CubeScene(SimScene):
+    """The benchmark scene: a unit cube, randomly rotated each frame.
+
+    Mirrors ``examples/datagen/cube.blend.py:6-39`` (randomize rotation in
+    ``pre_frame``, publish image + projected corner coords in
+    ``post_frame``).
+    """
+
+    def __init__(self, shape=(480, 640), seed: int = 0, half_extent=1.0):
+        self.half_extent = half_extent
+        self.rotation = np.eye(3)
+        self.color = np.array([200, 80, 40], np.uint8)
+        super().__init__(shape=shape, seed=seed)
+
+    def reset(self) -> None:
+        self.rotation = np.eye(3)
+
+    def step(self, frame: int) -> None:
+        self.rotation = rotation_xyz(*self.rng.uniform(0, 2 * np.pi, size=3))
+        self.color = self.rng.integers(40, 255, size=3).astype(np.uint8)
+
+    def corners_world(self) -> np.ndarray:
+        from blendjax.producer.utils import cube_vertices
+
+        return cube_vertices((0, 0, 0), self.half_extent) @ self.rotation.T
+
+    def render(self) -> np.ndarray:
+        tris, faces = cube_triangles((0, 0, 0), self.half_extent, self.rotation)
+        base = self.color.astype(np.float64)
+        # slight per-face tint so orientation is visually distinct
+        tint = 1.0 - 0.08 * (faces % 3)
+        colors = np.clip(base[None, :] * tint[:, None], 0, 255).astype(np.uint8)
+        return self.raster.render(self.camera, tris, colors)
+
+    def observation(self, frame: int) -> dict:
+        img = self.render()
+        xy = self.camera.world_to_pixel(self.corners_world())
+        return {"image": img, "xy": xy.astype(np.float32), "frameid": frame}
+
+
+class FallingCubesScene(SimScene):
+    """N cubes under gravity with ground bounce
+    (``examples/datagen/falling_cubes.blend.py``)."""
+
+    def __init__(self, shape=(480, 640), seed: int = 0, num_cubes: int = 8):
+        self.num_cubes = num_cubes
+        super().__init__(shape=shape, seed=seed)
+
+    def reset(self) -> None:
+        n = self.num_cubes
+        self.pos = np.stack(
+            [
+                self.rng.uniform(-3, 3, n),
+                self.rng.uniform(-3, 3, n),
+                self.rng.uniform(4, 9, n),
+            ],
+            axis=1,
+        )
+        self.vel = np.zeros((n, 3))
+        self.rot = self.rng.uniform(0, 2 * np.pi, (n, 3))
+        self.rotvel = self.rng.uniform(-2, 2, (n, 3))
+        self.colors = self.rng.integers(40, 255, (n, 3)).astype(np.uint8)
+        self.half = 0.5
+
+    def step(self, frame: int, dt: float = 1 / 25) -> None:
+        g = np.array([0, 0, -9.81])
+        self.vel += g * dt
+        self.pos += self.vel * dt
+        self.rot += self.rotvel * dt
+        low = self.pos[:, 2] < self.half
+        self.pos[low, 2] = self.half
+        self.vel[low, 2] *= -0.5  # inelastic bounce
+
+    def render(self) -> np.ndarray:
+        all_tris, all_cols = [], []
+        for i in range(self.num_cubes):
+            tris, faces = cube_triangles(
+                self.pos[i], self.half, rotation_xyz(*self.rot[i])
+            )
+            all_tris.append(tris)
+            all_cols.append(np.repeat(self.colors[i][None], 12, axis=0))
+        return self.raster.render(
+            self.camera, np.concatenate(all_tris), np.concatenate(all_cols)
+        )
+
+    def observation(self, frame: int) -> dict:
+        return {
+            "image": self.render(),
+            "xy": self.camera.world_to_pixel(self.pos).astype(np.float32),
+            "frameid": frame,
+        }
+
+
+def supershape_radius(theta, m, n1, n2, n3, a=1.0, b=1.0):
+    """Superformula (Gielis). Matches the reference's dependency
+    ('supershape' pkg, ``examples/densityopt/supershape.blend.py``)."""
+    t = np.abs(np.cos(m * theta / 4.0) / a) ** n2 + np.abs(
+        np.sin(m * theta / 4.0) / b
+    ) ** n3
+    return t ** (-1.0 / n1)
+
+
+class SupershapeScene(SimScene):
+    """2D supershape silhouette; parameters are set over the duplex channel
+    (``examples/densityopt``: TPU process optimizes sim params)."""
+
+    def __init__(self, shape=(256, 256), seed: int = 0, segments: int = 72):
+        self.segments = segments
+        self.params = np.array([6.0, 1.0, 1.0, 1.0])  # m, n1, n2, n3
+        self.shape_id = -1
+        cam = Camera.look_at(
+            eye=(0, 0, 8.0), target=(0, 0, 0), up=(0, 1, 0), shape=shape
+        )
+        super().__init__(shape=shape, seed=seed, camera=cam)
+
+    def set_params(self, params, shape_id: int) -> None:
+        self.params = np.asarray(params, np.float64)
+        self.shape_id = int(shape_id)
+
+    def step(self, frame: int) -> None:
+        pass  # shape changes only via set_params
+
+    def render(self) -> np.ndarray:
+        theta = np.linspace(0, 2 * np.pi, self.segments, endpoint=False)
+        r = supershape_radius(theta, *self.params)
+        r = np.nan_to_num(r, nan=0.0, posinf=0.0) * 2.0
+        pts = np.stack([r * np.cos(theta), r * np.sin(theta), np.zeros_like(r)], 1)
+        center = np.zeros(3)
+        tris = np.stack(
+            [
+                np.broadcast_to(center, (self.segments, 3)),
+                pts,
+                np.roll(pts, -1, axis=0),
+            ],
+            axis=1,
+        )
+        colors = np.repeat(
+            np.array([[230, 230, 230]], np.uint8), self.segments, axis=0
+        )
+        return self.raster.render(self.camera, tris, colors)
+
+    def observation(self, frame: int) -> dict:
+        return {
+            "image": self.render(),
+            "shape_id": self.shape_id,
+            "frameid": frame,
+        }
+
+
+class CartpoleScene(SimScene):
+    """Cart-pole on a rail with a velocity-controlled motor
+    (``examples/control/cartpole.blend.py:38-43`` constrains the cart with
+    a motor whose target velocity is the action)."""
+
+    GRAVITY = 9.81
+    MASS_CART = 1.0
+    MASS_POLE = 0.1
+    POLE_LEN = 1.0  # half-length
+    DT = 1 / 60
+
+    def __init__(self, shape=(240, 320), seed: int = 0):
+        cam = Camera.look_at(
+            eye=(0, -8.0, 1.0), target=(0, 0, 1.0), shape=shape
+        )
+        super().__init__(shape=shape, seed=seed, camera=cam)
+
+    def reset(self) -> None:
+        # x, x_dot, theta (rad from upright), theta_dot
+        self.state = self.rng.uniform(-0.05, 0.05, size=4)
+        self.motor_velocity = 0.0
+
+    def apply_motor(self, velocity: float) -> None:
+        self.motor_velocity = float(np.clip(velocity, -5.0, 5.0))
+
+    def step(self, frame: int) -> None:
+        x, x_dot, th, th_dot = self.state
+        # Velocity-servo cart (strong motor): cart accelerates toward the
+        # commanded velocity; pole swings from cart acceleration + gravity.
+        x_acc = 20.0 * (self.motor_velocity - x_dot)
+        th_acc = (
+            self.GRAVITY * np.sin(th) - x_acc * np.cos(th)
+        ) / self.POLE_LEN
+        dt = self.DT
+        x_dot += x_acc * dt
+        x += x_dot * dt
+        th_dot += th_acc * dt
+        th += th_dot * dt
+        self.state = np.array([x, x_dot, th, th_dot])
+
+    def observation_vector(self) -> np.ndarray:
+        return self.state.astype(np.float32)
+
+    def render(self) -> np.ndarray:
+        x, _, th, _ = self.state
+        cart_c = np.array([x, 0.0, 0.5])
+        cart_tris, _ = cube_triangles(cart_c, 0.3)
+        tip = cart_c + np.array([np.sin(th), 0.0, np.cos(th)]) * (
+            2 * self.POLE_LEN
+        )
+        mid = (cart_c + tip) / 2
+        d = tip - cart_c
+        zaxis = d / (np.linalg.norm(d) + 1e-9)
+        xaxis = np.cross([0, 1, 0], zaxis)
+        xaxis /= np.linalg.norm(xaxis) + 1e-9
+        yaxis = np.cross(zaxis, xaxis)
+        rot = np.stack([xaxis, yaxis, zaxis], axis=1)
+        pole_tris, _ = cube_triangles((0, 0, 0), 1.0, rotation=None)
+        scale = np.diag([0.05, 0.05, np.linalg.norm(d) / 2])
+        pole_tris = pole_tris @ (rot @ scale).T + mid
+        tris = np.concatenate([cart_tris, pole_tris])
+        colors = np.concatenate(
+            [
+                np.repeat(np.array([[80, 80, 220]], np.uint8), 12, axis=0),
+                np.repeat(np.array([[220, 180, 40]], np.uint8), 12, axis=0),
+            ]
+        )
+        return self.raster.render(self.camera, tris, colors)
+
+
+# ---------------------------------------------------------------------------
+# Engine adapter
+# ---------------------------------------------------------------------------
+
+
+class SimEngine(Engine):
+    """Drive a :class:`SimScene` from an AnimationController (the headless
+    counterpart of Blender's frame clock)."""
+
+    def __init__(self, scene: SimScene):
+        self.scene = scene
+
+    def frame_set(self, frame: int) -> None:
+        self.scene.step(frame)
+
+    def reset(self) -> None:
+        self.scene.reset()

@@ -53,7 +53,43 @@ def _as_frames(raw) -> list:
     return raw if isinstance(raw, list) else [raw]
 
 
-class DataPublisherSocket:
+
+class _Channel:
+    """Shared socket plumbing: context-managed close + poll/recv/decode."""
+
+    sock: zmq.Socket
+    allow_pickle: bool = True
+
+    def _register_poller(self) -> None:
+        self.poller = zmq.Poller()
+        self.poller.register(self.sock, zmq.POLLIN)
+
+    def _poll_recv(self, timeoutms: int, copy_arrays: bool):
+        """Receive+decode one message within ``timeoutms``; returns
+        ``(message, raw_buffers)`` or ``None`` on timeout."""
+        socks = dict(self.poller.poll(timeoutms))
+        if self.sock not in socks:
+            return None
+        frames = _as_frames(self.sock.recv_multipart(copy=False))
+        buffers = [f.buffer for f in frames]
+        return (
+            decode_message(
+                buffers, copy_arrays=copy_arrays, allow_pickle=self.allow_pickle
+            ),
+            buffers,
+        )
+
+    def close(self):
+        self.sock.close(0)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+class DataPublisherSocket(_Channel):
     """Producer end of the data stream: PUSH, bind side.
 
     Reference: ``pkg_blender/blendtorch/btb/publisher.py:4-43``. The small
@@ -96,17 +132,9 @@ class DataPublisherSocket:
             encode_message(data, codec=self.codec), copy=self.copy
         )
 
-    def close(self):
-        self.sock.close(0)
-
-    def __enter__(self):
-        return self
-
-    def __exit__(self, *exc):
-        self.close()
 
 
-class DataReceiverSocket:
+class DataReceiverSocket(_Channel):
     """Consumer end: PULL, connects to *all* producer addresses.
 
     Reference: ``pkg_pytorch/blendtorch/btt/dataset.py:68-111``. Fair-queued
@@ -133,36 +161,20 @@ class DataReceiverSocket:
         self.sock.setsockopt(zmq.LINGER, 0)
         for addr in self.addresses:
             self.sock.connect(addr)
-        self.poller = zmq.Poller()
-        self.poller.register(self.sock, zmq.POLLIN)
+        self._register_poller()
 
     def recv(self, timeoutms: int | None = None, copy_arrays: bool = False):
         t = self.timeoutms if timeoutms is None else timeoutms
-        socks = dict(self.poller.poll(t))
-        if self.sock not in socks:
+        out = self._poll_recv(t, copy_arrays)
+        if out is None:
             raise ReceiveTimeoutError(
                 f"no message within {t} ms from {self.addresses}"
             )
-        frames = _as_frames(self.sock.recv_multipart(copy=False))
-        buffers = [f.buffer for f in frames]
-        return (
-            decode_message(
-                buffers, copy_arrays=copy_arrays, allow_pickle=self.allow_pickle
-            ),
-            buffers,
-        )
-
-    def close(self):
-        self.sock.close(0)
-
-    def __enter__(self):
-        return self
-
-    def __exit__(self, *exc):
-        self.close()
+        return out
 
 
-class PairChannel:
+
+class PairChannel(_Channel):
     """Duplex control channel (PAIR<->PAIR), producer binds / consumer connects.
 
     Reference: ``btt/duplex.py:8-67`` and ``btb/duplex.py:8-66``. ``send``
@@ -196,8 +208,7 @@ class PairChannel:
         else:
             self.sock.connect(addr)
             self.addr = addr
-        self.poller = zmq.Poller()
-        self.poller.register(self.sock, zmq.POLLIN)
+        self._register_poller()
 
     def send(self, **kwargs) -> bytes:
         """Send a message; returns the generated ``btmid`` message id.
@@ -213,27 +224,12 @@ class PairChannel:
     def recv(self, timeoutms: int | None = None):
         """Receive one message or ``None`` if nothing arrives in time."""
         t = self.default_timeoutms if timeoutms is None else timeoutms
-        socks = dict(self.poller.poll(t))
-        if self.sock not in socks:
-            return None
-        frames = _as_frames(self.sock.recv_multipart(copy=False))
-        return decode_message(
-            [f.buffer for f in frames],
-            copy_arrays=True,
-            allow_pickle=self.allow_pickle,
-        )
-
-    def close(self):
-        self.sock.close(0)
-
-    def __enter__(self):
-        return self
-
-    def __exit__(self, *exc):
-        self.close()
+        out = self._poll_recv(t, copy_arrays=True)
+        return None if out is None else out[0]
 
 
-class RpcClient:
+
+class RpcClient(_Channel):
     """Blocking request/reply client (REQ with RELAXED+CORRELATE).
 
     Reference: ``btt/env.py:36-42,111-124``. RELAXED+CORRELATE let the REQ
@@ -269,17 +265,9 @@ class RpcClient:
             allow_pickle=self.allow_pickle,
         )
 
-    def close(self):
-        self.sock.close(0)
-
-    def __enter__(self):
-        return self
-
-    def __exit__(self, *exc):
-        self.close()
 
 
-class RpcServer:
+class RpcServer(_Channel):
     """Reply side of the RPC pattern (REP, bind).
 
     Reference: ``btb/env.py:212-216``. ``recv``/``reply`` are split so the
@@ -298,30 +286,14 @@ class RpcServer:
         self.sock.setsockopt(zmq.LINGER, 0)
         self.sock.bind(bind_addr)
         self.addr = self.sock.getsockopt_string(zmq.LAST_ENDPOINT)
-        self.poller = zmq.Poller()
-        self.poller.register(self.sock, zmq.POLLIN)
+        self._register_poller()
 
     def recv(self, timeoutms: int | None = None):
         """Receive one request, or ``None`` on timeout (``timeoutms=0`` polls)."""
         t = self.default_timeoutms if timeoutms is None else timeoutms
-        socks = dict(self.poller.poll(t))
-        if self.sock not in socks:
-            return None
-        frames = _as_frames(self.sock.recv_multipart(copy=False))
-        return decode_message(
-            [f.buffer for f in frames],
-            copy_arrays=True,
-            allow_pickle=self.allow_pickle,
-        )
+        out = self._poll_recv(t, copy_arrays=True)
+        return None if out is None else out[0]
 
     def reply(self, **kwargs):
         self.sock.send_multipart(encode_message(kwargs, codec=self.codec), copy=True)
 
-    def close(self):
-        self.sock.close(0)
-
-    def __enter__(self):
-        return self
-
-    def __exit__(self, *exc):
-        self.close()

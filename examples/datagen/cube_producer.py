@@ -1,0 +1,48 @@
+"""Producer script: streams rotating-cube images + corner annotations.
+
+The headless counterpart of the reference's ``examples/datagen/
+cube.blend.py:6-39`` (randomize in pre_frame, publish in post_frame) and
+the producer used by ``bench.py``. Launch it with
+:class:`blendjax.launcher.PythonProducerLauncher`; it reads the handshake
+(btid/seed/sockets) exactly like a Blender scene script would.
+
+Usage flags (passed via ``instance_args``):
+  --shape H W      image size (default 480 640)
+  --frames N       stop after N frames (default: run forever)
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from blendjax.producer import AnimationController, DataPublisher, parse_launch_args
+from blendjax.producer.sim import CubeScene, SimEngine
+
+
+def main() -> None:
+    args, remainder = parse_launch_args(sys.argv)
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--shape", nargs=2, type=int, default=[480, 640])
+    parser.add_argument("--frames", type=int, default=-1)
+    opts = parser.parse_args(remainder)
+
+    pub = DataPublisher(args.btsockets["DATA"], btid=args.btid, lingerms=2000)
+    scene = CubeScene(shape=tuple(opts.shape), seed=args.btseed)
+    ctrl = AnimationController(SimEngine(scene))
+
+    def publish(frame: int) -> None:
+        pub.publish(**scene.observation(frame))
+        if 0 < opts.frames <= frame:
+            ctrl.cancel()
+
+    ctrl.post_frame.add(publish)
+    end = opts.frames if opts.frames > 0 else 2_147_483_647
+    try:
+        ctrl.play(frame_range=(1, end), num_episodes=-1)
+    finally:
+        pub.close()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,85 @@
+"""Animation lifecycle ordering — mirrors the reference's canonical
+assertion (``tests/test_animation.py:7-26``): two episodes of frames 1..3
+produce pre_play -> [pre_animation -> (pre_frame post_frame)x3 ->
+post_animation]x2 -> post_play."""
+
+from blendjax.producer.animation import AnimationController, Engine
+from blendjax.producer.signal import Signal
+
+
+class RecordingEngine(Engine):
+    def __init__(self, log):
+        self.log = log
+
+    def frame_set(self, frame):
+        self.log.append(("sim", frame))
+
+    def reset(self):
+        self.log.append(("reset",))
+
+
+def _wire(ctrl, log):
+    ctrl.pre_play.add(lambda: log.append(("pre_play",)))
+    ctrl.pre_animation.add(lambda: log.append(("pre_anim",)))
+    ctrl.pre_frame.add(lambda f: log.append(("pre", f)))
+    ctrl.post_frame.add(lambda f: log.append(("post", f)))
+    ctrl.post_animation.add(lambda: log.append(("post_anim",)))
+    ctrl.post_play.add(lambda: log.append(("post_play",)))
+
+
+def test_lifecycle_two_episodes():
+    log = []
+    ctrl = AnimationController(RecordingEngine(log))
+    _wire(ctrl, log)
+    ctrl.play(frame_range=(1, 3), num_episodes=2)
+
+    episode = [("reset",), ("pre_anim",)]
+    for f in (1, 2, 3):
+        episode += [("pre", f), ("sim", f), ("post", f)]
+    episode += [("post_anim",)]
+    assert log == [("pre_play",)] + episode * 2 + [("post_play",)]
+    assert ctrl.episode == 2 and not ctrl.playing
+
+
+def test_rewind_restarts_episode_with_pre_animation():
+    log = []
+    ctrl = AnimationController(RecordingEngine(log))
+    _wire(ctrl, log)
+    fired = []
+
+    def maybe_rewind(f):
+        if f == 2 and not fired:
+            fired.append(True)
+            ctrl.rewind()
+
+    ctrl.post_frame.add(maybe_rewind)
+    ctrl.play(frame_range=(1, 3), num_episodes=1)
+
+    frames = [e[1] for e in log if e[0] == "pre"]
+    assert frames == [1, 2, 1, 2, 3]
+    # rewind re-fires pre_animation (env reset hook) but keeps one episode
+    assert sum(1 for e in log if e == ("pre_anim",)) == 2
+    assert sum(1 for e in log if e == ("post_anim",)) == 1
+    assert ctrl.episode == 1
+
+
+def test_cancel_stops_midway():
+    log = []
+    ctrl = AnimationController(RecordingEngine(log))
+    _wire(ctrl, log)
+    ctrl.post_frame.add(lambda f: ctrl.cancel() if f == 2 else None)
+    ctrl.play(frame_range=(1, 100), num_episodes=-1)
+    frames = [e[1] for e in log if e[0] == "pre"]
+    assert frames == [1, 2]
+    assert log[-1] == ("post_play",)
+
+
+def test_signal_partial_binding_and_remove():
+    s = Signal()
+    got = []
+    h = s.add(lambda tag, x: got.append((tag, x)), "bound")
+    s.invoke(42)
+    assert got == [("bound", 42)]
+    s.remove(h)
+    s.invoke(43)
+    assert got == [("bound", 42)]

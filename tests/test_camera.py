@@ -1,0 +1,93 @@
+"""Camera projection math against analytic ground truth (replaces the
+reference's ``tests/test_camera.py`` + ``cam.blend`` fixture: same
+assertions — pixel coords and depths for ortho and perspective cameras —
+without needing a Blender scene)."""
+
+import numpy as np
+
+from blendjax.producer.camera import Camera
+from blendjax.producer.utils import dehom, hom, look_at_matrix, random_spherical_loc
+
+
+def test_ortho_projection_ground_truth():
+    cam = Camera(
+        position=(0, 0, 10),
+        rotation=np.eye(3),  # looks down -Z
+        shape=(100, 100),
+        ortho_scale=4.0,
+    )
+    px, depth = cam.world_to_pixel(
+        [[0, 0, 0], [1, 0, 0], [0, 1, 0], [-1, -1, 0]], return_depth=True
+    )
+    np.testing.assert_allclose(px[0], [50, 50], atol=1e-6)
+    np.testing.assert_allclose(px[1], [75, 50], atol=1e-6)
+    np.testing.assert_allclose(px[2], [50, 25], atol=1e-6)  # +y is up
+    np.testing.assert_allclose(px[3], [25, 75], atol=1e-6)
+    np.testing.assert_allclose(depth, [10, 10, 10, 10], atol=1e-9)
+
+
+def test_perspective_projection_ground_truth():
+    f, s = 50.0, 36.0
+    cam = Camera(
+        position=(0, 0, 5), shape=(100, 100), focal_mm=f, sensor_mm=s
+    )
+    px, depth = cam.world_to_pixel(
+        [[0, 0, 0], [1, 0, 0]], return_depth=True
+    )
+    np.testing.assert_allclose(px[0], [50, 50], atol=1e-6)
+    ndc_x = (2 * f / s * 1.0) / 5.0
+    np.testing.assert_allclose(px[1, 0], (ndc_x + 1) * 0.5 * 100, atol=1e-6)
+    np.testing.assert_allclose(depth, [5, 5], atol=1e-9)
+    # farther object projects closer to the image center
+    px2 = cam.world_to_pixel([[1, 0, -5]])
+    assert abs(px2[0, 0] - 50) < abs(px[1, 0] - 50)
+
+
+def test_lower_left_origin():
+    cam = Camera(position=(0, 0, 10), shape=(100, 200), ortho_scale=4.0)
+    up_world = [[0, 0.5, 0]]
+    ul = cam.world_to_pixel(up_world, origin="upper-left")
+    ll = cam.world_to_pixel(up_world, origin="lower-left")
+    np.testing.assert_allclose(ul[0, 1] + ll[0, 1], 100, atol=1e-6)
+    assert ll[0, 1] > 50  # up is larger y in lower-left origin
+
+
+def test_look_at_points_camera_at_target():
+    eye = np.array([4.0, -7.0, 3.0])
+    cam = Camera.look_at(eye=eye, target=(0, 0, 0), shape=(200, 300))
+    px, depth = cam.world_to_pixel([[0, 0, 0]], return_depth=True)
+    np.testing.assert_allclose(px[0], [150, 100], atol=1e-6)
+    np.testing.assert_allclose(depth[0], np.linalg.norm(eye), atol=1e-9)
+    # rotation is orthonormal
+    r = cam.rotation
+    np.testing.assert_allclose(r @ r.T, np.eye(3), atol=1e-12)
+
+
+def test_bbox_world_to_pixel():
+    cam = Camera(position=(0, 0, 10), shape=(100, 100), ortho_scale=4.0)
+    pts = [[-1, -1, 0], [1, 1, 0], [0, 0, 0]]
+    bbox = cam.bbox_world_to_pixel(pts)
+    np.testing.assert_allclose(bbox, [25, 25, 75, 75], atol=1e-6)
+
+
+def test_hom_dehom_roundtrip():
+    x = np.random.default_rng(0).normal(size=(7, 3))
+    np.testing.assert_allclose(dehom(hom(x)), x, atol=1e-12)
+
+
+def test_random_spherical_loc_in_shell():
+    rng = np.random.default_rng(1)
+    center = np.array([1.0, 2.0, 3.0])
+    for _ in range(50):
+        p = random_spherical_loc(
+            radius_range=(2, 3), center=center, rng=rng
+        )
+        r = np.linalg.norm(p - center)
+        assert 2 - 1e-9 <= r <= 3 + 1e-9
+
+
+def test_look_at_degenerate_up():
+    # looking straight down the up vector must not produce NaNs
+    m = look_at_matrix((0, 0, 5), (0, 0, 0), up=(0, 0, 1))
+    assert np.isfinite(m).all()
+    np.testing.assert_allclose(m @ m.T, np.eye(3), atol=1e-9)
