@@ -1,0 +1,35 @@
+"""Consumer ingest pipeline: socket stream -> host batches -> sharded
+device arrays.
+
+Reference counterpart: ``pkg_pytorch/blendtorch/btt/dataset.py`` +
+``file.py`` (IterableDataset + pickle record/replay). The blendjax design
+is device-centric instead of DataLoader-centric (SURVEY.md §7):
+
+  wire frames -> zero-copy decode -> preallocated host batch buffers
+  -> ``jax.device_put`` onto a (possibly multi-host) mesh, double-buffered
+  -> jit train step
+
+Stages are exposed separately (``RemoteStream`` -> ``BatchAssembler`` ->
+``HostIngest`` -> ``DeviceFeeder``) so tests, benchmarks, and record/replay
+attach at the same boundaries the reference used (the raw-bytes tee sits
+between receive and decode, ``dataset.py:100-103``).
+"""
+
+from blendjax.data.replay import FileDataset, FileReader, FileRecorder, SingleFileDataset
+from blendjax.data.schema import StreamSchema
+from blendjax.data.stream import RemoteStream
+from blendjax.data.batcher import BatchAssembler, HostIngest
+from blendjax.data.pipeline import DeviceFeeder, StreamDataPipeline
+
+__all__ = [
+    "StreamSchema",
+    "RemoteStream",
+    "BatchAssembler",
+    "HostIngest",
+    "DeviceFeeder",
+    "StreamDataPipeline",
+    "FileRecorder",
+    "FileReader",
+    "FileDataset",
+    "SingleFileDataset",
+]

@@ -1,0 +1,172 @@
+"""Host-side batch assembly.
+
+Replaces torch's default collate (the reference lets ``DataLoader``
+stack pickled dicts, SURVEY.md §3.1 "the collate in torch ... are the CPU
+costs the TPU build must attack"): items are written directly into
+preallocated, recycled batch buffers — one memcpy per field per item, no
+per-item allocations in steady state — on a background thread that
+overlaps socket receive/decode with device compute.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+
+import numpy as np
+
+from blendjax.data.schema import StreamSchema
+from blendjax.utils.logging import get_logger
+
+logger = get_logger("data")
+
+
+class BatchAssembler:
+    """Packs per-item dicts into preallocated batch dicts.
+
+    A pool of ``num_buffers`` batch sets is cycled so a completed batch
+    stays valid while downstream transfers it (double buffering; size the
+    pool >= prefetch depth + 1).
+    """
+
+    def __init__(self, schema: StreamSchema, batch_size: int, num_buffers: int = 3):
+        self.schema = schema
+        self.batch_size = int(batch_size)
+        self._pool = [
+            {
+                k: np.empty((self.batch_size, *spec.shape), spec.dtype)
+                for k, spec in schema.fields.items()
+            }
+            for _ in range(num_buffers)
+        ]
+        self._meta: list = []
+        self._cursor = 0
+        self._active = 0
+
+    def add(self, item: dict):
+        """Add one item; returns a completed batch dict (with ``_meta``
+        list of per-item metadata) when full, else None."""
+        buf = self._pool[self._active]
+        i = self._cursor
+        for k in self.schema.fields:
+            buf[k][i] = item[k]
+        self._meta.append({k: item[k] for k in self.schema.meta_keys if k in item})
+        self._cursor += 1
+        if self._cursor < self.batch_size:
+            return None
+        batch = dict(buf)
+        batch["_meta"] = self._meta
+        self._meta = []
+        self._cursor = 0
+        self._active = (self._active + 1) % len(self._pool)
+        return batch
+
+
+class HostIngest:
+    """Background thread: stream -> validate -> assemble -> bounded queue.
+
+    The queue bound (``prefetch``) plus the socket HWM is the end-to-end
+    backpressure chain: when training stalls, the queue fills, receives
+    stop, the producers' PUSH sockets block (reference behavior,
+    ``examples/datagen/Readme.md:168-175``).
+    """
+
+    _DONE = object()
+
+    def __init__(
+        self,
+        stream,
+        batch_size: int,
+        schema: StreamSchema | None = None,
+        prefetch: int = 2,
+        validate_every: int = 1,
+    ):
+        self.stream = stream
+        self.batch_size = batch_size
+        self.schema = schema
+        self.prefetch = prefetch
+        self.validate_every = max(1, int(validate_every))
+        self._queue: queue.Queue = queue.Queue(maxsize=prefetch)
+        self._error: BaseException | None = None
+        self._thread: threading.Thread | None = None
+        self._stop = threading.Event()
+        self.batches_out = 0
+        self.items_in = 0
+
+    # -- thread body --------------------------------------------------------
+
+    def _run(self):
+        try:
+            assembler = None
+            for item in self.stream:
+                if self._stop.is_set():
+                    break
+                if self.schema is None:
+                    self.schema = StreamSchema.infer(item)
+                    logger.info("inferred stream schema: %s", self.schema)
+                if assembler is None:
+                    assembler = BatchAssembler(
+                        self.schema, self.batch_size,
+                        num_buffers=self.prefetch + 1,
+                    )
+                if self.items_in % self.validate_every == 0:
+                    self.schema.validate(item)
+                self.items_in += 1
+                batch = assembler.add(item)
+                if batch is not None:
+                    while not self._stop.is_set():
+                        try:
+                            self._queue.put(batch, timeout=0.25)
+                            self.batches_out += 1
+                            break
+                        except queue.Full:
+                            continue
+        except BaseException as e:  # propagate into the consumer thread
+            self._error = e
+        finally:
+            try:
+                self._queue.put(self._DONE, timeout=5)
+            except queue.Full:
+                pass
+
+    # -- consumer side ------------------------------------------------------
+
+    def start(self) -> "HostIngest":
+        assert self._thread is None, "already started"
+        self._thread = threading.Thread(
+            target=self._run, name="blendjax-ingest", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def queue_depth(self) -> int:
+        """Current prefetch-queue occupancy (observability gauge)."""
+        return self._queue.qsize()
+
+    def __iter__(self):
+        if self._thread is None:
+            self.start()
+        while True:
+            batch = self._queue.get()
+            if batch is self._DONE:
+                if self._error is not None:
+                    raise self._error
+                return
+            yield batch
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            # Drain so the thread isn't stuck on a full queue.
+            try:
+                while True:
+                    self._queue.get_nowait()
+            except queue.Empty:
+                pass
+            self._thread.join(timeout=10)
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()

@@ -1,0 +1,137 @@
+"""Device feeding: host batches -> sharded global arrays, double-buffered.
+
+This is the layer with no reference counterpart (the reference hands numpy
+to torch and calls ``.cuda()`` implicitly in user code): host batches are
+placed onto the mesh with ``jax.device_put`` under a ``NamedSharding``
+along the ``data`` axis, and a prefetch ring keeps ``prefetch`` batches in
+flight so host->HBM transfer overlaps the previous step's compute
+(SURVEY.md §7 build step 3; BASELINE.json north star).
+
+Multi-host: each process feeds its local shard;
+``jax.make_array_from_process_local_data`` assembles the global array so a
+v4-32-style mesh sees one logical batch (SURVEY.md §2.4 implication (b)).
+"""
+
+from __future__ import annotations
+
+import collections
+
+from blendjax.utils.logging import get_logger
+
+logger = get_logger("data")
+
+
+def _require_jax():
+    import jax  # deferred: producer processes never import jax
+
+    return jax
+
+
+class DeviceFeeder:
+    """Transfers host batch dicts to device with a prefetch ring.
+
+    ``sharding`` may be:
+    - None: default device placement (single chip).
+    - a ``jax.sharding.Sharding``: applied to every tensor field.
+    - a dict ``key -> Sharding`` for per-field layouts.
+
+    ``_meta`` (per-item provenance like ``btid``) stays on host.
+    """
+
+    def __init__(self, sharding=None, prefetch: int = 2, multihost: bool = False):
+        self.sharding = sharding
+        self.prefetch = max(1, int(prefetch))
+        self.multihost = multihost
+
+    def _place(self, batch: dict) -> dict:
+        jax = _require_jax()
+        out = {}
+        for k, v in batch.items():
+            if k == "_meta":
+                out[k] = v
+                continue
+            s = (
+                self.sharding.get(k)
+                if isinstance(self.sharding, dict)
+                else self.sharding
+            )
+            if s is None:
+                out[k] = jax.device_put(v)
+            elif self.multihost:
+                out[k] = jax.make_array_from_process_local_data(s, v)
+            else:
+                out[k] = jax.device_put(v, s)
+        return out
+
+    def __call__(self, host_batches):
+        """Iterate device batches, keeping ``prefetch`` transfers in flight
+        ahead of the consumer (flax-style prefetch ring)."""
+        ring = collections.deque()
+        it = iter(host_batches)
+        try:
+            while True:
+                while len(ring) < self.prefetch:
+                    try:
+                        ring.append(self._place(next(it)))
+                    except StopIteration:
+                        while ring:
+                            yield ring.popleft()
+                        return
+                yield ring.popleft()
+        finally:
+            ring.clear()
+
+
+class StreamDataPipeline:
+    """End-to-end convenience: addresses -> device batches.
+
+    The blendjax answer to ``DataLoader(RemoteIterableDataset(...))``
+    (reference ``examples/datagen/minimal.py:16-22``): construct with the
+    producer addresses and iterate sharded device batches.
+    """
+
+    def __init__(
+        self,
+        addresses,
+        batch_size: int,
+        schema=None,
+        sharding=None,
+        prefetch: int = 2,
+        multihost: bool = False,
+        **stream_kwargs,
+    ):
+        from blendjax.data.stream import RemoteStream
+
+        self.stream = RemoteStream(addresses, **stream_kwargs)
+        self.ingest = None
+        self.batch_size = batch_size
+        self.schema = schema
+        self.prefetch = prefetch
+        self.feeder = DeviceFeeder(
+            sharding=sharding, prefetch=prefetch, multihost=multihost
+        )
+
+    def __iter__(self):
+        from blendjax.data.batcher import HostIngest
+
+        self.ingest = HostIngest(
+            self.stream,
+            batch_size=self.batch_size,
+            schema=self.schema,
+            prefetch=self.prefetch,
+        )
+        self.ingest.start()
+        return iter(self.feeder(self.ingest))
+
+    def queue_depth(self) -> int:
+        return 0 if self.ingest is None else self.ingest.queue_depth()
+
+    def stop(self):
+        if self.ingest is not None:
+            self.ingest.stop()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()

@@ -1,0 +1,229 @@
+"""Record/replay of data streams — the framework's checkpoint analog.
+
+Reference: ``pkg_pytorch/blendtorch/btt/file.py`` (``FileRecorder`` writes
+raw pickled messages behind a pre-allocated offset header rewritten on
+close, ``file.py:56-74``; ``FileReader`` loads the offset table and lazily
+opens per worker, ``file.py:102-132``) and the replay datasets in
+``dataset.py:119-153``.
+
+blendjax's container (``.bjr``) stores the *wire frames* verbatim — the
+same zero-copy multipart messages that crossed the socket — with an offset
+index appended as a footer, so recording is a pure append-only tee (no
+header rewrite, crash leaves a recoverable prefix) and replay decodes
+through the identical ``decode_message`` path as live ingest. Not pickle:
+recordings made from tensor-codec producers are safe to share
+(``allow_pickle=False`` replays them fully).
+
+Layout::
+
+    b"BJXR1\\n"                                  magic
+    repeat per message:
+        u32 nframes, then per frame: u64 size + bytes
+    footer: u64 offsets[n] ... u64 n, u64 footer_start, b"BJXRIDX"
+"""
+
+from __future__ import annotations
+
+import glob as globmod
+import os
+import struct
+
+from blendjax.transport.wire import decode_message
+
+MAGIC = b"BJXR1\n"
+FOOTER_MAGIC = b"BJXRIDX"
+
+
+class FileRecorder:
+    """Append-only recorder of raw wire frames.
+
+    Reference API kept: ``FileRecorder(outpath, max_messages)`` as a
+    context manager with ``save(...)`` per message (``file.py:10-79``);
+    ``filename(prefix, worker_index)`` builds per-worker paths.
+    """
+
+    def __init__(self, outpath: str = "blendjax.bjr", max_messages: int | None = None):
+        self.outpath = outpath
+        self.max_messages = max_messages
+        self.num_messages = 0
+        self._offsets: list[int] = []
+        self._file = None
+
+    @staticmethod
+    def filename(prefix: str, worker_index: int) -> str:
+        """``{prefix}_{worker:02d}.bjr`` (reference ``file.py:76-79``)."""
+        return f"{prefix}_{worker_index:02d}.bjr"
+
+    def __enter__(self):
+        os.makedirs(os.path.dirname(os.path.abspath(self.outpath)), exist_ok=True)
+        self._file = open(self.outpath, "wb")
+        self._file.write(MAGIC)
+        return self
+
+    def save(self, frames) -> bool:
+        """Record one message's raw frames; returns False once full."""
+        if self.max_messages is not None and self.num_messages >= self.max_messages:
+            return False
+        self._offsets.append(self._file.tell())
+        self._file.write(struct.pack("<I", len(frames)))
+        for f in frames:
+            b = bytes(f)
+            self._file.write(struct.pack("<Q", len(b)))
+            self._file.write(b)
+        self.num_messages += 1
+        return True
+
+    def __exit__(self, *exc):
+        footer_start = self._file.tell()
+        for off in self._offsets:
+            self._file.write(struct.pack("<Q", off))
+        self._file.write(struct.pack("<Q", len(self._offsets)))
+        self._file.write(struct.pack("<Q", footer_start))
+        self._file.write(FOOTER_MAGIC)
+        self._file.close()
+        self._file = None
+
+
+def _load_index(path: str) -> list[int]:
+    with open(path, "rb") as f:
+        if f.read(len(MAGIC)) != MAGIC:
+            raise ValueError(f"{path}: not a blendjax recording")
+        f.seek(-(len(FOOTER_MAGIC) + 16), os.SEEK_END)
+        tail = f.read()
+        if tail[16:] != FOOTER_MAGIC:
+            raise ValueError(
+                f"{path}: missing index footer (truncated recording? "
+                "use FileReader.recover to scan)"
+            )
+        n, footer_start = struct.unpack("<QQ", tail[:16])
+        f.seek(footer_start)
+        return list(struct.unpack(f"<{n}Q", f.read(8 * n)))
+
+
+class FileReader:
+    """Random-access reader over a recording.
+
+    Lazily opens the file handle on first read so instances can be shipped
+    to worker processes (the reference reopens per worker for
+    multiprocessing compatibility, ``file.py:102-108``).
+    """
+
+    def __init__(self, path: str, allow_pickle: bool = True):
+        self.path = path
+        self.allow_pickle = allow_pickle
+        self._offsets = _load_index(path)
+        self._file = None
+        self._pid = None
+
+    @staticmethod
+    def recover(path: str) -> list[int]:
+        """Scan a footer-less (crashed) recording and return the offsets of
+        complete messages."""
+        offsets = []
+        size = os.path.getsize(path)
+        with open(path, "rb") as f:
+            if f.read(len(MAGIC)) != MAGIC:
+                raise ValueError(f"{path}: not a blendjax recording")
+            pos = f.tell()
+            while pos + 4 <= size:
+                f.seek(pos)
+                (nframes,) = struct.unpack("<I", f.read(4))
+                p = pos + 4
+                ok = 0 < nframes < 1024
+                for _ in range(nframes if ok else 0):
+                    if p + 8 > size:
+                        ok = False
+                        break
+                    f.seek(p)
+                    (ln,) = struct.unpack("<Q", f.read(8))
+                    p += 8 + ln
+                    if p > size:
+                        ok = False
+                        break
+                if not ok:
+                    break
+                offsets.append(pos)
+                pos = p
+        return offsets
+
+    def __len__(self) -> int:
+        return len(self._offsets)
+
+    def _handle(self):
+        if self._file is None or self._pid != os.getpid():
+            self._file = open(self.path, "rb")
+            self._pid = os.getpid()
+        return self._file
+
+    def frames(self, idx: int) -> list[bytes]:
+        f = self._handle()
+        f.seek(self._offsets[idx])
+        (nframes,) = struct.unpack("<I", f.read(4))
+        out = []
+        for _ in range(nframes):
+            (ln,) = struct.unpack("<Q", f.read(8))
+            out.append(f.read(ln))
+        return out
+
+    def __getitem__(self, idx: int) -> dict:
+        return decode_message(
+            self.frames(idx), copy_arrays=True, allow_pickle=self.allow_pickle
+        )
+
+    def close(self):
+        if self._file is not None:
+            self._file.close()
+            self._file = None
+
+
+class SingleFileDataset:
+    """Map-style dataset over one recording (reference ``dataset.py:119-132``)."""
+
+    def __init__(self, path: str, item_transform=None, allow_pickle: bool = True):
+        self.reader = FileReader(path, allow_pickle=allow_pickle)
+        self.item_transform = item_transform or (lambda x: x)
+
+    def __len__(self):
+        return len(self.reader)
+
+    def __getitem__(self, idx):
+        return self.item_transform(self.reader[idx])
+
+
+class FileDataset:
+    """Concatenation of ``{prefix}_*.bjr`` recordings (reference glob +
+    concat, ``dataset.py:134-153``) — replay a multi-worker recording with
+    no producers running."""
+
+    def __init__(self, record_path_prefix: str, item_transform=None,
+                 allow_pickle: bool = True):
+        paths = sorted(globmod.glob(f"{record_path_prefix}_*.bjr"))
+        if not paths:
+            raise FileNotFoundError(
+                f"no recordings matching {record_path_prefix}_*.bjr"
+            )
+        self.readers = [FileReader(p, allow_pickle=allow_pickle) for p in paths]
+        self._cum = []
+        total = 0
+        for r in self.readers:
+            total += len(r)
+            self._cum.append(total)
+        self.item_transform = item_transform or (lambda x: x)
+
+    def __len__(self):
+        return self._cum[-1] if self._cum else 0
+
+    def __getitem__(self, idx):
+        if idx < 0:
+            idx += len(self)
+        if not 0 <= idx < len(self):
+            raise IndexError(idx)
+        import bisect
+
+        ri = bisect.bisect_right(self._cum, idx)
+        base = self._cum[ri - 1] if ri else 0
+        return self.item_transform(self.readers[ri][idx - base])
+
+    def __iter__(self):
+        for i in range(len(self)):
+            yield self[i]

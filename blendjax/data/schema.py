@@ -1,0 +1,90 @@
+"""Per-stream shape/dtype contracts.
+
+The reference ships arbitrary pickled dicts and lets torch's default
+collate figure batching out dynamically (``dataset.py:113-117``). XLA
+needs static shapes (SURVEY.md §7 "hard parts (b)"), so blendjax makes the
+contract explicit: a :class:`StreamSchema` declares, per key, the
+*per-item* shape and dtype. It can be written down or inferred from the
+first received item; every subsequent item is validated against it so a
+misbehaving producer fails loudly at ingest rather than as an XLA
+recompile storm.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+
+@dataclass(frozen=True)
+class FieldSpec:
+    shape: tuple
+    dtype: np.dtype
+
+    def __repr__(self):
+        return f"FieldSpec(shape={self.shape}, dtype={np.dtype(self.dtype).name})"
+
+
+class SchemaError(ValueError):
+    pass
+
+
+class StreamSchema:
+    """Mapping ``key -> FieldSpec`` for the tensor fields of a stream.
+
+    Non-tensor metadata keys (e.g. ``btid``) can be listed in ``meta_keys``:
+    they are carried per-batch as plain arrays/lists but excluded from
+    device placement.
+    """
+
+    def __init__(self, fields: dict, meta_keys=("btid",)):
+        self.fields = {
+            k: FieldSpec(tuple(v[0]), np.dtype(v[1]))
+            if not isinstance(v, FieldSpec)
+            else v
+            for k, v in fields.items()
+        }
+        self.meta_keys = tuple(meta_keys)
+
+    @classmethod
+    def infer(cls, item: dict, meta_keys=("btid",)) -> "StreamSchema":
+        """Infer the contract from one decoded item. Scalars become
+        0-d fields; non-numeric values are treated as metadata."""
+        fields = {}
+        meta = list(meta_keys)
+        for k, v in item.items():
+            if k in meta_keys:
+                continue
+            if isinstance(v, np.ndarray):
+                fields[k] = FieldSpec(v.shape, v.dtype)
+            elif isinstance(v, (bool, int, float, np.generic)):
+                fields[k] = FieldSpec((), np.asarray(v).dtype)
+            else:
+                meta.append(k)
+        return cls(fields, meta_keys=tuple(meta))
+
+    def validate(self, item: dict) -> None:
+        for k, spec in self.fields.items():
+            if k not in item:
+                raise SchemaError(f"item missing field {k!r}")
+            v = np.asarray(item[k])
+            if tuple(v.shape) != spec.shape:
+                raise SchemaError(
+                    f"field {k!r}: shape {v.shape} != schema {spec.shape}"
+                )
+            if v.dtype != spec.dtype:
+                raise SchemaError(
+                    f"field {k!r}: dtype {v.dtype} != schema {spec.dtype}"
+                )
+
+    def batch_shapes(self, batch_size: int) -> dict:
+        return {
+            k: (batch_size, *spec.shape) for k, spec in self.fields.items()
+        }
+
+    def keys(self):
+        return self.fields.keys()
+
+    def __repr__(self):
+        return f"StreamSchema({self.fields}, meta={self.meta_keys})"

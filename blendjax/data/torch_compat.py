@@ -1,0 +1,59 @@
+"""Drop-in torch adapter for migration from the reference.
+
+Gives blendtorch users the exact class shape they had —
+``btt.RemoteIterableDataset`` fed to ``torch.utils.data.DataLoader``
+(reference ``dataset.py:14-117``, ``examples/datagen/minimal.py``) — on
+top of blendjax's transport, including per-worker stream splitting via
+``get_worker_info()`` and recording. Import requires torch (optional
+dependency).
+"""
+
+from __future__ import annotations
+
+import torch.utils.data as tud
+
+from blendjax import constants
+from blendjax.data.stream import RemoteStream
+
+
+class RemoteIterableDataset(tud.IterableDataset):
+    def __init__(
+        self,
+        addresses,
+        queue_size: int = constants.DEFAULT_QUEUE_SIZE,
+        timeoutms: int = constants.DEFAULT_TIMEOUTMS,
+        max_items: int | None = None,
+        item_transform=None,
+        record_path_prefix: str | None = None,
+    ):
+        self.addresses = addresses
+        self.queue_size = queue_size
+        self.timeoutms = timeoutms
+        self.max_items = max_items
+        self.item_transform = item_transform
+        self.record_path_prefix = record_path_prefix
+
+    def enable_recording(self, prefix: str):
+        """(reference ``dataset.py:53-58``)"""
+        self.record_path_prefix = prefix
+
+    def stream_length(self, max_items: int):
+        """(reference ``dataset.py:60-63``)"""
+        self.max_items = max_items
+
+    def __iter__(self):
+        info = tud.get_worker_info()
+        worker_index = info.id if info is not None else 0
+        num_workers = info.num_workers if info is not None else 1
+        stream = RemoteStream(
+            self.addresses,
+            queue_size=self.queue_size,
+            timeoutms=self.timeoutms,
+            max_items=self.max_items,
+            item_transform=self.item_transform,
+            record_path_prefix=self.record_path_prefix,
+            worker_index=worker_index,
+            num_workers=num_workers,
+            copy_arrays=True,  # torch tensors need writable arrays
+        )
+        return iter(stream)

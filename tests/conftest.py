@@ -11,9 +11,16 @@ import os
 # Opt-in real-device runs: `BLENDJAX_TEST_TPU=1 pytest -m tpu` skips the
 # CPU-mesh override so tpu-marked tests really touch the device.
 if os.environ.get("BLENDJAX_TEST_TPU") != "1":
-    os.environ.setdefault("JAX_PLATFORMS", "cpu")
     _flags = os.environ.get("XLA_FLAGS", "")
     if "xla_force_host_platform_device_count" not in _flags:
         os.environ["XLA_FLAGS"] = (
             _flags + " --xla_force_host_platform_device_count=8"
         ).strip()
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    # The machine image pre-imports jax and pins the TPU plugin via
+    # sitecustomize, so the env var alone is read too late; the config
+    # update is what actually selects the CPU backend (must run before the
+    # first backend/device query).
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")

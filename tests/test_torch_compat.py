@@ -1,0 +1,39 @@
+"""Migration adapter: blendtorch-shaped torch DataLoader over blendjax
+transport (reference ``tests/test_dataset.py:11-33`` streams 16 items into
+4 batches through DataLoader)."""
+
+import threading
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+
+from blendjax.data.torch_compat import RemoteIterableDataset  # noqa: E402
+from blendjax.transport import DataPublisherSocket  # noqa: E402
+
+
+def test_dataloader_batches_stream():
+    from torch.utils.data import DataLoader
+
+    pub = DataPublisherSocket("tcp://127.0.0.1:*", btid=0)
+    ds = RemoteIterableDataset([pub.addr], max_items=16, timeoutms=10000)
+
+    def produce():
+        for i in range(16):
+            pub.publish(
+                image=np.full((8, 8), i, np.uint8), frameid=i
+            )
+
+    t = threading.Thread(target=produce, daemon=True)
+    t.start()
+    batches = list(DataLoader(ds, batch_size=4, num_workers=0))
+    t.join(timeout=10)
+    assert len(batches) == 4
+    assert batches[0]["image"].shape == (4, 8, 8)
+    assert isinstance(batches[0]["image"], torch.Tensor)
+    all_frames = sorted(
+        int(f) for b in batches for f in b["frameid"]
+    )
+    assert all_frames == list(range(16))
+    pub.close()
