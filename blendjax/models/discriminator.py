@@ -1,0 +1,33 @@
+"""Image discriminator for simulation-parameter optimization.
+
+JAX counterpart of the reference's torch CNN critic
+(``examples/densityopt/densityopt.py:139-190``: five stride-2 conv blocks
+with batch-norm/leaky-relu into a single logit) used to drive supershape
+parameters toward a target distribution.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class Discriminator(nn.Module):
+    features: tuple = (32, 64, 128, 256)
+    dtype: type = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, images, train: bool = True):
+        """``images``: (B, H, W, C) in [0,1] or uint8. Returns (B,) logits."""
+        x = images.astype(self.dtype)
+        if images.dtype == jnp.uint8:
+            x = x / jnp.asarray(255.0, self.dtype)
+        for f in self.features:
+            x = nn.Conv(f, (4, 4), strides=(2, 2), use_bias=False,
+                        dtype=self.dtype, param_dtype=jnp.float32)(x)
+            x = nn.GroupNorm(num_groups=8, dtype=self.dtype,
+                             param_dtype=jnp.float32)(x)
+            x = nn.leaky_relu(x, 0.2)
+        x = x.mean(axis=(1, 2))
+        logit = nn.Dense(1, dtype=jnp.float32, param_dtype=jnp.float32)(x)
+        return logit[:, 0]

@@ -1,0 +1,31 @@
+"""Actor-critic network for the RL examples.
+
+The reference controls cartpole with a hand-written P-controller
+(``examples/control/cartpole.py:19-21``); blendjax additionally provides a
+learnable Gaussian policy + value head so REINFORCE/PPO agents train on
+TPU against Blender/sim envs (SURVEY.md §7 step 6).
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class PolicyValueNet(nn.Module):
+    hidden: tuple = (64, 64)
+    action_dim: int = 1
+
+    @nn.compact
+    def __call__(self, obs):
+        """``obs``: (B, obs_dim) float32 -> (mean (B,A), log_std (A,),
+        value (B,))."""
+        x = obs.astype(jnp.float32)
+        for h in self.hidden:
+            x = nn.tanh(nn.Dense(h)(x))
+        mean = nn.Dense(self.action_dim)(x)
+        log_std = self.param(
+            "log_std", nn.initializers.constant(-0.5), (self.action_dim,)
+        )
+        value = nn.Dense(1)(x)[:, 0]
+        return mean, log_std, value

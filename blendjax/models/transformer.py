@@ -1,0 +1,132 @@
+"""StreamFormer: a compact vision transformer over image streams.
+
+Net-new (no reference counterpart — blendtorch has no sequence models,
+SURVEY.md §2.4): the multi-chip showcase model. Design goals:
+
+- **TP-friendly dims**: every Dense's output features divide by typical
+  ``tensor`` axis sizes (2/4/8), so ``param_sharding_rules`` gives
+  Megatron-style column sharding for free and GSPMD inserts the
+  collectives.
+- **SP/long-context**: with ``use_ring=True`` attention runs as
+  :func:`blendjax.parallel.ring_attention` over the ``seq`` mesh axis —
+  token sequences (patch tokens of large frames, or frame sequences from
+  the stream) shard across devices and K/V ride the ICI ring.
+- bfloat16 activations on the MXU, float32 params/softmax.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from blendjax.parallel.ring import reference_attention, ring_attention
+
+
+class MultiHeadAttention(nn.Module):
+    num_heads: int
+    dtype: type = jnp.bfloat16
+    use_ring: bool = False
+    mesh: object = None
+    seq_axis: str = "seq"
+    batch_axis: str = "data"
+    causal: bool = False
+
+    @nn.compact
+    def __call__(self, x):
+        b, t, c = x.shape
+        h = self.num_heads
+        d = c // h
+        qkv = nn.DenseGeneral(
+            (3, h, d), axis=-1, dtype=self.dtype, param_dtype=jnp.float32,
+            name="qkv",
+        )(x)
+        q, k, v = (qkv[:, :, i] for i in range(3))  # (B, T, H, D)
+        # softmax math in f32 for stability
+        q, k, v = (a.astype(jnp.float32) for a in (q, k, v))
+        if self.use_ring:
+            assert self.mesh is not None, "ring attention needs a mesh"
+            o = ring_attention(
+                q, k, v, self.mesh, axis=self.seq_axis,
+                causal=self.causal, batch_axis=self.batch_axis,
+            )
+        else:
+            o = reference_attention(q, k, v, causal=self.causal)
+        o = o.astype(self.dtype).reshape(b, t, c)
+        return nn.Dense(c, dtype=self.dtype, param_dtype=jnp.float32,
+                        name="proj")(o)
+
+
+class Block(nn.Module):
+    num_heads: int
+    mlp_ratio: int = 4
+    dtype: type = jnp.bfloat16
+    use_ring: bool = False
+    mesh: object = None
+    seq_axis: str = "seq"
+    batch_axis: str = "data"
+    causal: bool = False
+
+    @nn.compact
+    def __call__(self, x):
+        c = x.shape[-1]
+        y = nn.LayerNorm(dtype=jnp.float32)(x)
+        x = x + MultiHeadAttention(
+            self.num_heads, dtype=self.dtype, use_ring=self.use_ring,
+            mesh=self.mesh, seq_axis=self.seq_axis,
+            batch_axis=self.batch_axis, causal=self.causal,
+        )(y)
+        y = nn.LayerNorm(dtype=jnp.float32)(x)
+        y = nn.Dense(c * self.mlp_ratio, dtype=self.dtype,
+                     param_dtype=jnp.float32)(y)
+        y = nn.gelu(y)
+        y = nn.Dense(c, dtype=self.dtype, param_dtype=jnp.float32)(y)
+        return x + y
+
+
+class StreamFormer(nn.Module):
+    """Patchify -> transformer blocks -> head.
+
+    ``num_outputs=16`` regresses cube corners like
+    :class:`~blendjax.models.cnn.CubeRegressor` so it can train on the
+    same stream.
+    """
+
+    patch: int = 16
+    dim: int = 256
+    depth: int = 4
+    num_heads: int = 8
+    num_outputs: int = 16
+    dtype: type = jnp.bfloat16
+    use_ring: bool = False
+    mesh: object = None
+    seq_axis: str = "seq"
+    batch_axis: str = "data"
+
+    @nn.compact
+    def __call__(self, images):
+        x = images.astype(self.dtype)
+        if images.dtype == jnp.uint8:
+            x = x / jnp.asarray(255.0, self.dtype)
+        x = nn.Conv(
+            self.dim, (self.patch, self.patch),
+            strides=(self.patch, self.patch), dtype=self.dtype,
+            param_dtype=jnp.float32, name="patch_embed",
+        )(x)
+        b, hh, ww, c = x.shape
+        x = x.reshape(b, hh * ww, c)
+        pos = self.param(
+            "pos_embed", nn.initializers.normal(0.02), (1, hh * ww, c),
+            jnp.float32,
+        )
+        x = x + pos.astype(self.dtype)
+        for _ in range(self.depth):
+            x = Block(
+                self.num_heads, dtype=self.dtype, use_ring=self.use_ring,
+                mesh=self.mesh, seq_axis=self.seq_axis,
+                batch_axis=self.batch_axis,
+            )(x)
+        x = nn.LayerNorm(dtype=jnp.float32)(x)
+        x = x.mean(axis=1)
+        out = nn.Dense(self.num_outputs, dtype=jnp.float32,
+                       param_dtype=jnp.float32)(x)
+        return out

@@ -1,0 +1,21 @@
+"""On-device image ops (XLA + Pallas).
+
+The reference burns producer CPU on these (gamma correction at
+``pkg_blender/blendtorch/btb/offscreen.py:105-112`` and in consumer
+transforms, ``examples/datagen/generate.py:10-14``); blendjax moves them
+onto the TPU where they fuse into the input cast of the train step.
+"""
+
+from blendjax.ops.image import (
+    gamma_correct,
+    normalize_uint8,
+    random_flip,
+    uint8_gamma_normalize,
+)
+
+__all__ = [
+    "gamma_correct",
+    "normalize_uint8",
+    "uint8_gamma_normalize",
+    "random_flip",
+]

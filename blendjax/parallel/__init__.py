@@ -1,0 +1,37 @@
+"""ICI-plane parallelism: meshes, shardings, collectives, ring attention.
+
+No reference counterpart exists — blendtorch's only "distributed backend"
+is ZMQ between processes (SURVEY.md §2.4); the accelerator-side plane is
+designed fresh for TPU: a named mesh (``data``/``fsdp``/``tensor``/
+``seq``), ``NamedSharding`` annotations, XLA collectives via ``shard_map``,
+and ring attention for sequence/context parallelism over ICI.
+"""
+
+from blendjax.parallel.mesh import MeshSpec, create_mesh
+from blendjax.parallel.sharding import (
+    batch_sharding,
+    param_sharding_rules,
+    replicated,
+    shard_params,
+)
+from blendjax.parallel.collectives import (
+    all_gather,
+    all_reduce_mean,
+    all_reduce_sum,
+    ring_permute,
+)
+from blendjax.parallel.ring import ring_attention
+
+__all__ = [
+    "MeshSpec",
+    "create_mesh",
+    "batch_sharding",
+    "replicated",
+    "param_sharding_rules",
+    "shard_params",
+    "all_gather",
+    "all_reduce_mean",
+    "all_reduce_sum",
+    "ring_permute",
+    "ring_attention",
+]

@@ -1,0 +1,64 @@
+"""Device mesh construction.
+
+Axis vocabulary (scaling-book conventions):
+
+- ``data``  — batch (DP); the streamed global batch is split here.
+- ``fsdp``  — parameter/optimizer sharding (ZeRO-style), usually folded
+  with ``data`` on small pods.
+- ``tensor`` — intra-layer model parallelism (TP).
+- ``seq``   — sequence/context parallelism (SP; ring attention).
+
+``create_mesh`` lays the requested axis sizes over the available devices
+in ICI-friendly order (innermost axes change fastest so ``tensor``/``seq``
+neighbors are physically adjacent).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import numpy as np
+
+
+@dataclass
+class MeshSpec:
+    """Requested axis sizes; -1 axes absorb the remaining devices."""
+
+    axes: dict = field(default_factory=lambda: {"data": -1})
+
+    def resolve(self, n_devices: int) -> dict:
+        sizes = dict(self.axes)
+        known = int(np.prod([s for s in sizes.values() if s != -1]))
+        free = [k for k, s in sizes.items() if s == -1]
+        assert len(free) <= 1, "at most one -1 axis"
+        if free:
+            assert n_devices % known == 0, (
+                f"{n_devices} devices not divisible by fixed axes {sizes}"
+            )
+            sizes[free[0]] = n_devices // known
+        total = int(np.prod(list(sizes.values())))
+        assert total == n_devices, (
+            f"mesh {sizes} needs {total} devices, have {n_devices}"
+        )
+        return sizes
+
+
+def create_mesh(spec: MeshSpec | dict | None = None, devices=None):
+    """Build a ``jax.sharding.Mesh``.
+
+    >>> mesh = create_mesh({"data": -1})                    # pure DP
+    >>> mesh = create_mesh({"data": -1, "tensor": 2})       # DP x TP
+    >>> mesh = create_mesh({"data": 1, "seq": 8})           # ring SP
+    """
+    import jax
+    from jax.sharding import Mesh
+
+    if spec is None:
+        spec = MeshSpec()
+    elif isinstance(spec, dict):
+        spec = MeshSpec(dict(spec))
+    devices = list(devices if devices is not None else jax.devices())
+    sizes = spec.resolve(len(devices))
+    names = tuple(sizes.keys())
+    shape = tuple(sizes.values())
+    return Mesh(np.array(devices).reshape(shape), axis_names=names)

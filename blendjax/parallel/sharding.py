@@ -1,0 +1,68 @@
+"""NamedSharding helpers and parameter partitioning rules."""
+
+from __future__ import annotations
+
+
+def _np():
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    return NamedSharding, PartitionSpec
+
+
+def batch_sharding(mesh, axis: str = "data"):
+    """Shard the leading (batch) axis across ``axis`` — the layout the
+    ingest pipeline feeds (SURVEY.md §2.4: per-host ingest -> global batch
+    on the ``data`` axis)."""
+    NamedSharding, P = _np()
+    names = [axis] if axis in mesh.axis_names else []
+    if "fsdp" in mesh.axis_names and axis == "data":
+        names.append("fsdp")  # fold fsdp into the batch axis for DP
+    return NamedSharding(mesh, P(tuple(names) if names else None))
+
+
+def replicated(mesh):
+    NamedSharding, P = _np()
+    return NamedSharding(mesh, P())
+
+
+def param_sharding_rules(mesh, path: tuple, value) -> "object":
+    """Default parameter layout:
+
+    - ``tensor`` axis: dense/conv kernels split on their output-feature
+      (last) dimension when divisible — Megatron-style column parallel.
+    - ``fsdp`` axis: remaining large params split on their largest
+      divisible dimension (ZeRO-3 style).
+    - small params (biases, norms) replicated.
+    """
+    NamedSharding, P = _np()
+    shape = getattr(value, "shape", ())
+    spec = [None] * len(shape)
+    if len(shape) >= 2:
+        if "tensor" in mesh.axis_names:
+            tp = mesh.shape["tensor"]
+            if tp > 1 and shape[-1] % tp == 0:
+                spec[-1] = "tensor"
+        if "fsdp" in mesh.axis_names:
+            fs = mesh.shape["fsdp"]
+            if fs > 1:
+                # biggest dim not already taken, divisible by fsdp
+                order = sorted(
+                    range(len(shape)), key=lambda i: -shape[i]
+                )
+                for i in order:
+                    if spec[i] is None and shape[i] % fs == 0:
+                        spec[i] = "fsdp"
+                        break
+    while spec and spec[-1] is None:  # canonical form: P() == replicated
+        spec.pop()
+    return NamedSharding(mesh, P(*spec))
+
+
+def shard_params(mesh, params):
+    """Apply :func:`param_sharding_rules` over a pytree and device_put."""
+    import jax
+
+    def place(path, leaf):
+        return jax.device_put(leaf, param_sharding_rules(mesh, path, leaf))
+
+    return jax.tree_util.tree_map_with_path(place, params)

@@ -1,0 +1,23 @@
+"""pjit train loops + checkpointing for streamed data.
+
+No direct reference counterpart (the reference defers training to user
+torch code, e.g. ``examples/densityopt/densityopt.py:257-331``); this
+package is the consumer-side training half of the north star: jitted,
+donated, mesh-sharded steps fed by ``blendjax.data``.
+"""
+
+from blendjax.train.steps import (
+    corner_loss,
+    make_eval_step,
+    make_train_state,
+    make_supervised_step,
+)
+from blendjax.train.checkpoint import CheckpointManager
+
+__all__ = [
+    "make_train_state",
+    "make_supervised_step",
+    "make_eval_step",
+    "corner_loss",
+    "CheckpointManager",
+]

@@ -1,0 +1,61 @@
+"""Train-state checkpointing (orbax).
+
+The reference has no model checkpointing (SURVEY.md §5 — its only
+persistence is the data-stream recorder, covered by
+``blendjax.data.replay``); this adds the standard orbax save/restore the
+train-loop layer needs, including sharded multi-host states.
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+
+
+class CheckpointManager:
+    """Thin orbax wrapper: ``save(step, state)`` / ``restore(state)``."""
+
+    def __init__(self, directory: str, max_to_keep: int = 3):
+        import orbax.checkpoint as ocp
+
+        self._ocp = ocp
+        self.directory = os.path.abspath(directory)
+        os.makedirs(self.directory, exist_ok=True)
+        self.manager = ocp.CheckpointManager(
+            self.directory,
+            options=ocp.CheckpointManagerOptions(
+                max_to_keep=max_to_keep, create=True
+            ),
+        )
+
+    def save(self, step: int, state) -> None:
+        self.manager.save(
+            step, args=self._ocp.args.StandardSave(state)
+        )
+        self.manager.wait_until_finished()
+
+    def latest_step(self):
+        return self.manager.latest_step()
+
+    def restore(self, target_state):
+        """Restore the latest checkpoint into the structure/shardings of
+        ``target_state`` (pass a freshly-initialized state)."""
+        step = self.manager.latest_step()
+        if step is None:
+            return None
+        abstract = jax.tree.map(
+            lambda x: jax.ShapeDtypeStruct(
+                x.shape, x.dtype,
+                sharding=getattr(x, "sharding", None),
+            )
+            if hasattr(x, "shape")
+            else x,
+            target_state,
+        )
+        return self.manager.restore(
+            step, args=self._ocp.args.StandardRestore(abstract)
+        )
+
+    def close(self):
+        self.manager.close()

@@ -1,0 +1,107 @@
+"""Mesh/sharding/collectives/ring-attention on the 8-device CPU mesh."""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+from jax.sharding import NamedSharding, PartitionSpec as P  # noqa: E402
+
+from blendjax.parallel import (  # noqa: E402
+    all_gather,
+    all_reduce_mean,
+    all_reduce_sum,
+    batch_sharding,
+    create_mesh,
+    param_sharding_rules,
+    replicated,
+    ring_attention,
+    ring_permute,
+    shard_params,
+)
+from blendjax.parallel.mesh import MeshSpec  # noqa: E402
+from blendjax.parallel.ring import reference_attention  # noqa: E402
+
+
+def test_mesh_spec_resolution():
+    assert MeshSpec({"data": -1}).resolve(8) == {"data": 8}
+    assert MeshSpec({"data": -1, "tensor": 2}).resolve(8) == {
+        "data": 4, "tensor": 2
+    }
+    assert MeshSpec({"data": 2, "seq": 4}).resolve(8) == {"data": 2, "seq": 4}
+    with pytest.raises(AssertionError):
+        MeshSpec({"data": 3}).resolve(8)
+
+
+def test_create_mesh_axes():
+    mesh = create_mesh({"data": -1, "tensor": 2})
+    assert mesh.axis_names == ("data", "tensor")
+    assert mesh.shape == {"data": 4, "tensor": 2}
+
+
+def test_batch_and_replicated_sharding():
+    mesh = create_mesh({"data": 4, "fsdp": 2})
+    s = batch_sharding(mesh)
+    assert s.spec == P(("data", "fsdp"))
+    assert replicated(mesh).spec == P()
+
+
+def test_param_sharding_rules():
+    mesh = create_mesh({"fsdp": 4, "tensor": 2})
+    dense = np.zeros((256, 128))
+    s = param_sharding_rules(mesh, ("dense", "kernel"), dense)
+    assert s.spec[-1] == "tensor" and "fsdp" in s.spec
+    bias = np.zeros((7,))
+    assert param_sharding_rules(mesh, ("b",), bias).spec == P()
+    params = {"w": dense, "b": bias}
+    placed = shard_params(mesh, params)
+    assert placed["w"].sharding.spec[-1] == "tensor"
+
+
+def test_collectives_sum_mean_gather_permute():
+    mesh = create_mesh({"data": 8})
+    x = jnp.arange(8.0)
+    xs = jax.device_put(x, NamedSharding(mesh, P("data")))
+    np.testing.assert_allclose(all_reduce_sum(xs, mesh), np.full(1, 28.0))
+    np.testing.assert_allclose(all_reduce_mean(xs, mesh), np.full(1, 3.5))
+    g = all_gather(xs, mesh)
+    np.testing.assert_allclose(np.asarray(g), np.arange(8.0))
+
+    mesh2 = create_mesh({"seq": 8})
+    y = jax.device_put(jnp.arange(8.0), NamedSharding(mesh2, P("seq")))
+    rolled = ring_permute(y, mesh2, axis="seq", shift=1)
+    np.testing.assert_allclose(np.asarray(rolled), np.roll(np.arange(8.0), 1))
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_attention_matches_reference(causal):
+    mesh = create_mesh({"seq": 8})
+    rng = np.random.default_rng(0)
+    b, t, h, d = 2, 32, 2, 4
+    q, k, v = (
+        jnp.asarray(rng.normal(size=(b, t, h, d)).astype(np.float32))
+        for _ in range(3)
+    )
+    spec = NamedSharding(mesh, P(None, "seq"))
+    qs, ks, vs = (jax.device_put(x, spec) for x in (q, k, v))
+    out = ring_attention(qs, ks, vs, mesh, axis="seq", causal=causal,
+                         batch_axis=None)
+    ref = reference_attention(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+    # output stays sequence-sharded on the ring
+    assert out.sharding.spec == P(None, "seq")
+
+
+def test_ring_attention_with_data_and_seq_axes():
+    mesh = create_mesh({"data": 2, "seq": 4})
+    rng = np.random.default_rng(1)
+    b, t, h, d = 4, 16, 2, 8
+    q, k, v = (
+        jnp.asarray(rng.normal(size=(b, t, h, d)).astype(np.float32))
+        for _ in range(3)
+    )
+    spec = NamedSharding(mesh, P("data", "seq"))
+    qs, ks, vs = (jax.device_put(x, spec) for x in (q, k, v))
+    out = ring_attention(qs, ks, vs, mesh, axis="seq", causal=True)
+    ref = reference_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
