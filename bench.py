@@ -1,0 +1,113 @@
+"""blendjax benchmark: Cube-scene stream + CNN train step, images/sec.
+
+Reproduces the reference benchmark's semantics (``benchmarks/benchmark.py``:
+batch 8, 640x480 RGBA cube scene, N producer instances, first batches
+excluded as warmup, timing covers render + transfer + decode + batching)
+and additionally runs a real train step on the accelerator per batch —
+strictly more work per image than the reference measured.
+
+Baseline (BASELINE.md): reference best published aggregate is 0.012
+s/image = 83.3 images/s with 4 Blender instances; ``vs_baseline`` is
+measured_throughput / 83.3.
+
+Prints exactly one JSON line.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+BATCH = 8
+SHAPE = (480, 640)
+WARMUP_BATCHES = 4
+MEASURE_ITEMS = 512
+BASELINE_IMG_PER_SEC = 1.0 / 0.012  # Readme.md:92, 4 instances
+TIME_CAP_S = 120.0
+
+
+def main() -> None:
+    import jax
+
+    from blendjax.data import StreamDataPipeline
+    from blendjax.launcher import PythonProducerLauncher
+    from blendjax.models import CubeRegressor
+    from blendjax.parallel import batch_sharding, create_mesh
+    from blendjax.train import make_supervised_step, make_train_state
+
+    cpu = os.cpu_count() or 1
+    instances = max(1, min(6, cpu - 1)) if cpu > 1 else 1
+    mesh = create_mesh({"data": -1})
+    sharding = batch_sharding(mesh)
+
+    model = CubeRegressor()
+    state = make_train_state(
+        model, np.zeros((BATCH, *SHAPE, 4), np.uint8), mesh=mesh
+    )
+    step = make_supervised_step(mesh=mesh, batch_sharding=sharding)
+
+    producer = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)),
+        "examples", "datagen", "cube_producer.py",
+    )
+    with PythonProducerLauncher(
+        script=producer,
+        num_instances=instances,
+        named_sockets=["DATA"],
+        seed=0,
+        instance_args=[["--shape", str(SHAPE[0]), str(SHAPE[1])]] * instances,
+    ) as launcher:
+        with StreamDataPipeline(
+            launcher.addresses["DATA"],
+            batch_size=BATCH,
+            sharding=sharding,
+            timeoutms=60_000,
+        ) as pipe:
+            it = iter(pipe)
+            for _ in range(WARMUP_BATCHES):  # warmup: compile + fill queues
+                batch = next(it)
+                state, metrics = step(
+                    state, {"image": batch["image"], "xy": batch["xy"]}
+                )
+            jax.block_until_ready(metrics["loss"])
+
+            images = 0
+            t0 = time.perf_counter()
+            while images < MEASURE_ITEMS:
+                batch = next(it)
+                state, metrics = step(
+                    state, {"image": batch["image"], "xy": batch["xy"]}
+                )
+                images += BATCH
+                if time.perf_counter() - t0 > TIME_CAP_S:
+                    break
+            jax.block_until_ready(metrics["loss"])
+            dt = time.perf_counter() - t0
+
+    ips = images / dt
+    print(
+        json.dumps(
+            {
+                "metric": "cube_640x480_stream+train images/sec/chip",
+                "value": round(ips, 2),
+                "unit": "images/s",
+                "vs_baseline": round(ips / BASELINE_IMG_PER_SEC, 3),
+                "detail": {
+                    "instances": instances,
+                    "batch": BATCH,
+                    "images": images,
+                    "seconds": round(dt, 2),
+                    "backend": jax.default_backend(),
+                    "final_loss": float(metrics["loss"]),
+                },
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -14,6 +14,8 @@ from __future__ import annotations
 import flax.linen as nn
 import jax.numpy as jnp
 
+from blendjax.ops.image import maybe_normalize_uint8
+
 
 class CubeRegressor(nn.Module):
     features: tuple = (32, 64, 128, 256)
@@ -22,8 +24,9 @@ class CubeRegressor(nn.Module):
 
     @nn.compact
     def __call__(self, images):
-        """``images``: (B, H, W, 4) uint8 (or float). Returns (B, P, 2)."""
-        x = images.astype(self.dtype) / jnp.asarray(255.0, self.dtype)
+        """``images``: (B, H, W, 4) uint8 (or float in [0,1]).
+        Returns (B, P, 2)."""
+        x = maybe_normalize_uint8(images, self.dtype)
         for f in self.features:
             x = nn.Conv(f, (3, 3), strides=(2, 2), dtype=self.dtype,
                         param_dtype=jnp.float32)(x)

@@ -11,6 +11,8 @@ from __future__ import annotations
 import flax.linen as nn
 import jax.numpy as jnp
 
+from blendjax.ops.image import maybe_normalize_uint8
+
 
 class Discriminator(nn.Module):
     features: tuple = (32, 64, 128, 256)
@@ -19,9 +21,7 @@ class Discriminator(nn.Module):
     @nn.compact
     def __call__(self, images, train: bool = True):
         """``images``: (B, H, W, C) in [0,1] or uint8. Returns (B,) logits."""
-        x = images.astype(self.dtype)
-        if images.dtype == jnp.uint8:
-            x = x / jnp.asarray(255.0, self.dtype)
+        x = maybe_normalize_uint8(images, self.dtype)
         for f in self.features:
             x = nn.Conv(f, (4, 4), strides=(2, 2), use_bias=False,
                         dtype=self.dtype, param_dtype=jnp.float32)(x)

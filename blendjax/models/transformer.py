@@ -19,6 +19,7 @@ from __future__ import annotations
 import flax.linen as nn
 import jax.numpy as jnp
 
+from blendjax.ops.image import maybe_normalize_uint8
 from blendjax.parallel.ring import reference_attention, ring_attention
 
 
@@ -104,9 +105,7 @@ class StreamFormer(nn.Module):
 
     @nn.compact
     def __call__(self, images):
-        x = images.astype(self.dtype)
-        if images.dtype == jnp.uint8:
-            x = x / jnp.asarray(255.0, self.dtype)
+        x = maybe_normalize_uint8(images, self.dtype)
         x = nn.Conv(
             self.dim, (self.patch, self.patch),
             strides=(self.patch, self.patch), dtype=self.dtype,

@@ -8,6 +8,7 @@ onto the TPU where they fuse into the input cast of the train step.
 
 from blendjax.ops.image import (
     gamma_correct,
+    maybe_normalize_uint8,
     normalize_uint8,
     random_flip,
     uint8_gamma_normalize,
@@ -16,6 +17,7 @@ from blendjax.ops.image import (
 __all__ = [
     "gamma_correct",
     "normalize_uint8",
+    "maybe_normalize_uint8",
     "uint8_gamma_normalize",
     "random_flip",
 ]

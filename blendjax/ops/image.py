@@ -32,6 +32,15 @@ def normalize_uint8(x, dtype=jnp.bfloat16):
     return x.astype(dtype) / jnp.asarray(255.0, dtype)
 
 
+def maybe_normalize_uint8(x, dtype=jnp.bfloat16):
+    """Model-input canonicalization: uint8 is scaled to [0,1]; float input
+    is assumed already normalized and only cast. The single shared guard
+    all blendjax models use, so the semantics can't drift per-model."""
+    if x.dtype == jnp.uint8:
+        return normalize_uint8(x, dtype)
+    return x.astype(dtype)
+
+
 def random_flip(rng, x, axis: int = 2):
     """Batched random horizontal flip (augmentation; per-sample bit)."""
     b = x.shape[0]
@@ -57,8 +66,11 @@ def _pallas_gamma_normalize(x, gamma: float = 2.2, dtype=jnp.float32,
 
     b, h, w, c = x.shape
     x2 = x.reshape(b * h, w * c)  # 2D layout for (sublane, lane) tiling
-    block_rows = 256 if (b * h) % 256 == 0 else b * h
-    grid = ((b * h) // block_rows,)
+    # Largest divisor of the row count <= 256: keeps blocks within VMEM for
+    # any resolution (worst case degrades to single-row blocks).
+    rows = b * h
+    block_rows = max(d for d in range(1, min(256, rows) + 1) if rows % d == 0)
+    grid = (rows // block_rows,)
     out = pl.pallas_call(
         functools.partial(
             _gamma_kernel, inv_gamma=1.0 / gamma, scale=1.0 / 255.0

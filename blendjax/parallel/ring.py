@@ -92,8 +92,12 @@ def ring_attention(
 
     d = q.shape[-1]
     scale = scale if scale is not None else d**-0.5
+    if axis not in mesh.axis_names:
+        # No sequence axis on this mesh: nothing to ring over — run plain
+        # exact attention (same math, zero collectives).
+        return reference_attention(q, k, v, causal=causal, scale=scale)
     b_ax = batch_axis if (batch_axis and batch_axis in mesh.axis_names) else None
-    spec = P(b_ax, axis if axis in mesh.axis_names else None)
+    spec = P(b_ax, axis)
     vary_axes = tuple(a for a in (b_ax, axis) if a in mesh.axis_names)
     body = functools.partial(
         _ring_attention_local, axis_name=axis, causal=causal, scale=scale,

@@ -30,17 +30,23 @@ class CheckpointManager:
         )
 
     def save(self, step: int, state) -> None:
+        """Asynchronous: serialization overlaps subsequent train steps;
+        :meth:`wait`/:meth:`close` (and :meth:`restore`) synchronize."""
         self.manager.save(
             step, args=self._ocp.args.StandardSave(state)
         )
+
+    def wait(self) -> None:
         self.manager.wait_until_finished()
 
     def latest_step(self):
+        self.manager.wait_until_finished()
         return self.manager.latest_step()
 
     def restore(self, target_state):
         """Restore the latest checkpoint into the structure/shardings of
         ``target_state`` (pass a freshly-initialized state)."""
+        self.manager.wait_until_finished()
         step = self.manager.latest_step()
         if step is None:
             return None
@@ -58,4 +64,5 @@ class CheckpointManager:
         )
 
     def close(self):
+        self.manager.wait_until_finished()
         self.manager.close()

@@ -1,0 +1,107 @@
+"""Supervised training on a live cube stream — the blendjax counterpart of
+the reference's ``examples/datagen/generate.py`` + a real train loop.
+
+Launches N headless producers (swap in BlenderLauncher + a ``.blend.py``
+scene for real Blender), streams image+corner batches onto the device
+mesh, and trains :class:`CubeRegressor` with a donated jitted step.
+
+Run: ``python examples/datagen/train.py --steps 50`` (add ``--record
+PREFIX`` / ``--replay PREFIX`` for the reference's record/replay flows,
+``generate.py:48-81``).
+"""
+
+from __future__ import annotations
+
+import argparse
+import time
+
+import numpy as np
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--instances", type=int, default=2)
+    ap.add_argument("--batch", type=int, default=8)
+    ap.add_argument("--shape", nargs=2, type=int, default=[128, 128])
+    ap.add_argument("--steps", type=int, default=50)
+    ap.add_argument("--record", default=None, help="record stream to PREFIX")
+    ap.add_argument("--replay", default=None, help="replay from PREFIX (no producers)")
+    args = ap.parse_args()
+
+    import jax
+
+    from blendjax.data import StreamDataPipeline
+    from blendjax.launcher import PythonProducerLauncher
+    from blendjax.models import CubeRegressor
+    from blendjax.parallel import batch_sharding, create_mesh
+    from blendjax.train import make_supervised_step, make_train_state
+
+    mesh = create_mesh({"data": -1})
+    sharding = batch_sharding(mesh)
+    h, w = args.shape
+
+    model = CubeRegressor()
+    state = make_train_state(
+        model, np.zeros((args.batch, h, w, 4), np.uint8), mesh=mesh
+    )
+    step = make_supervised_step(mesh=mesh, batch_sharding=sharding)
+
+    def run_steps(batches):
+        nonlocal state
+        t0, n = time.perf_counter(), 0
+        for i, batch in enumerate(batches):
+            if i >= args.steps:
+                break
+            state, metrics = step(
+                state, {"image": batch["image"], "xy": batch["xy"]}
+            )
+            n += args.batch
+            if i % 10 == 0:
+                print(f"step {i}: loss={float(metrics['loss']):.5f}")
+        dt = time.perf_counter() - t0
+        print(f"{n / dt:.1f} images/sec ({n} images in {dt:.1f}s)")
+
+    if args.replay:
+        from blendjax.data import FileDataset
+        from blendjax.data.batcher import BatchAssembler
+        from blendjax.data.schema import StreamSchema
+
+        ds = FileDataset(args.replay)
+
+        def batches():
+            asm = None
+            while True:  # loop the recording like an epoch
+                for item in ds:
+                    if asm is None:
+                        asm = BatchAssembler(
+                            StreamSchema.infer(item), args.batch
+                        )
+                    b = asm.add(item)
+                    if b is not None:
+                        yield {
+                            k: jax.device_put(v, sharding)
+                            for k, v in b.items()
+                            if k != "_meta"
+                        }
+
+        run_steps(batches())
+        return
+
+    with PythonProducerLauncher(
+        script=__file__.replace("train.py", "cube_producer.py"),
+        num_instances=args.instances,
+        named_sockets=["DATA"],
+        seed=0,
+        instance_args=[["--shape", str(h), str(w)]] * args.instances,
+    ) as launcher:
+        with StreamDataPipeline(
+            launcher.addresses["DATA"],
+            batch_size=args.batch,
+            sharding=sharding,
+            record_path_prefix=args.record,
+        ) as pipe:
+            run_steps(iter(pipe))
+
+
+if __name__ == "__main__":
+    main()
