@@ -1,0 +1,27 @@
+"""RL integration: drive remote (Blender or sim) environments from
+training processes.
+
+Reference counterpart: ``pkg_pytorch/blendtorch/btt/env.py`` (RemoteEnv /
+launch_env / OpenAIRemoteEnv) + ``env_rendering.py``. blendjax targets
+Gymnasium (the maintained gym API) and adds batched environments so
+policies train on-device against fleets of simulators.
+"""
+
+from blendjax.env.remote import RemoteEnv, launch_env
+from blendjax.env.rendering import RENDER_BACKENDS, create_renderer
+from blendjax.env.vector import BatchedRemoteEnv
+
+try:  # gymnasium is an optional dependency (reference guards gym the
+    # same way, ``btt/env.py:191,315``)
+    from blendjax.env.gymnasium_adapter import GymnasiumRemoteEnv
+except ImportError:  # pragma: no cover
+    GymnasiumRemoteEnv = None
+
+__all__ = [
+    "RemoteEnv",
+    "launch_env",
+    "GymnasiumRemoteEnv",
+    "BatchedRemoteEnv",
+    "create_renderer",
+    "RENDER_BACKENDS",
+]

@@ -1,0 +1,82 @@
+"""Gymnasium adapter for remote-controlled producer environments.
+
+Reference: ``OpenAIRemoteEnv`` (``btt/env.py:195-313``) wrapped classic
+gym; blendjax targets Gymnasium's API (terminated/truncated split,
+``reset(seed=...) -> (obs, info)``) since gym is unmaintained.
+"""
+
+from __future__ import annotations
+
+import gymnasium
+import numpy as np
+
+from blendjax.env.remote import launch_env
+
+
+class GymnasiumRemoteEnv(gymnasium.Env):
+    """A Gymnasium env whose physics run in a launched producer process.
+
+    Subclass (or construct) with the producer ``script``; pass spaces that
+    describe the remote env. Extra kwargs go to the producer CLI
+    (reference launch+step+reset+render wrapping, ``btt/env.py:216-313``).
+    """
+
+    metadata = {"render_modes": ["human", "rgb_array"]}
+
+    def __init__(
+        self,
+        script: str,
+        scene: str = "",
+        observation_space=None,
+        action_space=None,
+        render_mode: str | None = None,
+        real_time: bool = False,
+        max_episode_steps: int | None = None,
+        **launch_kwargs,
+    ):
+        self.render_mode = render_mode
+        self.observation_space = observation_space or gymnasium.spaces.Box(
+            -np.inf, np.inf, shape=(4,), dtype=np.float32
+        )
+        self.action_space = action_space or gymnasium.spaces.Box(
+            -1.0, 1.0, shape=(1,), dtype=np.float32
+        )
+        self.max_episode_steps = max_episode_steps
+        self._elapsed = 0
+        self._ctx = launch_env(
+            script=script, scene=scene, real_time=real_time, **launch_kwargs
+        )
+        self._env = self._ctx.__enter__()
+
+    def reset(self, *, seed=None, options=None):
+        super().reset(seed=seed)
+        self._elapsed = 0
+        obs, info = self._env.reset()
+        return self._obs(obs), info
+
+    def step(self, action):
+        if isinstance(action, np.ndarray) and action.size == 1:
+            action = float(action.reshape(()))
+        obs, reward, done, info = self._env.step(action)
+        self._elapsed += 1
+        truncated = (
+            self.max_episode_steps is not None
+            and self._elapsed >= self.max_episode_steps
+        )
+        return self._obs(obs), reward, bool(done), bool(truncated), info
+
+    def _obs(self, obs):
+        if obs is None:
+            return None
+        arr = np.asarray(obs)
+        return arr.astype(self.observation_space.dtype)
+
+    def render(self):
+        if self.render_mode == "rgb_array":
+            return self._env.render(mode="rgb_array")
+        if self.render_mode == "human":
+            return self._env.render(mode="human")
+        return None
+
+    def close(self):
+        self._ctx.__exit__(None, None, None)

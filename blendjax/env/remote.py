@@ -1,0 +1,121 @@
+"""Blocking RPC client for remote-controlled environments.
+
+Reference: ``pkg_pytorch/blendtorch/btt/env.py:7-189``. One ``step()`` =
+one simulated frame on the producer; the REQ socket uses RELAXED+CORRELATE
+and timeouts raise so a dead simulator fails fast (``btt/env.py:36-42``).
+"""
+
+from __future__ import annotations
+
+import contextlib
+
+from blendjax import constants
+from blendjax.transport import RpcClient
+
+
+class RemoteEnv:
+    """Client for a producer-side :class:`~blendjax.producer.env
+    .RemoteControlledAgent`."""
+
+    def __init__(self, addr: str, timeoutms: int = constants.DEFAULT_TIMEOUTMS):
+        self.client = RpcClient(addr, timeoutms=timeoutms)
+        self.env_time = None
+        self.rgb_array = None
+
+    def _unpack(self, rep: dict):
+        # Track simulation time and the latest rendered frame
+        # (reference ``_reqrep`` bookkeeping, ``btt/env.py:111-124``).
+        self.env_time = rep.get("time", self.env_time)
+        if "rgb_array" in rep:
+            self.rgb_array = rep["rgb_array"]
+        obs = rep.get("obs")
+        info = {
+            k: v
+            for k, v in rep.items()
+            if k not in ("obs", "reward", "done", "rgb_array")
+        }
+        return obs, float(rep.get("reward", 0.0)), bool(rep.get("done", False)), info
+
+    def reset(self):
+        """Start a fresh episode; returns ``(obs, info)``
+        (reference ``btt/env.py:47-60``)."""
+        obs, _, _, info = self._unpack(self.client.call(cmd="reset"))
+        return obs, info
+
+    def step(self, action):
+        """Apply ``action`` for one frame; returns
+        ``(obs, reward, done, info)`` (reference ``btt/env.py:62-86``)."""
+        return self._unpack(self.client.call(cmd="step", action=action))
+
+    def render(self, mode: str = "human", backend: str | None = None):
+        """Show or return the last ``rgb_array`` received
+        (reference ``btt/env.py:88-109``)."""
+        if mode == "rgb_array" or self.rgb_array is None:
+            return self.rgb_array
+        from blendjax.env.rendering import create_renderer
+
+        if not hasattr(self, "_viewer") or self._viewer is None:
+            self._viewer = create_renderer(backend)
+        self._viewer.imshow(self.rgb_array)
+        return None
+
+    def close(self):
+        if getattr(self, "_viewer", None) is not None:
+            self._viewer.close()
+            self._viewer = None
+        self.client.close()
+
+
+def _kwargs_to_cli(kwargs: dict) -> list[str]:
+    """kwargs -> producer CLI flags: ``--key value`` / ``--key`` /
+    ``--no-key`` for bools (reference ``btt/env.py:164-174``)."""
+    argv: list[str] = []
+    for k, v in kwargs.items():
+        flag = k.replace("_", "-")
+        if isinstance(v, bool):
+            argv.append(f"--{flag}" if v else f"--no-{flag}")
+        elif isinstance(v, (list, tuple)):
+            argv.append(f"--{flag}")
+            argv.extend(str(x) for x in v)
+        else:
+            argv.extend([f"--{flag}", str(v)])
+    return argv
+
+
+@contextlib.contextmanager
+def launch_env(script: str, scene: str = "", background: bool = False,
+               seed: int = 0, real_time: bool = False,
+               use_blender: bool | None = None, **kwargs):
+    """Launch one environment producer and yield a connected
+    :class:`RemoteEnv` (reference ``launch_env``, ``btt/env.py:137-189``).
+
+    ``script`` is a producer script speaking the handshake; with
+    ``use_blender`` (or a ``scene`` given) it runs inside Blender,
+    otherwise as a headless Python producer. Extra kwargs become CLI flags
+    for the script.
+    """
+    from blendjax.launcher.launcher import (
+        BlenderLauncher,
+        PythonProducerLauncher,
+    )
+
+    extra = _kwargs_to_cli({"real_time": real_time, **kwargs})
+    if use_blender is None:
+        use_blender = bool(scene)
+    if use_blender:
+        launcher = BlenderLauncher(
+            scene=scene, script=script, background=background,
+            num_instances=1, named_sockets=["GYM"], seed=seed,
+            instance_args=[extra],
+        )
+    else:
+        launcher = PythonProducerLauncher(
+            script=script, num_instances=1, named_sockets=["GYM"],
+            seed=seed, instance_args=[extra],
+        )
+    with launcher as ln:
+        env = RemoteEnv(ln.addresses["GYM"][0])
+        try:
+            yield env
+        finally:
+            env.close()

@@ -1,0 +1,79 @@
+"""Batched remote environments: a fleet of producers stepped in parallel.
+
+Net-new (SURVEY.md §7 build step 6: "batch envs x N Blender instances for
+PPO/REINFORCE on TPU"): each remote step is a blocking network RPC, so a
+thread pool overlaps the N round-trips and the results stack into device-
+ready arrays. With ``real_time=False`` producers wait for their next
+command, so lockstep batching is exact.
+"""
+
+from __future__ import annotations
+
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+
+from blendjax.env.remote import RemoteEnv, _kwargs_to_cli
+
+
+class BatchedRemoteEnv:
+    """N producer instances, stepped/reset in lockstep.
+
+    ``step(actions)`` takes (N, ...) actions and returns stacked
+    ``(obs (N,...), reward (N,), done (N,), infos list)``. Episodes
+    auto-reset on done (the standard vector-env contract) so TPU policy
+    rollouts never stall.
+    """
+
+    def __init__(self, script: str, num_envs: int = 4, seed: int = 0,
+                 timeoutms: int = 30_000, **producer_kwargs):
+        from blendjax.launcher.launcher import PythonProducerLauncher
+
+        extra = _kwargs_to_cli(producer_kwargs) if producer_kwargs else []
+        self.launcher = PythonProducerLauncher(
+            script=script,
+            num_instances=num_envs,
+            named_sockets=["GYM"],
+            seed=seed,
+            instance_args=[list(extra) for _ in range(num_envs)],
+        )
+        self.launcher.__enter__()
+        self.envs = [
+            RemoteEnv(a, timeoutms=timeoutms)
+            for a in self.launcher.addresses["GYM"]
+        ]
+        self.num_envs = num_envs
+        self._pool = ThreadPoolExecutor(max_workers=num_envs)
+
+    def reset(self):
+        obs_info = list(self._pool.map(lambda e: e.reset(), self.envs))
+        return np.stack([np.asarray(o) for o, _ in obs_info]), [
+            i for _, i in obs_info
+        ]
+
+    def step(self, actions):
+        def one(env_action):
+            env, a = env_action
+            obs, reward, done, info = env.step(np.asarray(a).tolist())
+            if done:
+                obs, _ = env.reset()  # auto-reset, obs is the fresh episode
+            return obs, reward, done, info
+
+        results = list(self._pool.map(one, zip(self.envs, actions)))
+        obs = np.stack([np.asarray(r[0]) for r in results])
+        reward = np.asarray([r[1] for r in results], np.float32)
+        done = np.asarray([r[2] for r in results], bool)
+        infos = [r[3] for r in results]
+        return obs, reward, done, infos
+
+    def close(self):
+        self._pool.shutdown(wait=False)
+        for e in self.envs:
+            e.close()
+        self.launcher.__exit__(None, None, None)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

@@ -1,0 +1,44 @@
+"""Balance the remote cartpole with a hand-tuned controller.
+
+Mirrors the reference example (``examples/control/cartpole.py:19-39``: a
+proportional controller on pole angle driving the motor velocity through
+the gym API), against the headless producer here.
+
+Run: ``python examples/control/cartpole.py``
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from blendjax.env import launch_env
+
+SCRIPT = os.path.join(os.path.dirname(__file__), "cartpole_producer.py")
+
+
+def control(obs) -> float:
+    """P(D)-controller: push the cart under the falling pole
+    (reference ``cartpole.py:19-21``)."""
+    x, x_dot, theta, theta_dot = np.asarray(obs, np.float32)
+    return float(8.0 * theta + 1.0 * theta_dot + 0.2 * x)
+
+
+def main() -> None:
+    with launch_env(script=SCRIPT, seed=3) as env:
+        obs, _ = env.reset()
+        total, steps = 0.0, 0
+        for _ in range(300):
+            obs, reward, done, info = env.step(control(obs))
+            total += reward
+            steps += 1
+            if done:
+                print(f"episode end after {steps} steps, return {total}")
+                obs, _ = env.reset()
+                total, steps = 0.0, 0
+        print(f"final: {steps} steps balanced, return {total}")
+
+
+if __name__ == "__main__":
+    main()
