@@ -1,0 +1,88 @@
+"""Score-function (REINFORCE) gradients through non-differentiable
+simulators.
+
+The core trick of the reference's densityopt example
+(``examples/densityopt/densityopt.py:285-309``): simulation parameters are
+sampled from a Gaussian, rendered by the (non-differentiable) producer,
+scored by a loss on the consumer, and the sampling distribution is updated
+with ``grad log p(theta) * (loss - baseline)``. blendjax packages the
+distribution + update as a reusable component; the association of rendered
+frames back to their parameter samples rides on ``shape_id``
+(``densityopt.py:99-103,119``).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+class GaussianSimParams:
+    """Diagonal-Gaussian distribution over simulator parameters with a
+    REINFORCE update and a running-mean baseline."""
+
+    def __init__(self, mu, log_sigma, learning_rate: float = 5e-2,
+                 baseline_decay: float = 0.9):
+        self.mu = jnp.asarray(mu, jnp.float32)
+        self.log_sigma = jnp.asarray(log_sigma, jnp.float32)
+        self.lr = learning_rate
+        self.baseline = None
+        self.baseline_decay = baseline_decay
+
+    def sample(self, key, n: int):
+        """Draw n parameter vectors; returns (samples (n,D))."""
+        eps = jax.random.normal(key, (n, *self.mu.shape))
+        return self.mu + jnp.exp(self.log_sigma) * eps
+
+    def log_prob(self, theta):
+        var = jnp.exp(2 * self.log_sigma)
+        return -0.5 * (
+            (theta - self.mu) ** 2 / var
+            + 2 * self.log_sigma
+            + jnp.log(2 * jnp.pi)
+        ).sum(-1)
+
+    def update(self, theta, losses):
+        """REINFORCE step: lower expected loss (``densityopt.py:290-309``).
+
+        theta: (n, D) sampled params; losses: (n,) per-sample losses.
+        Returns the advantage-weighted mean loss for logging.
+        """
+        theta = jnp.asarray(theta, jnp.float32)
+        losses = jnp.asarray(losses, jnp.float32)
+        mean_loss = losses.mean()
+        if self.baseline is None:
+            self.baseline = mean_loss
+        adv = losses - self.baseline
+
+        def objective(mu, log_sigma):
+            var = jnp.exp(2 * log_sigma)
+            lp = -0.5 * (
+                (theta - mu) ** 2 / var + 2 * log_sigma + jnp.log(2 * jnp.pi)
+            ).sum(-1)
+            return (lp * jax.lax.stop_gradient(adv)).mean()
+
+        gmu, gsig = jax.grad(objective, argnums=(0, 1))(
+            self.mu, self.log_sigma
+        )
+        self.mu = self.mu - self.lr * gmu
+        self.log_sigma = self.log_sigma - self.lr * gsig
+        self.baseline = (
+            self.baseline_decay * self.baseline
+            + (1 - self.baseline_decay) * mean_loss
+        )
+        return float(mean_loss)
+
+
+def chunk_across(items, n_chunks: int):
+    """Split a list into n contiguous chunks (last may be short) — the
+    reference's param fan-out across producer instances
+    (``densityopt.py:95-107``)."""
+    k, m = divmod(len(items), n_chunks)
+    out = []
+    i = 0
+    for c in range(n_chunks):
+        size = k + (1 if c < m else 0)
+        out.append(items[i : i + size])
+        i += size
+    return out

@@ -1,0 +1,152 @@
+"""Optimize simulation parameters from a TPU loss — adversarial style.
+
+blendjax counterpart of the reference's flagship bidirectional example
+(``examples/densityopt/densityopt.py``): a fleet of supershape producers
+renders parameter samples fanned out over duplex CTRL channels; a
+discriminator on the accelerator scores rendered vs. target images; the
+sampling distribution over shape parameters updates by score-function
+gradient (the renderer is non-differentiable). ``shape_id`` round-trips
+through the producers to re-associate images with their samples
+(``densityopt.py:99-103,119``).
+
+Run: ``python examples/densityopt/densityopt.py --iters 10``
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+
+import numpy as np
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--instances", type=int, default=2)
+    ap.add_argument("--samples", type=int, default=8, help="per iteration")
+    ap.add_argument("--iters", type=int, default=10)
+    ap.add_argument("--target-m", type=float, default=3.0)
+    ap.add_argument("--init-m", type=float, default=7.0)
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from blendjax.data import RemoteStream
+    from blendjax.launcher import PythonProducerLauncher
+    from blendjax.models import Discriminator
+    from blendjax.producer.sim import SupershapeScene
+    from blendjax.train.score import GaussianSimParams, chunk_across
+    from blendjax.transport import PairChannel
+
+    script = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "supershape_producer.py"
+    )
+
+    # Target distribution: "real" images rendered locally at target params
+    # (the reference draws its target set the same way, from known params).
+    target_scene = SupershapeScene(seed=123)
+    rng = np.random.default_rng(0)
+
+    def target_batch(n):
+        imgs = []
+        for _ in range(n):
+            m = args.target_m + rng.normal() * 0.1
+            target_scene.set_params([m, 1.0, 1.0, 1.0], shape_id=0)
+            imgs.append(target_scene.render())
+        return np.stack(imgs)
+
+    disc = Discriminator(features=(16, 32))
+    dummy = np.zeros((2, 256, 256, 4), np.uint8)
+    dparams = disc.init(jax.random.key(0), dummy)["params"]
+    tx = optax.adam(1e-3)
+    opt_state = tx.init(dparams)
+
+    @jax.jit
+    def disc_step(dparams, opt_state, real, fake):
+        def loss_fn(p):
+            lr = disc.apply({"params": p}, real)
+            lf = disc.apply({"params": p}, fake)
+            return (
+                optax.sigmoid_binary_cross_entropy(lr, jnp.ones_like(lr)).mean()
+                + optax.sigmoid_binary_cross_entropy(
+                    lf, jnp.zeros_like(lf)
+                ).mean()
+            )
+
+        loss, grads = jax.value_and_grad(loss_fn)(dparams)
+        updates, opt_state = tx.update(grads, opt_state)
+        return optax.apply_updates(dparams, updates), opt_state, loss
+
+    @jax.jit
+    def fake_scores(dparams, fake):
+        # Simulator wants fakes classified REAL: per-sample BCE vs 1.
+        logits = disc.apply({"params": dparams}, fake)
+        return optax.sigmoid_binary_cross_entropy(
+            logits, jnp.ones_like(logits)
+        )
+
+    sim = GaussianSimParams(
+        mu=[args.init_m], log_sigma=[np.log(0.5)], learning_rate=0.15
+    )
+
+    with PythonProducerLauncher(
+        script=script,
+        num_instances=args.instances,
+        named_sockets=["DATA", "CTRL"],
+        seed=0,
+    ) as launcher:
+        remotes = [
+            PairChannel(a, bind=False)
+            for a in launcher.addresses["CTRL"]
+        ]
+        stream = RemoteStream(
+            launcher.addresses["DATA"], timeoutms=30_000, copy_arrays=True
+        )
+        images_iter = iter(stream)
+        key = jax.random.key(0)
+        next_id = 0
+        for it in range(args.iters):
+            key, sub = jax.random.split(key)
+            theta = np.asarray(sim.sample(sub, args.samples))
+            ids = list(range(next_id, next_id + args.samples))
+            next_id += args.samples
+            # Fan samples out across instances (reference
+            # ``update_simulations``, ``densityopt.py:95-107``).
+            for remote, th_chunk, id_chunk in zip(
+                remotes,
+                chunk_across(list(theta), args.instances),
+                chunk_across(ids, args.instances),
+            ):
+                for th, sid in zip(th_chunk, id_chunk):
+                    remote.send(
+                        shape_params=np.array(
+                            [th[0], 1.0, 1.0, 1.0], np.float32
+                        ),
+                        shape_id=sid,
+                    )
+            # Collect one render per sample, re-associated by shape_id.
+            by_id = {}
+            while len(by_id) < args.samples:
+                item = next(images_iter)
+                if item["shape_id"] in ids:
+                    by_id[item["shape_id"]] = item["image"]
+            fake = np.stack([by_id[i] for i in ids])
+            real = target_batch(args.samples)
+            dparams, opt_state, dloss = disc_step(
+                dparams, opt_state, real, fake
+            )
+            losses = np.asarray(fake_scores(dparams, fake))
+            mean_loss = sim.update(theta, losses)
+            print(
+                f"iter {it}: mu={float(sim.mu[0]):.3f} "
+                f"(target {args.target_m}) d_loss={float(dloss):.4f} "
+                f"sim_loss={mean_loss:.4f}"
+            )
+        for r in remotes:
+            r.close()
+
+
+if __name__ == "__main__":
+    main()
