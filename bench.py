@@ -59,6 +59,7 @@ def main() -> None:
         num_instances=instances,
         named_sockets=["DATA"],
         seed=0,
+        proto="ipc",  # same-host fleet: unix sockets beat TCP loopback
         instance_args=[["--shape", str(SHAPE[0]), str(SHAPE[1])]] * instances,
     ) as launcher:
         with StreamDataPipeline(

@@ -1,0 +1,82 @@
+"""On-demand g++ build + ctypes loading of the native accelerators.
+
+No pybind11 in this environment, so the ABI is plain C (``extern "C"``)
+over ctypes. The shared object is cached next to the package keyed by a
+source hash, so rebuilds happen only when the source changes. Set
+``BLENDJAX_NO_NATIVE=1`` to force the Python fallbacks.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import tempfile
+import threading
+
+from blendjax.utils.logging import get_logger
+
+logger = get_logger("native")
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_LOCK = threading.Lock()
+_CACHE: dict = {}
+
+
+def _build(src_path: str, tag: str):
+    with open(src_path, "rb") as f:
+        digest = hashlib.sha256(f.read()).hexdigest()[:16]
+    out = os.path.join(_HERE, f"_{tag}_{digest}.so")
+    if not os.path.exists(out):
+        tmp = tempfile.mktemp(suffix=".so", dir=_HERE)
+        cmd = [
+            "g++", "-O3", "-shared", "-fPIC", "-std=c++17",
+            "-o", tmp, src_path,
+        ]
+        try:
+            subprocess.run(
+                cmd, check=True, capture_output=True, timeout=120
+            )
+            os.replace(tmp, out)  # atomic: safe across concurrent builds
+        except (OSError, subprocess.SubprocessError) as e:
+            stderr = getattr(e, "stderr", b"") or b""
+            logger.warning(
+                "native build of %s failed (%s) %s; using Python fallback",
+                tag, e, stderr.decode(errors="replace")[:500],
+            )
+            if os.path.exists(tmp):
+                os.remove(tmp)
+            return None
+    return ctypes.CDLL(out)
+
+
+def load_rasterizer():
+    """Returns ``(fill, clear)`` native functions or None.
+
+    ``fill(px f64[n,3,2], depth f64[n,3], rgba u8[n,4], n, color u8[h,w,4],
+    zbuf f64[h,w], h, w)``; ``clear(color, zbuf, h, w, rgba u8[4])``.
+    """
+    if os.environ.get("BLENDJAX_NO_NATIVE") == "1":
+        return None
+    with _LOCK:
+        if "rasterizer" not in _CACHE:
+            lib = _build(os.path.join(_HERE, "rasterizer.cpp"), "rasterizer")
+            if lib is None:
+                _CACHE["rasterizer"] = None
+            else:
+                u8p = ctypes.POINTER(ctypes.c_uint8)
+                f64p = ctypes.POINTER(ctypes.c_double)
+                fill = lib.bjx_fill_triangles
+                fill.restype = None
+                fill.argtypes = [
+                    f64p, f64p, u8p, ctypes.c_int64,
+                    u8p, f64p, ctypes.c_int64, ctypes.c_int64,
+                ]
+                clear = lib.bjx_clear
+                clear.restype = None
+                clear.argtypes = [
+                    u8p, f64p, ctypes.c_int64, ctypes.c_int64, u8p,
+                ]
+                _CACHE["rasterizer"] = (fill, clear)
+        return _CACHE["rasterizer"]

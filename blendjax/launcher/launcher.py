@@ -23,6 +23,7 @@ import signal
 import socket as pysocket
 import subprocess
 import sys
+import tempfile
 
 from blendjax.launcher.arguments import format_launch_args
 from blendjax.launcher.launch_info import LaunchInfo
@@ -83,6 +84,7 @@ class ProcessLauncher:
         self.processes: list = []
         self.launch_info: LaunchInfo | None = None
         self._argvs: list = []
+        self._ipc_dir: str | None = None
 
     # -- address plan -------------------------------------------------------
 
@@ -92,8 +94,19 @@ class ProcessLauncher:
         With ``start_port`` set, ports are deterministic ``start_port+k``
         in socket-major order (reference starts at 11000,
         ``launcher.py:63,104-107``); otherwise free ports are probed.
+        ``proto='ipc'`` uses unix-socket endpoints instead — cheaper than
+        TCP loopback for same-host producer fleets.
         """
         addresses: dict = {}
+        if self.proto == "ipc":
+            base = self._ipc_dir = tempfile.mkdtemp(prefix="blendjax-ipc-")
+            return {
+                name: [
+                    f"ipc://{base}/{name}-{i}"
+                    for i in range(self.num_instances)
+                ]
+                for name in self.named_sockets
+            }
         port = self.start_port
         for name in self.named_sockets:
             addrs = []
@@ -201,6 +214,13 @@ class ProcessLauncher:
         # All children must be gone (reference asserts, ``launcher.py:181``).
         still = [p.pid for p in self.processes if p.poll() is None]
         self.processes = []
+        if self._ipc_dir is not None:
+            # SIGTERM'd producers never unlink their unix sockets; stale
+            # files would also break rebinding after a respawn.
+            import shutil
+
+            shutil.rmtree(self._ipc_dir, ignore_errors=True)
+            self._ipc_dir = None
         if still:
             # Never mask an in-flight exception with the leak report.
             if exc_type is None:

@@ -34,19 +34,21 @@ class GaussianSimParams:
         eps = jax.random.normal(key, (n, *self.mu.shape))
         return self.mu + jnp.exp(self.log_sigma) * eps
 
-    def log_prob(self, theta):
-        var = jnp.exp(2 * self.log_sigma)
+    def log_prob(self, theta, mu=None, log_sigma=None):
+        """Diagonal-Gaussian log density (also the differentiated core of
+        :meth:`update`, so the math lives in exactly one place)."""
+        mu = self.mu if mu is None else mu
+        log_sigma = self.log_sigma if log_sigma is None else log_sigma
+        var = jnp.exp(2 * log_sigma)
         return -0.5 * (
-            (theta - self.mu) ** 2 / var
-            + 2 * self.log_sigma
-            + jnp.log(2 * jnp.pi)
+            (theta - mu) ** 2 / var + 2 * log_sigma + jnp.log(2 * jnp.pi)
         ).sum(-1)
 
     def update(self, theta, losses):
         """REINFORCE step: lower expected loss (``densityopt.py:290-309``).
 
         theta: (n, D) sampled params; losses: (n,) per-sample losses.
-        Returns the advantage-weighted mean loss for logging.
+        Returns the plain mean loss (pre-baseline) for logging.
         """
         theta = jnp.asarray(theta, jnp.float32)
         losses = jnp.asarray(losses, jnp.float32)
@@ -56,10 +58,7 @@ class GaussianSimParams:
         adv = losses - self.baseline
 
         def objective(mu, log_sigma):
-            var = jnp.exp(2 * log_sigma)
-            lp = -0.5 * (
-                (theta - mu) ** 2 / var + 2 * log_sigma + jnp.log(2 * jnp.pi)
-            ).sum(-1)
+            lp = self.log_prob(theta, mu, log_sigma)
             return (lp * jax.lax.stop_gradient(adv)).mean()
 
         gmu, gsig = jax.grad(objective, argnums=(0, 1))(
