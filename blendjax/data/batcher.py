@@ -17,6 +17,7 @@ import numpy as np
 
 from blendjax.data.schema import StreamSchema
 from blendjax.utils.logging import get_logger
+from blendjax.utils.metrics import metrics
 
 logger = get_logger("data")
 
@@ -112,14 +113,18 @@ class HostIngest:
                 if self.items_in % self.validate_every == 0:
                     self.schema.validate(item)
                 self.items_in += 1
+                metrics.count("ingest.items")
                 batch = assembler.add(item)
                 if batch is not None:
+                    metrics.gauge("ingest.queue_depth", self._queue.qsize())
                     while not self._stop.is_set():
                         try:
                             self._queue.put(batch, timeout=0.25)
                             self.batches_out += 1
+                            metrics.count("ingest.batches")
                             break
                         except queue.Full:
+                            metrics.count("ingest.queue_full_waits")
                             continue
         except BaseException as e:  # propagate into the consumer thread
             self._error = e

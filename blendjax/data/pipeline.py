@@ -98,10 +98,27 @@ class StreamDataPipeline:
         sharding=None,
         prefetch: int = 2,
         multihost: bool = False,
+        launcher=None,
         **stream_kwargs,
     ):
         from blendjax.data.stream import RemoteStream
 
+        # With a launcher attached, a receive timeout becomes a producer
+        # health check: dead instances raise with their exit codes (or are
+        # respawned when the launcher has respawn=True) instead of an
+        # opaque timeout (SURVEY.md §5 failure detection).
+        self.launcher = launcher
+        if launcher is not None and "on_timeout" not in stream_kwargs:
+            retries = {"left": 3}
+
+            def on_timeout():
+                launcher.assert_alive()  # raises (or respawns) as configured
+                # All producers alive but silent: retry a bounded number of
+                # times (covers slow startup/respawn), then fail fast.
+                retries["left"] -= 1
+                return retries["left"] >= 0
+
+            stream_kwargs["on_timeout"] = on_timeout
         self.stream = RemoteStream(addresses, **stream_kwargs)
         self.ingest = None
         self.batch_size = batch_size

@@ -12,7 +12,7 @@ from __future__ import annotations
 
 from blendjax import constants
 from blendjax.data.replay import FileRecorder
-from blendjax.transport import DataReceiverSocket
+from blendjax.transport import DataReceiverSocket, ReceiveTimeoutError
 from blendjax.utils.logging import get_logger
 
 logger = get_logger("data")
@@ -42,6 +42,7 @@ class RemoteStream:
         num_workers: int = 1,
         copy_arrays: bool = False,
         allow_pickle: bool = True,
+        on_timeout=None,
     ):
         if isinstance(addresses, str):
             addresses = [addresses]
@@ -56,6 +57,11 @@ class RemoteStream:
         self.num_workers = num_workers
         self.copy_arrays = copy_arrays
         self.allow_pickle = allow_pickle
+        # Failure-detection hook: called on a receive timeout; return True
+        # to keep waiting (e.g. after verifying/respawning producers via
+        # the launcher), False/None to fail fast like the reference
+        # (``dataset.py:98-99``).
+        self.on_timeout = on_timeout
 
     def enable_recording(self, prefix: str, max_messages: int | None = None):
         """(reference ``dataset.py:53-58``)"""
@@ -96,7 +102,12 @@ class RemoteStream:
                 ).__enter__()
             n = 0
             while limit is None or n < limit:
-                msg, raw = recv.recv(copy_arrays=self.copy_arrays)
+                try:
+                    msg, raw = recv.recv(copy_arrays=self.copy_arrays)
+                except ReceiveTimeoutError:
+                    if self.on_timeout is not None and self.on_timeout():
+                        continue
+                    raise
                 if recorder is not None:
                     recorder.save(raw)
                 yield self.item_transform(msg)

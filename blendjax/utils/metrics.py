@@ -1,0 +1,85 @@
+"""Lightweight metrics: counters, gauges, and timing spans.
+
+The reference has no metrics system (SURVEY.md §5 — only wall-clock in its
+benchmark harness); blendjax instruments the ingest pipeline so feed
+stalls are diagnosable: per-stage spans, queue-depth gauges, and a
+one-line report. For deep dives, ``trace`` wraps ``jax.profiler.trace``
+so the same code path emits a TensorBoard-loadable profile.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+from collections import defaultdict
+
+
+class Metrics:
+    """Process-local registry. Thread-safe enough for hot-loop use
+    (counter increments hold no lock; report() is approximate by design).
+    """
+
+    def __init__(self):
+        self.counters: dict = defaultdict(int)
+        self.gauges: dict = {}
+        self._spans: dict = defaultdict(lambda: [0, 0.0])  # count, total_s
+        self._lock = threading.Lock()
+
+    def count(self, name: str, n: int = 1) -> None:
+        self.counters[name] += n
+
+    def gauge(self, name: str, value) -> None:
+        self.gauges[name] = value
+
+    @contextlib.contextmanager
+    def span(self, name: str):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            dt = time.perf_counter() - t0
+            with self._lock:
+                s = self._spans[name]
+                s[0] += 1
+                s[1] += dt
+
+    def spans(self) -> dict:
+        with self._lock:
+            return {
+                k: {"count": c, "total_s": t, "mean_ms": (t / c * 1e3) if c else 0.0}
+                for k, (c, t) in self._spans.items()
+            }
+
+    def report(self) -> dict:
+        return {
+            "counters": dict(self.counters),
+            "gauges": dict(self.gauges),
+            "spans": self.spans(),
+        }
+
+    def reset(self) -> None:
+        with self._lock:
+            self.counters.clear()
+            self.gauges.clear()
+            self._spans.clear()
+
+
+# Default process-wide registry (imports stay cheap; no jax dependency).
+metrics = Metrics()
+
+
+@contextlib.contextmanager
+def trace(logdir: str):
+    """JAX profiler trace around a code block; view in TensorBoard/XProf.
+
+    >>> with trace("/tmp/profile"):
+    ...     for batch in pipeline: step(state, batch)
+    """
+    import jax
+
+    jax.profiler.start_trace(logdir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()

@@ -1,0 +1,39 @@
+"""Producer: physics scene with N cubes falling under gravity.
+
+Counterpart of the reference's ``examples/datagen/falling_cubes.blend.py``
+(rigid-body cubes dropped per episode, per-instance seeds randomize the
+drop). Episodes replay automatically: each episode re-randomizes positions
+from the instance seed stream.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from blendjax.producer import AnimationController, DataPublisher, parse_launch_args
+from blendjax.producer.sim import FallingCubesScene, SimEngine
+
+
+def main() -> None:
+    args, remainder = parse_launch_args(sys.argv)
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--shape", nargs=2, type=int, default=[480, 640])
+    parser.add_argument("--num-cubes", type=int, default=8)
+    parser.add_argument("--episode-frames", type=int, default=100)
+    opts = parser.parse_args(remainder)
+
+    pub = DataPublisher(args.btsockets["DATA"], btid=args.btid, lingerms=2000)
+    scene = FallingCubesScene(
+        shape=tuple(opts.shape), seed=args.btseed, num_cubes=opts.num_cubes
+    )
+    ctrl = AnimationController(SimEngine(scene))
+    ctrl.post_frame.add(lambda f: pub.publish(**scene.observation(f)))
+    try:
+        ctrl.play(frame_range=(1, opts.episode_frames), num_episodes=-1)
+    finally:
+        pub.close()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,38 @@
+"""Smallest end-to-end blendjax program.
+
+Counterpart of the reference's ``examples/datagen/minimal.py:6-29``:
+launch producers, iterate batches, print shapes — in blendjax the batches
+arrive as device arrays already sharded over the mesh.
+"""
+
+import os
+
+from blendjax.data import StreamDataPipeline
+from blendjax.launcher import PythonProducerLauncher
+from blendjax.parallel import batch_sharding, create_mesh
+
+
+def main():
+    producer = os.path.join(os.path.dirname(__file__), "cube_producer.py")
+    mesh = create_mesh({"data": -1})
+    with PythonProducerLauncher(
+        script=producer, num_instances=2, named_sockets=["DATA"], seed=10
+    ) as launcher:
+        with StreamDataPipeline(
+            launcher.addresses["DATA"],
+            batch_size=4,
+            sharding=batch_sharding(mesh),
+            launcher=launcher,
+        ) as pipe:
+            for i, batch in enumerate(pipe):
+                print(
+                    f"batch {i}: image{tuple(batch['image'].shape)} "
+                    f"xy{tuple(batch['xy'].shape)} on "
+                    f"{batch['image'].sharding}"
+                )
+                if i == 4:
+                    break
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,44 @@
+"""Install the blendjax producer package into Blender's bundled Python.
+
+Counterpart of the reference's ``scripts/install_btb.py:23-41`` (which
+pip-installs ``blendtorch.btb`` into Blender via the interpreter path
+Blender reports about itself). Run it THROUGH Blender so the right
+interpreter self-reports:
+
+    blender --background --python scripts/install_producer.py -- [--user]
+
+Installs blendjax plus the producer-side deps (pyzmq, msgpack, numpy);
+the JAX stack is intentionally NOT installed into Blender.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+
+def blender_python() -> str:
+    import bpy  # only importable when run through Blender
+
+    # Blender >= 2.91 exposes the interpreter via sys.executable; older
+    # builds report it as bpy.app.binary_path_python.
+    return getattr(bpy.app, "binary_path_python", None) or sys.executable
+
+
+def main() -> None:
+    py = blender_python()
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    args = sys.argv[sys.argv.index("--") + 1:] if "--" in sys.argv else []
+    cmd = [py, "-m", "pip", "install", *args, repo, "pyzmq", "msgpack"]
+    print("running:", " ".join(cmd))
+    subprocess.run(cmd, check=True)
+    out = subprocess.run(
+        [py, "-c", "import blendjax.producer, zmq; print('producer OK')"],
+        capture_output=True, text=True,
+    )
+    print(out.stdout or out.stderr)
+
+
+if __name__ == "__main__":
+    main()

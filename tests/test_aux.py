@@ -1,0 +1,119 @@
+"""Auxiliary subsystems: metrics, failure detection, respawn recovery."""
+
+import time
+
+import numpy as np
+import pytest
+
+from blendjax.transport import DataPublisherSocket, ReceiveTimeoutError
+from blendjax.utils.metrics import Metrics, metrics
+
+
+def test_metrics_counters_gauges_spans():
+    m = Metrics()
+    m.count("x")
+    m.count("x", 2)
+    m.gauge("depth", 7)
+    with m.span("work"):
+        time.sleep(0.01)
+    rep = m.report()
+    assert rep["counters"]["x"] == 3
+    assert rep["gauges"]["depth"] == 7
+    assert rep["spans"]["work"]["count"] == 1
+    assert rep["spans"]["work"]["mean_ms"] >= 5
+    m.reset()
+    assert m.report() == {"counters": {}, "gauges": {}, "spans": {}}
+
+
+def test_ingest_populates_default_metrics():
+    import threading
+
+    from blendjax.data import HostIngest, RemoteStream
+
+    metrics.reset()
+    pub = DataPublisherSocket("tcp://127.0.0.1:*", btid=0)
+    ingest = HostIngest(
+        RemoteStream([pub.addr], timeoutms=5000, max_items=4), batch_size=2
+    )
+    t = threading.Thread(
+        target=lambda: [
+            pub.publish(image=np.zeros((4, 4), np.uint8), frameid=i)
+            for i in range(4)
+        ],
+        daemon=True,
+    )
+    t.start()
+    assert len(list(ingest)) == 2
+    t.join(timeout=5)
+    rep = metrics.report()
+    assert rep["counters"]["ingest.items"] == 4
+    assert rep["counters"]["ingest.batches"] == 2
+    pub.close()
+
+
+def test_stream_on_timeout_retry_then_fail():
+    from blendjax.data import RemoteStream
+
+    pub = DataPublisherSocket("tcp://127.0.0.1:*", btid=0)
+    calls = []
+
+    def on_timeout():
+        calls.append(1)
+        return len(calls) < 3
+
+    stream = RemoteStream([pub.addr], timeoutms=50, on_timeout=on_timeout)
+    with pytest.raises(ReceiveTimeoutError):
+        next(iter(stream))
+    assert len(calls) == 3
+    pub.close()
+
+
+def test_pipeline_timeout_reports_dead_producer():
+    """With a launcher attached, a feed stall names the dead instance
+    instead of raising an opaque timeout."""
+    from blendjax.data import StreamDataPipeline
+    from blendjax.launcher import PythonProducerLauncher
+
+    with PythonProducerLauncher(
+        script="-c",
+        script_args=["import sys; sys.exit(7)"],
+        num_instances=1,
+    ) as launcher:
+        launcher.processes[0].wait(timeout=30)
+        addr = "tcp://127.0.0.1:49999"  # nothing listens; timeout path
+        with StreamDataPipeline(
+            [addr], batch_size=2, launcher=launcher, timeoutms=100
+        ) as pipe:
+            with pytest.raises(RuntimeError, match="died.*7"):
+                next(iter(pipe))
+
+
+def test_pipeline_respawn_keeps_stream_alive():
+    """respawn=True + launcher-integrated timeout: killing the producer
+    mid-stream recovers without consumer-visible failure."""
+    import os
+
+    from blendjax.data import StreamDataPipeline
+    from blendjax.launcher import PythonProducerLauncher
+
+    producer = os.path.join(
+        os.path.dirname(__file__), "..", "examples", "datagen",
+        "cube_producer.py",
+    )
+    with PythonProducerLauncher(
+        script=producer,
+        num_instances=1,
+        named_sockets=["DATA"],
+        respawn=True,
+        instance_args=[["--shape", "32", "32"]],
+    ) as launcher:
+        with StreamDataPipeline(
+            launcher.addresses["DATA"], batch_size=4,
+            launcher=launcher, timeoutms=3000,
+        ) as pipe:
+            it = iter(pipe)
+            next(it)
+            # kill the producer; respawn via the timeout path revives it
+            launcher.processes[0].terminate()
+            batch = next(it)
+            assert batch["image"].shape == (4, 32, 32, 4)
