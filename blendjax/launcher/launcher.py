@@ -154,8 +154,18 @@ class ProcessLauncher:
     def _spawn(self, argv):
         # Own session/process group so the whole producer tree can be
         # signalled together (reference launches in a new process group,
-        # ``launcher.py:124-132``).
-        return subprocess.Popen(argv, start_new_session=True)
+        # ``launcher.py:124-132``). Producer scripts import blendjax; make
+        # the package root importable in the child even when blendjax runs
+        # from a source checkout rather than site-packages (subprocess
+        # sys.path[0] is the script dir, not our cwd).
+        env = dict(os.environ)
+        pkg_root = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+        parts = [pkg_root] + [
+            p for p in env.get("PYTHONPATH", "").split(os.pathsep) if p
+        ]
+        env["PYTHONPATH"] = os.pathsep.join(dict.fromkeys(parts))
+        return subprocess.Popen(argv, start_new_session=True, env=env)
 
     @property
     def addresses(self) -> dict:
