@@ -8,6 +8,14 @@ multi-chip sharding environment the driver validates via
 
 import os
 
+# Child processes (producers, the blendjax-launch CLI) must import
+# blendjax from this source checkout even when spawned with a foreign
+# cwd; export the repo root so the whole process tree inherits it.
+_repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_pp = [p for p in os.environ.get("PYTHONPATH", "").split(os.pathsep) if p]
+if _repo_root not in _pp:
+    os.environ["PYTHONPATH"] = os.pathsep.join([_repo_root] + _pp)
+
 # Opt-in real-device runs: `BLENDJAX_TEST_TPU=1 pytest -m tpu` skips the
 # CPU-mesh override so tpu-marked tests really touch the device.
 if os.environ.get("BLENDJAX_TEST_TPU") != "1":
