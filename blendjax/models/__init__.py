@@ -16,7 +16,16 @@ JAX-native equivalents plus the models the TPU train loops need:
 
 from blendjax.models.cnn import CubeRegressor
 from blendjax.models.discriminator import Discriminator
+from blendjax.models.moe import MoEMLP, apply_with_aux, collect_aux_loss
 from blendjax.models.policy import PolicyValueNet
 from blendjax.models.transformer import StreamFormer
 
-__all__ = ["CubeRegressor", "Discriminator", "PolicyValueNet", "StreamFormer"]
+__all__ = [
+    "CubeRegressor",
+    "Discriminator",
+    "MoEMLP",
+    "apply_with_aux",
+    "collect_aux_loss",
+    "PolicyValueNet",
+    "StreamFormer",
+]

@@ -1,0 +1,107 @@
+"""Mixture-of-Experts MLP with expert parallelism over the ``expert`` axis.
+
+No reference counterpart (SURVEY.md §2.4: "Expert parallelism: none") —
+net-new, TPU-first design: Switch-Transformer-style top-1 routing with a
+static token capacity so every shape is known at trace time (XLA cannot
+tile dynamic shapes onto the MXU), dispatch/combine as einsums against a
+one-hot dispatch tensor (MXU-friendly, no gather/scatter), and expert
+weights stacked on a leading ``E`` dim that
+:func:`blendjax.parallel.sharding.param_sharding_rules` shards over the
+``expert`` mesh axis — GSPMD then inserts the all-to-alls between the
+data-sharded tokens and expert-sharded weights automatically.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+def collect_aux_loss(intermediates) -> jnp.ndarray:
+    """Sum every sown ``aux_loss`` in an ``intermediates`` collection."""
+    from jax import tree_util
+
+    total = jnp.zeros(())
+    for path, leaf in tree_util.tree_leaves_with_path(intermediates):
+        if "aux_loss" in tree_util.keystr(path):
+            total = total + jnp.sum(leaf)
+    return total
+
+
+def apply_with_aux(model, variables, *args, aux_weight: float = 1e-2,
+                   **kwargs):
+    """``model.apply`` that also returns the weighted MoE load-balancing
+    loss (Switch aux loss). Add it to the task loss — without it, top-1
+    routing can collapse onto one expert. Returns ``(out, aux)``."""
+    out, state = model.apply(
+        variables, *args, mutable=["intermediates"], **kwargs
+    )
+    return out, aux_weight * collect_aux_loss(state.get("intermediates", {}))
+
+
+class MoEMLP(nn.Module):
+    """Drop-in replacement for a transformer MLP block.
+
+    Input/output: ``(B, T, C)``. Tokens are routed top-1 to one of
+    ``num_experts`` expert MLPs (``C -> C*mlp_ratio -> C``); tokens over a
+    expert's capacity are dropped (their residual path passes through
+    unchanged, as in Switch Transformer).
+    """
+
+    num_experts: int
+    mlp_ratio: int = 4
+    capacity_factor: float = 1.25
+    dtype: type = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        b, t, c = x.shape
+        e = self.num_experts
+        n = b * t
+        cap = max(1, int(self.capacity_factor * n / e))
+        tokens = x.reshape(n, c)
+
+        # Router in f32 for a stable softmax.
+        logits = nn.Dense(e, dtype=jnp.float32, param_dtype=jnp.float32,
+                          name="router")(tokens.astype(jnp.float32))
+        probs = nn.softmax(logits, axis=-1)
+        expert_idx = jnp.argmax(probs, axis=-1)           # (N,)
+        gate = jnp.max(probs, axis=-1)                    # (N,)
+        onehot = nn.one_hot(expert_idx, e, dtype=jnp.float32)  # (N, E)
+
+        # Position of each token within its expert's queue; beyond-capacity
+        # tokens get dispatch weight 0 (dropped).
+        pos = jnp.cumsum(onehot, axis=0) * onehot - 1.0   # (N, E)
+        keep = (pos >= 0) & (pos < cap)
+        pos_oh = nn.one_hot(pos.astype(jnp.int32), cap, dtype=jnp.float32)
+        dispatch = onehot[..., None] * pos_oh * keep[..., None]  # (N, E, cap)
+
+        # Aux load-balancing loss (Switch eq. 4): mean fraction routed x
+        # mean router prob, per expert.
+        frac = onehot.mean(axis=0)
+        prob_mean = probs.mean(axis=0)
+        self.sow("intermediates", "aux_loss", e * jnp.sum(frac * prob_mean))
+
+        # Expert weights stacked on E: sharded over the ``expert`` mesh
+        # axis by param_sharding_rules (name-keyed).
+        h = c * self.mlp_ratio
+        w1 = self.param("expert_wi", nn.initializers.lecun_normal(),
+                        (e, c, h), jnp.float32)
+        b1 = self.param("expert_bi", nn.initializers.zeros, (e, h),
+                        jnp.float32)
+        w2 = self.param("expert_wo", nn.initializers.lecun_normal(),
+                        (e, h, c), jnp.float32)
+        b2 = self.param("expert_bo", nn.initializers.zeros, (e, c),
+                        jnp.float32)
+
+        xt = tokens.astype(self.dtype)
+        xe = jnp.einsum("nec,nd->ecd", dispatch.astype(self.dtype), xt)
+        he = nn.gelu(
+            jnp.einsum("ecd,edh->ech", xe, w1.astype(self.dtype))
+            + b1[:, None].astype(self.dtype)
+        )
+        ye = (jnp.einsum("ech,ehd->ecd", he, w2.astype(self.dtype))
+              + b2[:, None].astype(self.dtype))
+        combine = dispatch * gate[:, None, None]
+        y = jnp.einsum("nec,ecd->nd", combine.astype(self.dtype), ye)
+        return y.reshape(b, t, c)

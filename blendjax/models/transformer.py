@@ -66,6 +66,7 @@ class Block(nn.Module):
     seq_axis: str = "seq"
     batch_axis: str = "data"
     causal: bool = False
+    num_experts: int = 0  # >0: Switch-style MoE MLP (expert parallelism)
 
     @nn.compact
     def __call__(self, x):
@@ -77,10 +78,18 @@ class Block(nn.Module):
             batch_axis=self.batch_axis, causal=self.causal,
         )(y)
         y = nn.LayerNorm(dtype=jnp.float32)(x)
-        y = nn.Dense(c * self.mlp_ratio, dtype=self.dtype,
-                     param_dtype=jnp.float32)(y)
-        y = nn.gelu(y)
-        y = nn.Dense(c, dtype=self.dtype, param_dtype=jnp.float32)(y)
+        if self.num_experts > 0:
+            from blendjax.models.moe import MoEMLP
+
+            y = MoEMLP(
+                num_experts=self.num_experts, mlp_ratio=self.mlp_ratio,
+                dtype=self.dtype,
+            )(y)
+        else:
+            y = nn.Dense(c * self.mlp_ratio, dtype=self.dtype,
+                         param_dtype=jnp.float32)(y)
+            y = nn.gelu(y)
+            y = nn.Dense(c, dtype=self.dtype, param_dtype=jnp.float32)(y)
         return x + y
 
 
@@ -102,6 +111,8 @@ class StreamFormer(nn.Module):
     mesh: object = None
     seq_axis: str = "seq"
     batch_axis: str = "data"
+    num_experts: int = 0
+    moe_every: int = 2  # MoE MLP in every nth block (others stay dense)
 
     @nn.compact
     def __call__(self, images):
@@ -118,11 +129,16 @@ class StreamFormer(nn.Module):
             jnp.float32,
         )
         x = x + pos.astype(self.dtype)
-        for _ in range(self.depth):
+        for i in range(self.depth):
+            moe = (
+                self.num_experts
+                if self.num_experts > 0 and i % self.moe_every == 0
+                else 0
+            )
             x = Block(
                 self.num_heads, dtype=self.dtype, use_ring=self.use_ring,
                 mesh=self.mesh, seq_axis=self.seq_axis,
-                batch_axis=self.batch_axis,
+                batch_axis=self.batch_axis, num_experts=moe,
             )(x)
         x = nn.LayerNorm(dtype=jnp.float32)(x)
         x = x.mean(axis=1)

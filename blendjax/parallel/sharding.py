@@ -28,6 +28,9 @@ def replicated(mesh):
 def param_sharding_rules(mesh, path: tuple, value) -> "object":
     """Default parameter layout:
 
+    - ``expert`` axis: MoE parameters (name starts with ``expert_``,
+      leading dim = num_experts) split on dim 0 — expert parallelism;
+      GSPMD inserts the dispatch/combine all-to-alls.
     - ``tensor`` axis: dense/conv kernels split on their output-feature
       (last) dimension when divisible — Megatron-style column parallel.
     - ``fsdp`` axis: remaining large params split on their largest
@@ -37,6 +40,14 @@ def param_sharding_rules(mesh, path: tuple, value) -> "object":
     NamedSharding, P = _np()
     shape = getattr(value, "shape", ())
     spec = [None] * len(shape)
+    name = str(getattr(path[-1], "key", path[-1])) if path else ""
+    if (
+        "expert" in mesh.axis_names
+        and name.startswith("expert_")
+        and shape
+        and shape[0] % mesh.shape["expert"] == 0
+    ):
+        spec[0] = "expert"
     if len(shape) >= 2:
         if "tensor" in mesh.axis_names:
             tp = mesh.shape["tensor"]

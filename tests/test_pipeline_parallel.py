@@ -1,0 +1,211 @@
+"""Pipeline (pp) and expert (ep) parallelism — net-new vs the reference
+(SURVEY.md §2.4 lists both as absent). Runs on the virtual 8-device CPU
+mesh from conftest."""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from blendjax.parallel import (  # noqa: E402
+    create_mesh,
+    pipeline_apply,
+    shard_params,
+    stack_stage_params,
+)
+
+
+def _stage_fn(params, x):
+    # One shape-preserving MLP stage: x @ w + b, gelu.
+    return jax.nn.gelu(x @ params["w"] + params["b"])
+
+
+def _make_stages(key, n_stages, dim):
+    stages = []
+    for i in range(n_stages):
+        k = jax.random.fold_in(key, i)
+        stages.append({
+            "w": jax.random.normal(k, (dim, dim), jnp.float32) / np.sqrt(dim),
+            "b": jnp.zeros((dim,), jnp.float32),
+        })
+    return stages
+
+
+def _sequential(stages, x):
+    y = x
+    for p in stages:
+        y = _stage_fn(p, y)
+    return y
+
+
+def test_pipeline_matches_sequential():
+    n_stages, m, mb, dim = 4, 8, 2, 16
+    mesh = create_mesh({"pipe": n_stages}, devices=jax.devices()[:n_stages])
+    stages = _make_stages(jax.random.key(0), n_stages, dim)
+    stacked = stack_stage_params(stages)
+    x = jax.random.normal(jax.random.key(1), (m, mb, dim), jnp.float32)
+
+    y = pipeline_apply(_stage_fn, stacked, x, mesh, axis="pipe")
+    ref = jnp.stack([_sequential(stages, x[i]) for i in range(m)])
+    np.testing.assert_allclose(np.asarray(y), np.asarray(ref), rtol=2e-5,
+                               atol=2e-5)
+
+
+def test_pipeline_grads_match_sequential():
+    n_stages, m, mb, dim = 2, 4, 2, 8
+    mesh = create_mesh({"pipe": n_stages}, devices=jax.devices()[:n_stages])
+    stages = _make_stages(jax.random.key(2), n_stages, dim)
+    stacked = stack_stage_params(stages)
+    x = jax.random.normal(jax.random.key(3), (m, mb, dim), jnp.float32)
+
+    def loss_pipe(p):
+        return jnp.mean(pipeline_apply(_stage_fn, p, x, mesh) ** 2)
+
+    def loss_seq(p):
+        unstacked = [
+            jax.tree_util.tree_map(lambda a: a[i], p)
+            for i in range(n_stages)
+        ]
+        return jnp.mean(
+            jnp.stack([_sequential(unstacked, x[i]) for i in range(m)]) ** 2
+        )
+
+    g_pipe = jax.grad(loss_pipe)(stacked)
+    g_seq = jax.grad(loss_seq)(stacked)
+    for a, b in zip(jax.tree_util.tree_leaves(g_pipe),
+                    jax.tree_util.tree_leaves(g_seq)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=2e-4,
+                                   atol=2e-5)
+
+
+def test_pipeline_degenerate_no_axis():
+    # Mesh without a pipe axis: stages applied sequentially, same result.
+    n_stages, m, mb, dim = 3, 4, 2, 8
+    mesh = create_mesh({"data": 1}, devices=jax.devices()[:1])
+    stages = _make_stages(jax.random.key(4), n_stages, dim)
+    stacked = stack_stage_params(stages)
+    x = jax.random.normal(jax.random.key(5), (m, mb, dim), jnp.float32)
+    y = pipeline_apply(_stage_fn, stacked, x, mesh, axis="pipe")
+    ref = jnp.stack([_sequential(stages, x[i]) for i in range(m)])
+    np.testing.assert_allclose(np.asarray(y), np.asarray(ref), rtol=2e-5,
+                               atol=2e-5)
+
+
+def test_pipeline_composes_with_jit_and_data_axis():
+    # pipe x data mesh: batch sharded on data, stages on pipe, under jit.
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    mesh = create_mesh({"pipe": 2, "data": 2},
+                       devices=jax.devices()[:4])
+    stages = _make_stages(jax.random.key(6), 2, 8)
+    stacked = stack_stage_params(stages)
+    x = jax.random.normal(jax.random.key(7), (4, 4, 8), jnp.float32)
+    xs = jax.device_put(x, NamedSharding(mesh, P(None, "data")))
+
+    @jax.jit
+    def f(p, x):
+        return pipeline_apply(_stage_fn, p, x, mesh)
+
+    y = f(stacked, xs)
+    ref = jnp.stack([_sequential(stages, x[i]) for i in range(4)])
+    np.testing.assert_allclose(np.asarray(y), np.asarray(ref), rtol=2e-5,
+                               atol=2e-5)
+    # Batch stays sharded on 'data' through the pipeline (no gather).
+    assert "data" in str(y.sharding.spec)
+
+
+def test_pipeline_rejects_stage_count_mismatch():
+    mesh = create_mesh({"pipe": 2}, devices=jax.devices()[:2])
+    stages = _make_stages(jax.random.key(8), 4, 8)  # 4 stages, pipe=2
+    stacked = stack_stage_params(stages)
+    x = jax.random.normal(jax.random.key(9), (4, 2, 8), jnp.float32)
+    with pytest.raises(AssertionError, match="leading dim"):
+        pipeline_apply(_stage_fn, stacked, x, mesh, axis="pipe")
+
+
+# ---------------------------------------------------------------------------
+# Expert parallelism (MoE)
+
+
+def test_moe_routes_all_tokens_with_ample_capacity():
+    from blendjax.models import MoEMLP
+
+    model = MoEMLP(num_experts=4, capacity_factor=4.0, dtype=jnp.float32)
+    x = jax.random.normal(jax.random.key(8), (2, 16, 32), jnp.float32)
+    params = model.init(jax.random.key(9), x)["params"]
+    y, state = model.apply({"params": params}, x,
+                           mutable=["intermediates"])
+    assert y.shape == x.shape
+    assert np.isfinite(np.asarray(y)).all()
+    aux = state["intermediates"]["aux_loss"][0]
+    # Balanced-ish routing keeps the Switch aux loss near 1.
+    assert 0.5 < float(aux) < 4.0
+
+
+def test_moe_expert_sharded_step_runs():
+    # data x expert mesh; expert_* params sharded on the expert axis.
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from blendjax.models import MoEMLP
+    from blendjax.parallel import param_sharding_rules
+
+    mesh = create_mesh({"data": 2, "expert": 4},
+                       devices=jax.devices()[:8])
+    model = MoEMLP(num_experts=4, dtype=jnp.float32)
+    x = np.random.default_rng(0).normal(size=(8, 16, 32)).astype(np.float32)
+    params = model.init(jax.random.key(10), x)["params"]
+    params = shard_params(mesh, params)
+    # The stacked expert weights must actually land on the expert axis.
+    wi = params["expert_wi"]
+    assert "expert" in str(wi.sharding.spec)
+
+    xs = jax.device_put(x, NamedSharding(mesh, P("data")))
+
+    @jax.jit
+    def loss(p, x):
+        return jnp.mean(model.apply({"params": p}, x) ** 2)
+
+    l, g = jax.value_and_grad(loss)(params, xs)
+    assert np.isfinite(float(l))
+    assert all(
+        np.isfinite(np.asarray(a)).all()
+        for a in jax.tree_util.tree_leaves(g)
+    )
+
+
+def test_moe_aux_loss_reaches_gradients():
+    from blendjax.models import MoEMLP, apply_with_aux
+
+    model = MoEMLP(num_experts=4, dtype=jnp.float32)
+    x = jax.random.normal(jax.random.key(12), (2, 16, 32), jnp.float32)
+    params = model.init(jax.random.key(13), x)["params"]
+
+    def loss(p):
+        out, aux = apply_with_aux(model, {"params": p}, x)
+        return jnp.mean(out**2) + aux
+
+    def loss_no_aux(p):
+        return jnp.mean(model.apply({"params": p}, x) ** 2)
+
+    _, aux = apply_with_aux(model, {"params": params}, x)
+    assert float(aux) > 0.0
+    g = jax.grad(loss)(params)["router"]["kernel"]
+    g0 = jax.grad(loss_no_aux)(params)["router"]["kernel"]
+    # The aux term changes the router's gradient (balancing pressure).
+    assert not np.allclose(np.asarray(g), np.asarray(g0))
+
+
+def test_streamformer_with_moe_blocks():
+    from blendjax.models import StreamFormer
+
+    model = StreamFormer(patch=8, dim=32, depth=2, num_heads=4,
+                         num_outputs=16, num_experts=2,
+                         dtype=jnp.float32)
+    images = np.zeros((2, 32, 32, 4), np.uint8)
+    params = model.init(jax.random.key(11), images)["params"]
+    out = model.apply({"params": params}, images)
+    assert out.shape == (2, 16)
+    # MoE blocks really exist: expert-stacked weights present in block 0.
+    flat = jax.tree_util.tree_leaves_with_path(params)
+    assert any("expert_wi" in jax.tree_util.keystr(p) for p, _ in flat)
