@@ -55,7 +55,7 @@ def load_rasterizer():
     """Returns ``(fill, clear)`` native functions or None.
 
     ``fill(px f64[n,3,2], depth f64[n,3], rgba u8[n,4], n, color u8[h,w,4],
-    zbuf f64[h,w], h, w)``; ``clear(color, zbuf, h, w, rgba u8[4])``.
+    zbuf f32[h,w], h, w)``; ``clear(color, zbuf, h, w, rgba u8[4])``.
     """
     if os.environ.get("BLENDJAX_NO_NATIVE") == "1":
         return None
@@ -66,17 +66,18 @@ def load_rasterizer():
                 _CACHE["rasterizer"] = None
             else:
                 u8p = ctypes.POINTER(ctypes.c_uint8)
+                f32p = ctypes.POINTER(ctypes.c_float)
                 f64p = ctypes.POINTER(ctypes.c_double)
                 fill = lib.bjx_fill_triangles
                 fill.restype = None
                 fill.argtypes = [
                     f64p, f64p, u8p, ctypes.c_int64,
-                    u8p, f64p, ctypes.c_int64, ctypes.c_int64,
+                    u8p, f32p, ctypes.c_int64, ctypes.c_int64,
                 ]
                 clear = lib.bjx_clear
                 clear.restype = None
                 clear.argtypes = [
-                    u8p, f64p, ctypes.c_int64, ctypes.c_int64, u8p,
+                    u8p, f32p, ctypes.c_int64, ctypes.c_int64, u8p,
                 ]
                 _CACHE["rasterizer"] = (fill, clear)
         return _CACHE["rasterizer"]

@@ -96,36 +96,93 @@ class HostIngest:
 
     # -- thread body --------------------------------------------------------
 
+    @staticmethod
+    def _batched_views(item: dict):
+        """Per-item views of a producer-batched message (``_batched=True``:
+        every ndarray field carries a leading batch dim)."""
+        lead = next(
+            (v.shape[0] for v in item.values() if isinstance(v, np.ndarray)),
+            0,
+        )
+        for i in range(lead):
+            yield {
+                k: v[i]
+                if isinstance(v, np.ndarray) and v.shape[:1] == (lead,)
+                else v
+                for k, v in item.items()
+            }
+
+    def _passthrough(self, item: dict):
+        """A producer-batched item whose leading dim equals ``batch_size``
+        and whose fields match the schema is already a batch: hand it on
+        with zero copies (the batch-publishing producer's fast path)."""
+        for k, spec in self.schema.fields.items():
+            v = item.get(k)
+            if not (
+                isinstance(v, np.ndarray)
+                and v.shape == (self.batch_size, *spec.shape)
+                and v.dtype == spec.dtype
+            ):
+                return None
+        batch = {k: item[k] for k in self.schema.fields}
+        meta = {k: item[k] for k in self.schema.meta_keys if k in item}
+        batch["_meta"] = [
+            {
+                k: v[i]
+                if isinstance(v, np.ndarray) and len(v) == self.batch_size
+                else v
+                for k, v in meta.items()
+            }
+            for i in range(self.batch_size)
+        ]
+        return batch
+
+    def _emit(self, batch) -> None:
+        metrics.gauge("ingest.queue_depth", self._queue.qsize())
+        while not self._stop.is_set():
+            try:
+                self._queue.put(batch, timeout=0.25)
+                self.batches_out += 1
+                metrics.count("ingest.batches")
+                break
+            except queue.Full:
+                metrics.count("ingest.queue_full_waits")
+                continue
+
     def _run(self):
         try:
             assembler = None
             for item in self.stream:
                 if self._stop.is_set():
                     break
+                batched = bool(item.pop("_batched", False))
                 if self.schema is None:
-                    self.schema = StreamSchema.infer(item)
+                    first = next(self._batched_views(item)) if batched else item
+                    self.schema = StreamSchema.infer(first)
                     logger.info("inferred stream schema: %s", self.schema)
                 if assembler is None:
                     assembler = BatchAssembler(
                         self.schema, self.batch_size,
                         num_buffers=self.prefetch + 1,
                     )
-                if self.items_in % self.validate_every == 0:
-                    self.schema.validate(item)
-                self.items_in += 1
-                metrics.count("ingest.items")
-                batch = assembler.add(item)
-                if batch is not None:
-                    metrics.gauge("ingest.queue_depth", self._queue.qsize())
-                    while not self._stop.is_set():
-                        try:
-                            self._queue.put(batch, timeout=0.25)
-                            self.batches_out += 1
-                            metrics.count("ingest.batches")
-                            break
-                        except queue.Full:
-                            metrics.count("ingest.queue_full_waits")
-                            continue
+                if batched:
+                    whole = self._passthrough(item)
+                    if whole is not None:
+                        self.items_in += self.batch_size
+                        metrics.count("ingest.items", self.batch_size)
+                        self._emit(whole)
+                        continue
+                    items = self._batched_views(item)  # size mismatch: split
+                else:
+                    items = (item,)
+                for one in items:
+                    if self.items_in % self.validate_every == 0:
+                        self.schema.validate(one)
+                    self.items_in += 1
+                    metrics.count("ingest.items")
+                    batch = assembler.add(one)
+                    if batch is not None:
+                        self._emit(batch)
         except BaseException as e:  # propagate into the consumer thread
             self._error = e
         finally:

@@ -36,12 +36,22 @@ class DeviceFeeder:
     - a dict ``key -> Sharding`` for per-field layouts.
 
     ``_meta`` (per-item provenance like ``btid``) stays on host.
+
+    ``throttle=True`` (default) waits for the oldest in-flight transfer to
+    finish before yielding it. Host->device copies still overlap ingest and
+    compute (the ring keeps ``prefetch`` transfers ahead), but the transfer
+    queue can never grow beyond the ring: on tunneled/remote device
+    hosts, unbounded queues of multi-MB transfers degrade per-transfer
+    latency by 5-10x (measured on a TPU-over-network host), so bounding
+    them is strictly faster end to end.
     """
 
-    def __init__(self, sharding=None, prefetch: int = 2, multihost: bool = False):
+    def __init__(self, sharding=None, prefetch: int = 2, multihost: bool = False,
+                 throttle: bool = True):
         self.sharding = sharding
         self.prefetch = max(1, int(prefetch))
         self.multihost = multihost
+        self.throttle = throttle
 
     def _place(self, batch: dict) -> dict:
         jax = _require_jax()
@@ -63,6 +73,15 @@ class DeviceFeeder:
                 out[k] = jax.device_put(v, s)
         return out
 
+    def _pop(self, ring):
+        batch = ring.popleft()
+        if self.throttle:
+            jax = _require_jax()
+            for k, v in batch.items():
+                if k != "_meta":
+                    jax.block_until_ready(v)
+        return batch
+
     def __call__(self, host_batches):
         """Iterate device batches, keeping ``prefetch`` transfers in flight
         ahead of the consumer (flax-style prefetch ring)."""
@@ -75,9 +94,9 @@ class DeviceFeeder:
                         ring.append(self._place(next(it)))
                     except StopIteration:
                         while ring:
-                            yield ring.popleft()
+                            yield self._pop(ring)
                         return
-                yield ring.popleft()
+                yield self._pop(ring)
         finally:
             ring.clear()
 

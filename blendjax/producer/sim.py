@@ -83,10 +83,10 @@ class Rasterizer:
 
     def __init__(self, shape=(480, 640), background=(0, 0, 0, 255)):
         self.shape = (int(shape[0]), int(shape[1]))
-        self.background = np.array(background, np.uint8)
+        self.background = np.ascontiguousarray(background, np.uint8)
         h, w = self.shape
         self._color = np.empty((h, w, 4), np.uint8)
-        self._depth = np.empty((h, w), np.float64)
+        self._depth = np.empty((h, w), np.float32)
         self._light = np.array([0.4, -0.35, 0.85])
         self._light = self._light / np.linalg.norm(self._light)
         from blendjax._native import load_rasterizer
@@ -94,27 +94,39 @@ class Rasterizer:
         native = load_rasterizer()
         self._native_fill, self._native_clear = native or (None, None)
 
-    def render(self, camera: Camera, triangles, colors) -> np.ndarray:
+    def render(self, camera: Camera, triangles, colors, out=None) -> np.ndarray:
         """Render world-space ``triangles`` (N,3,3) filled with ``colors``
         (N,3|4 uint8); returns HxWx4 uint8 (origin upper-left, like the
-        reference's flipped GL readback, ``offscreen.py:95-96``)."""
+        reference's flipped GL readback, ``offscreen.py:95-96``).
+
+        With ``out`` (contiguous HxWx4 uint8, e.g. a slot of a batch
+        buffer) pixels are written there directly and no copy is made —
+        the zero-copy path for batched producers."""
         h, w = self.shape
+        if out is None:
+            target = self._color
+        else:
+            target = out
+            assert (
+                target.shape == (h, w, 4)
+                and target.dtype == np.uint8
+                and target.flags.c_contiguous
+            ), "out must be contiguous (h, w, 4) uint8"
         if self._native_clear is not None:
             import ctypes
 
-            bg = np.ascontiguousarray(self.background)
             self._native_clear(
-                self._color.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
-                self._depth.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+                target.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+                self._depth.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
                 h, w,
-                bg.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+                self.background.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
             )
         else:
-            self._color[:] = self.background
+            target[:] = self.background
             self._depth[:] = np.inf
         triangles = np.asarray(triangles, np.float64)
         if triangles.size == 0:
-            return self._color.copy()
+            return target.copy() if out is None else target
         colors = np.asarray(colors)
         if colors.shape[1] == 3:
             colors = np.concatenate(
@@ -138,14 +150,14 @@ class Rasterizer:
 
         visible = ~np.any(depth <= camera.clip_near, axis=1)
         if self._native_fill is not None:
-            self._render_native(px[visible], depth[visible],
+            self._render_native(target, px[visible], depth[visible],
                                 colors[visible], shade[visible])
         else:
             for i in np.nonzero(visible)[0]:
-                self._fill(px[i], depth[i], colors[i], shade[i])
-        return self._color.copy()
+                self._fill(target, px[i], depth[i], colors[i], shade[i])
+        return target.copy() if out is None else target
 
-    def _render_native(self, px, depth, colors, shade):
+    def _render_native(self, target, px, depth, colors, shade):
         import ctypes
 
         n = len(px)
@@ -162,12 +174,12 @@ class Rasterizer:
             depth.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
             rgba.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
             n,
-            self._color.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
-            self._depth.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+            target.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+            self._depth.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
             h, w,
         )
 
-    def _fill(self, tri_px, tri_depth, color, shade):
+    def _fill(self, target, tri_px, tri_depth, color, shade):
         h, w = self.shape
         xmin = max(int(np.floor(tri_px[:, 0].min())), 0)
         xmax = min(int(np.ceil(tri_px[:, 0].max())) + 1, w)
@@ -190,9 +202,11 @@ class Rasterizer:
             return
         # Screen-space affine depth interpolation (adequate for annotation
         # ground truth; not perspective-correct).
-        z = w0 * tri_depth[0] + w1 * tri_depth[1] + w2 * tri_depth[2]
+        z = (w0 * tri_depth[0] + w1 * tri_depth[1] + w2 * tri_depth[2]).astype(
+            np.float32
+        )
         zbuf = self._depth[ymin:ymax, xmin:xmax]
-        cbuf = self._color[ymin:ymax, xmin:xmax]
+        cbuf = target[ymin:ymax, xmin:xmax]
         closer = inside & (z < zbuf)
         if not closer.any():
             return
@@ -256,18 +270,26 @@ class CubeScene(SimScene):
 
         return cube_vertices((0, 0, 0), self.half_extent) @ self.rotation.T
 
-    def render(self) -> np.ndarray:
+    def render(self, out=None) -> np.ndarray:
         tris, faces = cube_triangles((0, 0, 0), self.half_extent, self.rotation)
         base = self.color.astype(np.float64)
         # slight per-face tint so orientation is visually distinct
         tint = 1.0 - 0.08 * (faces % 3)
         colors = np.clip(base[None, :] * tint[:, None], 0, 255).astype(np.uint8)
-        return self.raster.render(self.camera, tris, colors)
+        return self.raster.render(self.camera, tris, colors, out=out)
 
     def observation(self, frame: int) -> dict:
         img = self.render()
         xy = self.camera.world_to_pixel(self.corners_world())
         return {"image": img, "xy": xy.astype(np.float32), "frameid": frame}
+
+    def observation_into(self, frame: int, buf: dict, i: int) -> None:
+        """Write frame ``frame``'s observation into slot ``i`` of a batch
+        buffer dict (``image`` (B,H,W,4) u8, ``xy`` (B,8,2) f32, ``frameid``
+        (B,) i64) — the zero-copy path for batch-publishing producers."""
+        self.render(out=buf["image"][i])
+        buf["xy"][i] = self.camera.world_to_pixel(self.corners_world())
+        buf["frameid"][i] = frame
 
 
 class FallingCubesScene(SimScene):

@@ -9,12 +9,18 @@ the producer used by ``bench.py``. Launch it with
 Usage flags (passed via ``instance_args``):
   --shape H W      image size (default 480 640)
   --frames N       stop after N frames (default: run forever)
+  --batch B        publish one (B, H, W, 4) message per B frames instead of
+                   B per-frame messages (renders straight into the batch
+                   buffer; the consumer's ingest passes full batches
+                   through without re-assembly)
 """
 
 from __future__ import annotations
 
 import argparse
 import sys
+
+import numpy as np
 
 from blendjax.producer import AnimationController, DataPublisher, parse_launch_args
 from blendjax.producer.sim import CubeScene, SimEngine
@@ -25,21 +31,70 @@ def main() -> None:
     parser = argparse.ArgumentParser()
     parser.add_argument("--shape", nargs=2, type=int, default=[480, 640])
     parser.add_argument("--frames", type=int, default=-1)
+    parser.add_argument("--batch", type=int, default=1)
     opts = parser.parse_args(remainder)
 
-    pub = DataPublisher(args.btsockets["DATA"], btid=args.btid, lingerms=2000)
     scene = CubeScene(shape=tuple(opts.shape), seed=args.btseed)
     ctrl = AnimationController(SimEngine(scene))
+    flush = None
 
-    def publish(frame: int) -> None:
-        pub.publish(**scene.observation(frame))
-        if 0 < opts.frames <= frame:
-            ctrl.cancel()
+    if opts.batch > 1:
+        # publish(copy=False) hands buffers to the socket by reference and
+        # they stay referenced until the IO thread has written them, so the
+        # rotating pool must outlast the send queue: a small HWM (batch
+        # messages are ~10MB; 2 batches of queue ≈ the reference's 10-item
+        # HWM at batch 8) and pool size HWM+2 (queued + one in flight + one
+        # being rendered).
+        send_hwm = 2
+        pub = DataPublisher(
+            args.btsockets["DATA"], btid=args.btid, lingerms=2000,
+            send_hwm=send_hwm,
+        )
+        b, (h, w) = opts.batch, opts.shape
+        pool = [
+            {
+                "image": np.empty((b, h, w, 4), np.uint8),
+                "xy": np.empty((b, 8, 2), np.float32),
+                "frameid": np.empty((b,), np.int64),
+            }
+            for _ in range(send_hwm + 2)
+        ]
+        cursor = {"slot": 0, "i": 0}
+
+        def publish(frame: int) -> None:
+            buf = pool[cursor["slot"]]
+            scene.observation_into(frame, buf, cursor["i"])
+            cursor["i"] += 1
+            if cursor["i"] == b:
+                pub.publish(_batched=True, **buf)
+                cursor["i"] = 0
+                cursor["slot"] = (cursor["slot"] + 1) % len(pool)
+            if 0 < opts.frames <= frame:
+                ctrl.cancel()
+
+        def flush() -> None:
+            # Tail frames of a partial batch (--frames not a multiple of
+            # --batch): ship the filled prefix; the consumer's ingest
+            # re-batches mismatched sizes.
+            i = cursor["i"]
+            if i > 0:
+                buf = pool[cursor["slot"]]
+                pub.publish(_batched=True, **{k: v[:i] for k, v in buf.items()})
+
+    else:
+        pub = DataPublisher(args.btsockets["DATA"], btid=args.btid, lingerms=2000)
+
+        def publish(frame: int) -> None:
+            pub.publish(**scene.observation(frame))
+            if 0 < opts.frames <= frame:
+                ctrl.cancel()
 
     ctrl.post_frame.add(publish)
     end = opts.frames if opts.frames > 0 else 2_147_483_647
     try:
         ctrl.play(frame_range=(1, end), num_episodes=-1)
+        if flush is not None:
+            flush()
     finally:
         pub.close()
 

@@ -207,3 +207,72 @@ def test_host_ingest_schema_mismatch_fails_fast():
         list(ingest)
     t.join(timeout=10)
     pub.close()
+
+
+# -- producer-side batching --------------------------------------------------
+
+
+def _batched_item(start, b, h=4, w=6):
+    return {
+        "btid": 0,
+        "_batched": True,
+        "image": np.stack([np.full((h, w, 4), (start + i) % 255, np.uint8)
+                           for i in range(b)]),
+        "xy": np.stack([np.full((8, 2), float(start + i), np.float32)
+                        for i in range(b)]),
+        "frameid": np.arange(start, start + b, dtype=np.int64),
+    }
+
+
+def test_host_ingest_passthrough_of_producer_batches():
+    """A (B, ...) message with B == batch_size becomes a batch with zero
+    re-assembly; _meta carries the shared btid per item."""
+    pub = DataPublisherSocket(WILD, btid=0)
+    stream = RemoteStream([pub.addr], timeoutms=2000)
+    ingest = HostIngest(stream, batch_size=4, prefetch=2)
+    t = _publish_async(pub, [_batched_item(0, 4), _batched_item(4, 4)])
+    it = iter(ingest)
+    b1, b2 = next(it), next(it)
+    assert b1["image"].shape == (4, 4, 6, 4)
+    got = set(b1["frameid"]) | set(b2["frameid"])
+    assert got == set(range(8))
+    assert [m["btid"] for m in b1["_meta"]] == [0] * 4
+    assert ingest.items_in == 8
+    t.join(timeout=10)
+    ingest.stop()
+    pub.close()
+
+
+def test_host_ingest_rebatches_mismatched_producer_batches():
+    """Producer batch size 3 != consumer batch size 2: items are split and
+    re-assembled, nothing lost."""
+    pub = DataPublisherSocket(WILD, btid=0)
+    stream = RemoteStream([pub.addr], timeoutms=2000)
+    ingest = HostIngest(stream, batch_size=2, prefetch=3)
+    t = _publish_async(pub, [_batched_item(0, 3), _batched_item(3, 3)])
+    it = iter(ingest)
+    frames = []
+    for _ in range(3):
+        b = next(it)
+        assert b["image"].shape == (2, 4, 6, 4)
+        frames.extend(b["frameid"].tolist())
+    assert sorted(frames) == list(range(6))
+    t.join(timeout=10)
+    ingest.stop()
+    pub.close()
+
+
+def test_host_ingest_mixed_batched_and_single_producers():
+    """Schema inferred from a batched message matches per-item messages, so
+    a mixed fleet interleaves cleanly."""
+    pub = DataPublisherSocket(WILD, btid=0)
+    stream = RemoteStream([pub.addr], timeoutms=2000)
+    ingest = HostIngest(stream, batch_size=4)
+    msgs = [_batched_item(0, 4), _item(4), _item(5), _item(6), _item(7)]
+    t = _publish_async(pub, msgs)
+    it = iter(ingest)
+    b1, b2 = next(it), next(it)
+    assert sorted([*b1["frameid"], *b2["frameid"]]) == list(range(8))
+    t.join(timeout=10)
+    ingest.stop()
+    pub.close()

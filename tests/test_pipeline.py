@@ -76,3 +76,27 @@ def test_stream_pipeline_end_to_end_with_producers():
                     break
             assert pipe.queue_depth() >= 0
     assert seen_btids == {0, 1}
+
+
+def test_batched_producer_end_to_end_and_tail_flush():
+    """--batch mode: producer publishes (B, ...) messages; a --frames count
+    that is not a multiple of --batch still delivers every frame (the tail
+    partial batch is flushed at shutdown and re-batched by ingest)."""
+    from blendjax.data import RemoteStream
+    from blendjax.launcher import PythonProducerLauncher
+
+    with PythonProducerLauncher(
+        script=PRODUCER,
+        num_instances=1,
+        named_sockets=["DATA"],
+        seed=1,
+        instance_args=[["--shape", "32", "32", "--batch", "4", "--frames", "10"]],
+    ) as launcher:
+        stream = RemoteStream(
+            launcher.addresses["DATA"], timeoutms=20000, max_items=3
+        )
+        frames = []
+        for msg in stream:
+            assert msg["_batched"] is True
+            frames.extend(msg["frameid"].tolist())
+        assert sorted(frames) == list(range(1, 11))
