@@ -101,7 +101,11 @@ class HostIngest:
         """Per-item views of a producer-batched message (``_batched=True``:
         every ndarray field carries a leading batch dim)."""
         lead = next(
-            (v.shape[0] for v in item.values() if isinstance(v, np.ndarray)),
+            (
+                v.shape[0]
+                for v in item.values()
+                if isinstance(v, np.ndarray) and v.ndim > 0
+            ),
             0,
         )
         for i in range(lead):
@@ -157,7 +161,17 @@ class HostIngest:
                     break
                 batched = bool(item.pop("_batched", False))
                 if self.schema is None:
-                    first = next(self._batched_views(item)) if batched else item
+                    if batched:
+                        first = next(self._batched_views(item), None)
+                        if first is None:
+                            from blendjax.data.schema import SchemaError
+
+                            raise SchemaError(
+                                "batched message has no array field with a "
+                                f"leading batch dim (keys: {sorted(item)})"
+                            )
+                    else:
+                        first = item
                     self.schema = StreamSchema.infer(first)
                     logger.info("inferred stream schema: %s", self.schema)
                 if assembler is None:

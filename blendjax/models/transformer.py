@@ -21,6 +21,7 @@ import jax.numpy as jnp
 
 from blendjax.ops.image import maybe_normalize_uint8
 from blendjax.parallel.ring import reference_attention, ring_attention
+from blendjax.parallel.ulysses import ulysses_attention
 
 
 class MultiHeadAttention(nn.Module):
@@ -31,6 +32,7 @@ class MultiHeadAttention(nn.Module):
     seq_axis: str = "seq"
     batch_axis: str = "data"
     causal: bool = False
+    sp_mode: str = "ring"  # 'ring' | 'ulysses' (when use_ring=True)
 
     @nn.compact
     def __call__(self, x):
@@ -44,9 +46,19 @@ class MultiHeadAttention(nn.Module):
         q, k, v = (qkv[:, :, i] for i in range(3))  # (B, T, H, D)
         # softmax math in f32 for stability
         q, k, v = (a.astype(jnp.float32) for a in (q, k, v))
-        if self.use_ring:
-            assert self.mesh is not None, "ring attention needs a mesh"
-            o = ring_attention(
+        assert self.sp_mode in ("ring", "ulysses"), (
+            f"unknown sp_mode {self.sp_mode!r}; use 'ring' or 'ulysses'"
+        )
+        # use_ring gates sequence parallelism for back-compat; explicitly
+        # requesting the non-default strategy also enables it.
+        use_sp = self.use_ring or self.sp_mode == "ulysses"
+        if use_sp:
+            assert self.mesh is not None, "sequence parallelism needs a mesh"
+            sp_attn = (
+                ulysses_attention if self.sp_mode == "ulysses"
+                else ring_attention
+            )
+            o = sp_attn(
                 q, k, v, self.mesh, axis=self.seq_axis,
                 causal=self.causal, batch_axis=self.batch_axis,
             )
@@ -67,6 +79,7 @@ class Block(nn.Module):
     batch_axis: str = "data"
     causal: bool = False
     num_experts: int = 0  # >0: Switch-style MoE MLP (expert parallelism)
+    sp_mode: str = "ring"
 
     @nn.compact
     def __call__(self, x):
@@ -76,6 +89,7 @@ class Block(nn.Module):
             self.num_heads, dtype=self.dtype, use_ring=self.use_ring,
             mesh=self.mesh, seq_axis=self.seq_axis,
             batch_axis=self.batch_axis, causal=self.causal,
+            sp_mode=self.sp_mode,
         )(y)
         y = nn.LayerNorm(dtype=jnp.float32)(x)
         if self.num_experts > 0:
@@ -113,6 +127,7 @@ class StreamFormer(nn.Module):
     batch_axis: str = "data"
     num_experts: int = 0
     moe_every: int = 2  # MoE MLP in every nth block (others stay dense)
+    sp_mode: str = "ring"  # sequence-parallel strategy: 'ring' | 'ulysses'
 
     @nn.compact
     def __call__(self, images):
@@ -139,6 +154,7 @@ class StreamFormer(nn.Module):
                 self.num_heads, dtype=self.dtype, use_ring=self.use_ring,
                 mesh=self.mesh, seq_axis=self.seq_axis,
                 batch_axis=self.batch_axis, num_experts=moe,
+                sp_mode=self.sp_mode,
             )(x)
         x = nn.LayerNorm(dtype=jnp.float32)(x)
         x = x.mean(axis=1)

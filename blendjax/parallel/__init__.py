@@ -21,6 +21,7 @@ from blendjax.parallel.collectives import (
     ring_permute,
 )
 from blendjax.parallel.ring import ring_attention
+from blendjax.parallel.ulysses import ulysses_attention
 from blendjax.parallel.pipeline import pipeline_apply, stack_stage_params
 
 __all__ = [
@@ -35,6 +36,7 @@ __all__ = [
     "all_reduce_sum",
     "ring_permute",
     "ring_attention",
+    "ulysses_attention",
     "pipeline_apply",
     "stack_stage_params",
 ]

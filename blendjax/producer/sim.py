@@ -107,11 +107,18 @@ class Rasterizer:
             target = self._color
         else:
             target = out
-            assert (
+            # Raise (not assert): the raw pointer goes to native code, so
+            # the check must survive ``python -O``.
+            if not (
                 target.shape == (h, w, 4)
                 and target.dtype == np.uint8
                 and target.flags.c_contiguous
-            ), "out must be contiguous (h, w, 4) uint8"
+            ):
+                raise ValueError(
+                    f"out must be contiguous ({h}, {w}, 4) uint8; got "
+                    f"shape={target.shape} dtype={target.dtype} "
+                    f"contiguous={target.flags.c_contiguous}"
+                )
         if self._native_clear is not None:
             import ctypes
 

@@ -132,6 +132,20 @@ class DataPublisherSocket(_Channel):
             encode_message(data, codec=self.codec), copy=self.copy
         )
 
+    def publish_tracked(self, **kwargs):
+        """Zero-copy publish returning a ``zmq.MessageTracker``.
+
+        ``tracker.done`` flips True once the IO thread no longer references
+        the payload buffers, so a producer rotating a fixed buffer pool can
+        ``tracker.wait()`` before rendering into a slot again. Unlike
+        HWM-based pool sizing this bounds buffer reuse for *any* number of
+        connected consumers: PUSH keeps one queue per pipe, so per-pipe HWM
+        alone does not cap the total number of in-flight messages."""
+        data = {"btid": self.btid, **kwargs}
+        return self.sock.send_multipart(
+            encode_message(data, codec=self.codec), copy=False, track=True
+        )
+
 
 
 class DataReceiverSocket(_Channel):

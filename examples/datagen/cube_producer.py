@@ -39,12 +39,14 @@ def main() -> None:
     flush = None
 
     if opts.batch > 1:
-        # publish(copy=False) hands buffers to the socket by reference and
-        # they stay referenced until the IO thread has written them, so the
-        # rotating pool must outlast the send queue: a small HWM (batch
-        # messages are ~10MB; 2 batches of queue ≈ the reference's 10-item
-        # HWM at batch 8) and pool size HWM+2 (queued + one in flight + one
-        # being rendered).
+        # Zero-copy batch pool: publish_tracked hands buffers to the socket
+        # by reference and returns a zmq MessageTracker; a slot is rendered
+        # into again only after its tracker reports the IO thread is done
+        # with it. This bounds buffer reuse for any number of connected
+        # consumers (per-pipe SNDHWM alone would not: PUSH queues per pipe).
+        # A small HWM still provides backpressure (batch messages are
+        # ~10MB; 2 batches of queue ≈ the reference's 10-item HWM at
+        # batch 8); pool size HWM+2 = queued + in flight + being rendered.
         send_hwm = 2
         pub = DataPublisher(
             args.btsockets["DATA"], btid=args.btid, lingerms=2000,
@@ -59,16 +61,21 @@ def main() -> None:
             }
             for _ in range(send_hwm + 2)
         ]
+        trackers = [None] * len(pool)
         cursor = {"slot": 0, "i": 0}
 
         def publish(frame: int) -> None:
-            buf = pool[cursor["slot"]]
+            slot = cursor["slot"]
+            if cursor["i"] == 0 and trackers[slot] is not None:
+                trackers[slot].wait()  # backpressure: slot still in flight
+                trackers[slot] = None
+            buf = pool[slot]
             scene.observation_into(frame, buf, cursor["i"])
             cursor["i"] += 1
             if cursor["i"] == b:
-                pub.publish(_batched=True, **buf)
+                trackers[slot] = pub.publish_tracked(_batched=True, **buf)
                 cursor["i"] = 0
-                cursor["slot"] = (cursor["slot"] + 1) % len(pool)
+                cursor["slot"] = (slot + 1) % len(pool)
             if 0 < opts.frames <= frame:
                 ctrl.cancel()
 

@@ -93,7 +93,7 @@ def test_batched_producer_end_to_end_and_tail_flush():
         instance_args=[["--shape", "32", "32", "--batch", "4", "--frames", "10"]],
     ) as launcher:
         stream = RemoteStream(
-            launcher.addresses["DATA"], timeoutms=20000, max_items=3
+            launcher.addresses["DATA"], timeoutms=40000, max_items=3
         )
         frames = []
         for msg in stream:

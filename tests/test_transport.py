@@ -178,3 +178,26 @@ def test_rpc_client_timeout():
     with pytest.raises(ReceiveTimeoutError):
         client.call(cmd="reset")
     client.close(); server.close()
+
+
+def test_publish_tracked_bounds_buffer_reuse():
+    """publish_tracked returns a MessageTracker that completes once the IO
+    thread releases the payload buffers, so a rotating pool can wait on a
+    slot before rendering into it again (safe for any consumer count)."""
+    import numpy as np
+
+    from blendjax.transport import DataPublisherSocket, DataReceiverSocket
+
+    pub = DataPublisherSocket("tcp://127.0.0.1:*", btid=0)
+    recv = DataReceiverSocket([pub.addr], timeoutms=10_000)
+    try:
+        buf = np.arange(64, dtype=np.uint8).reshape(8, 8)
+        tracker = pub.publish_tracked(image=buf, frameid=7)
+        msg, _ = recv.recv(copy_arrays=True)
+        assert msg["frameid"] == 7
+        np.testing.assert_array_equal(msg["image"], buf)
+        tracker.wait(timeout=10)  # delivered -> buffers released
+        assert tracker.done
+    finally:
+        recv.close()
+        pub.close()
