@@ -28,6 +28,7 @@ WARMUP_BATCHES = 4
 MEASURE_ITEMS = 512
 BASELINE_IMG_PER_SEC = 1.0 / 0.012  # Readme.md:92, 4 instances
 TIME_CAP_S = 120.0
+ENCODING = os.environ.get("BLENDJAX_BENCH_ENCODING", "tile")
 
 
 def main() -> None:
@@ -73,9 +74,15 @@ def main() -> None:
         seed=0,
         proto="ipc",  # same-host fleet: unix sockets beat TCP loopback
         # Producers render into (BATCH, H, W, 4) buffers and publish one
-        # message per batch; ingest passes them through with zero copies.
+        # message per batch. With tile-delta encoding (default) only the
+        # 16x16 tiles the cube touches cross the wire and the host->device
+        # link; the consumer reconstructs bit-exact full frames on device
+        # (blendjax.ops.tiles — the sustained host->HBM bandwidth is the
+        # end-to-end bottleneck for raw 1.2MB frames). Set
+        # BLENDJAX_BENCH_ENCODING=raw to ship full frames instead.
         instance_args=[
-            ["--shape", str(SHAPE[0]), str(SHAPE[1]), "--batch", str(BATCH)]
+            ["--shape", str(SHAPE[0]), str(SHAPE[1]), "--batch", str(BATCH),
+             "--encoding", ENCODING, "--tile", "16"]
         ] * instances,
     ) as launcher:
         with StreamDataPipeline(
@@ -115,6 +122,7 @@ def main() -> None:
                 "vs_baseline": round(ips / BASELINE_IMG_PER_SEC, 3),
                 "detail": {
                     "instances": instances,
+                    "encoding": ENCODING,
                     "batch": BATCH,
                     "images": images,
                     "seconds": round(dt, 2),

@@ -2,11 +2,12 @@
 
 The reference has no native code of its own but leans on native wheels for
 its hot paths (SURVEY.md §2: libzmq, PyOpenGL readback, torch); blendjax's
-native layer covers the piece those wheels don't: the producer-side
-rasterizer fill loop. Built on demand with g++ (see ``build.py``); every
-caller must work when the toolchain is absent.
+native layer covers the pieces those wheels don't: the producer-side
+rasterizer fill loop and the tile-delta changed-tile scan. Built on demand
+with g++ (see ``build.py``); every caller must work when the toolchain is
+absent.
 """
 
-from blendjax._native.build import load_rasterizer
+from blendjax._native.build import load_rasterizer, load_tile_delta
 
-__all__ = ["load_rasterizer"]
+__all__ = ["load_rasterizer", "load_tile_delta"]

@@ -51,6 +51,33 @@ def _build(src_path: str, tag: str):
     return ctypes.CDLL(out)
 
 
+def load_tile_delta():
+    """Returns the native changed-tile scan or None.
+
+    ``tile_delta(img u8[h,w,c], ref u8[h,w,c], h, w, c, t,
+    idx_out i32[n_tiles], tiles_out u8[n_tiles,t,t,c]) -> count``.
+    """
+    if os.environ.get("BLENDJAX_NO_NATIVE") == "1":
+        return None
+    with _LOCK:
+        if "tiledelta" not in _CACHE:
+            lib = _build(os.path.join(_HERE, "tiledelta.cpp"), "tiledelta")
+            if lib is None:
+                _CACHE["tiledelta"] = None
+            else:
+                u8p = ctypes.POINTER(ctypes.c_uint8)
+                fn = lib.bjx_tile_delta
+                fn.restype = ctypes.c_int64
+                fn.argtypes = [
+                    u8p, u8p,
+                    ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+                    ctypes.c_int64,
+                    ctypes.POINTER(ctypes.c_int32), u8p,
+                ]
+                _CACHE["tiledelta"] = fn
+        return _CACHE["tiledelta"]
+
+
 def load_rasterizer():
     """Returns ``(fill, clear)`` native functions or None.
 

@@ -1,0 +1,50 @@
+// Changed-tile scan for the tile-delta stream encoding
+// (blendjax/ops/tiles.py). Compares an image against the stream's
+// reference image one tile row at a time (memcmp over t*c contiguous
+// bytes) and copies only the changed tiles out — the producer-side hot
+// loop of the sparse streaming path. Same semantics as the numpy
+// fallback in TileDeltaEncoder.encode: exact byte equality, row-major
+// flattened tile indices.
+
+#include <cstdint>
+#include <cstring>
+
+extern "C" {
+
+// img, ref: h*w*c uint8, C-contiguous. t divides h and w (checked by the
+// Python caller). idx_out has capacity for all (h/t)*(w/t) tiles and
+// tiles_out for as many t*t*c blocks, so overflow is impossible.
+// Returns the number of changed tiles.
+int64_t bjx_tile_delta(const uint8_t* img, const uint8_t* ref,
+                       int64_t h, int64_t w, int64_t c, int64_t t,
+                       int32_t* idx_out, uint8_t* tiles_out) {
+  const int64_t tw = w / t;
+  const int64_t th = h / t;
+  const int64_t row_bytes = w * c;    // one image row
+  const int64_t trow_bytes = t * c;   // one tile row
+  int64_t count = 0;
+  for (int64_t ty = 0; ty < th; ++ty) {
+    for (int64_t tx = 0; tx < tw; ++tx) {
+      const int64_t base = (ty * t) * row_bytes + tx * trow_bytes;
+      bool changed = false;
+      for (int64_t y = 0; y < t; ++y) {
+        if (std::memcmp(img + base + y * row_bytes,
+                        ref + base + y * row_bytes, trow_bytes) != 0) {
+          changed = true;
+          break;
+        }
+      }
+      if (!changed) continue;
+      idx_out[count] = (int32_t)(ty * tw + tx);
+      uint8_t* dst = tiles_out + count * t * trow_bytes;
+      for (int64_t y = 0; y < t; ++y) {
+        std::memcpy(dst + y * trow_bytes, img + base + y * row_bytes,
+                    trow_bytes);
+      }
+      ++count;
+    }
+  }
+  return count;
+}
+
+}  // extern "C"

@@ -19,7 +19,11 @@ from blendjax.data.replay import FileDataset, FileReader, FileRecorder, SingleFi
 from blendjax.data.schema import StreamSchema
 from blendjax.data.stream import RemoteStream
 from blendjax.data.batcher import BatchAssembler, HostIngest
-from blendjax.data.pipeline import DeviceFeeder, StreamDataPipeline
+from blendjax.data.pipeline import (
+    DeviceFeeder,
+    StreamDataPipeline,
+    TileStreamDecoder,
+)
 
 __all__ = [
     "StreamSchema",
@@ -28,6 +32,7 @@ __all__ = [
     "HostIngest",
     "DeviceFeeder",
     "StreamDataPipeline",
+    "TileStreamDecoder",
     "FileRecorder",
     "FileReader",
     "FileDataset",

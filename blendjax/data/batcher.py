@@ -88,6 +88,7 @@ class HostIngest:
         self.prefetch = prefetch
         self.validate_every = max(1, int(validate_every))
         self._queue: queue.Queue = queue.Queue(maxsize=prefetch)
+        self._warned_prebatch = False
         self._error: BaseException | None = None
         self._thread: threading.Thread | None = None
         self._stop = threading.Event()
@@ -159,6 +160,35 @@ class HostIngest:
             for item in self.stream:
                 if self._stop.is_set():
                     break
+                if item.pop("_prebatched", False):
+                    # Opaque producer-assembled batch (e.g. tile-delta
+                    # messages, whose per-batch field shapes vary with
+                    # scene activity): hand on untouched, no schema. Its
+                    # actual leading dim is the batch size downstream
+                    # sees — a mismatch vs the pipeline's batch_size is
+                    # allowed (ragged tails from a producer flush) but
+                    # flagged once, since a jitted train step will
+                    # recompile for the odd shape.
+                    lead = next(
+                        (
+                            v.shape[0]
+                            for v in item.values()
+                            if isinstance(v, np.ndarray) and v.ndim > 0
+                        ),
+                        0,
+                    )
+                    if lead != self.batch_size and not self._warned_prebatch:
+                        self._warned_prebatch = True
+                        logger.warning(
+                            "prebatched message carries %d items but the "
+                            "pipeline batch_size is %d; passing through "
+                            "as-is (match the producer's --batch to avoid "
+                            "jit recompiles)", lead, self.batch_size,
+                        )
+                    self.items_in += lead
+                    metrics.count("ingest.items", lead)
+                    self._emit(item)
+                    continue
                 batched = bool(item.pop("_batched", False))
                 if self.schema is None:
                     if batched:

@@ -65,6 +65,13 @@ class DeviceFeeder:
                 if isinstance(self.sharding, dict)
                 else self.sharding
             )
+            spec_rank = len(getattr(s, "spec", ()) or ())
+            if s is not None and getattr(v, "ndim", 0) < spec_rank:
+                # Scalar/low-rank sidecar fields (e.g. a producer's btid
+                # stamp) can't take the batch sharding: replicate instead.
+                from jax.sharding import NamedSharding, PartitionSpec
+
+                s = NamedSharding(s.mesh, PartitionSpec())
             if s is None:
                 out[k] = jax.device_put(v)
             elif self.multihost:
@@ -99,6 +106,98 @@ class DeviceFeeder:
                 yield self._pop(ring)
         finally:
             ring.clear()
+
+
+class TileStreamDecoder:
+    """Pipeline stage pair for tile-delta-encoded image streams
+    (``blendjax.ops.tiles`` wire convention).
+
+    ``host_stage`` runs before the :class:`DeviceFeeder`: it strips each
+    producer's one-time ``<name>__tileref`` reference image (placing its
+    tiled view on device, replicated), remembers the decode geometry, and
+    queues per-batch decode plans. ``device_stage`` runs after the feeder:
+    batches whose (small) ``__tileidx``/``__tiles`` arrays were transferred
+    are reconstructed into exact full ``<name>`` images by a jitted batched
+    scatter — so only changed tiles ever cross host->device.
+
+    Refs are keyed per (field, producer btid): ZMQ PUSH is FIFO per
+    producer, so a producer's ref always precedes its deltas even under
+    fair fan-in interleaving.
+    """
+
+    def __init__(self, sharding=None):
+        self.sharding = sharding
+        self._refs: dict = {}    # (name, btid) -> device ref_tiles
+        self._shapes: dict = {}  # name -> (h, w, c, tile)
+        self._plans: collections.deque = collections.deque()
+        self._decode = None
+
+    def reset(self) -> None:
+        """Drop queued per-batch decode plans (call when re-iterating a
+        pipeline: batches a feeder prefetched but never yielded leave
+        stale plans behind). Refs survive — producers send them once."""
+        self._plans.clear()
+
+    def _replicated(self):
+        jax = _require_jax()
+        s = self.sharding
+        if isinstance(s, dict):
+            s = next((v for v in s.values() if v is not None), None)
+        if s is not None and hasattr(s, "mesh"):
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            return NamedSharding(s.mesh, PartitionSpec())
+        return None
+
+    def host_stage(self, host_batches):
+        from blendjax.ops import tiles as T
+
+        jax = _require_jax()
+        for hb in host_batches:
+            btid = hb.get("btid")
+            names = []
+            for key in [k for k in hb if k.endswith(T.TILEREF_SUFFIX)]:
+                name = key[: -len(T.TILEREF_SUFFIX)]
+                ref = hb.pop(key)
+                tile = int(hb.get(name + T.TILESHAPE_SUFFIX, [0, 0, 0, T.TILE])[3])
+                ref_tiles = T.tile_ref(ref, tile)
+                s = self._replicated()
+                if s is not None:
+                    ref_tiles = jax.device_put(ref_tiles, s)
+                self._refs[(name, btid)] = ref_tiles
+            for key in [k for k in hb if k.endswith(T.TILESHAPE_SUFFIX)]:
+                name = key[: -len(T.TILESHAPE_SUFFIX)]
+                self._shapes[name] = tuple(int(v) for v in hb.pop(key))
+                names.append(name)
+            for name in names:
+                if (name, btid) not in self._refs:
+                    raise RuntimeError(
+                        f"tile-delta batch for {name!r} from producer "
+                        f"{btid!r} arrived before its reference image"
+                    )
+            self._plans.append((names, btid) if names else None)
+            yield hb
+
+    def device_stage(self, device_batches):
+        from blendjax.ops import tiles as T
+
+        jax = _require_jax()
+        if self._decode is None:
+            self._decode = jax.jit(
+                T.decode_tile_delta, static_argnames=("shape",)
+            )
+        for db in device_batches:
+            plan = self._plans.popleft()
+            if plan is not None:
+                names, btid = plan
+                for name in names:
+                    h, w, c, _tile = self._shapes[name]
+                    idx = db.pop(name + T.TILEIDX_SUFFIX)
+                    tiles = db.pop(name + T.TILES_SUFFIX)
+                    db[name] = self._decode(
+                        self._refs[(name, btid)], idx, tiles, shape=(h, w, c)
+                    )
+            yield db
 
 
 class StreamDataPipeline:
@@ -146,6 +245,7 @@ class StreamDataPipeline:
         self.feeder = DeviceFeeder(
             sharding=sharding, prefetch=prefetch, multihost=multihost
         )
+        self.tiles = TileStreamDecoder(sharding=sharding)
 
     def __iter__(self):
         from blendjax.data.batcher import HostIngest
@@ -157,7 +257,9 @@ class StreamDataPipeline:
             prefetch=self.prefetch,
         )
         self.ingest.start()
-        return iter(self.feeder(self.ingest))
+        self.tiles.reset()
+        host = self.tiles.host_stage(self.ingest)
+        return iter(self.tiles.device_stage(self.feeder(host)))
 
     def queue_depth(self) -> int:
         return 0 if self.ingest is None else self.ingest.queue_depth()

@@ -1,23 +1,39 @@
-"""On-device image ops (XLA + Pallas).
+"""On-device image ops (XLA + Pallas) and the tile-delta stream codec.
 
 The reference burns producer CPU on these (gamma correction at
 ``pkg_blender/blendtorch/btb/offscreen.py:105-112`` and in consumer
 transforms, ``examples/datagen/generate.py:10-14``); blendjax moves them
 onto the TPU where they fuse into the input cast of the train step.
+
+Attribute access is lazy (PEP 562): producer processes import
+``blendjax.ops.tiles`` (numpy-only) without pulling in jax via
+``blendjax.ops.image``.
 """
 
-from blendjax.ops.image import (
-    gamma_correct,
-    maybe_normalize_uint8,
-    normalize_uint8,
-    random_flip,
-    uint8_gamma_normalize,
-)
-
-__all__ = [
+_IMAGE = {
     "gamma_correct",
     "normalize_uint8",
     "maybe_normalize_uint8",
     "uint8_gamma_normalize",
     "random_flip",
-]
+}
+_TILES = {
+    "TileDeltaEncoder",
+    "decode_tile_delta",
+    "pack_batch",
+    "tile_ref",
+}
+
+__all__ = sorted(_IMAGE | _TILES)
+
+
+def __getattr__(name):
+    if name in _IMAGE:
+        from blendjax.ops import image
+
+        return getattr(image, name)
+    if name in _TILES:
+        from blendjax.ops import tiles
+
+        return getattr(tiles, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

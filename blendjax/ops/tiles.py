@@ -1,0 +1,199 @@
+"""Lossless tile-delta encoding for image streams.
+
+Synthetic-render streams are sparse: between frames (or against a static
+background) only the pixels the scene geometry touches change. The
+reference ships every frame as a full pickled RGBA buffer
+(``publisher.py:43`` -> ``dataset.py:105``); on a TPU host the equivalent
+raw stream is bounded by host->HBM transfer bandwidth long before the chip
+is busy. This module moves the bottleneck: producers send only the tiles
+that differ from a *reference image* (typically the scene background), and
+the consumer reconstructs exact full frames **on device** with a jitted
+batched scatter — so the bytes that cross the host->device boundary scale
+with scene activity, not resolution.
+
+Encoding (host side, producer):
+    ``encode_tile_delta(img, ref)`` -> ``(idx, tiles)`` where ``idx`` holds
+    flattened tile indices (row-major over the tile grid) and ``tiles`` the
+    changed ``t x t x C`` blocks. Unused capacity is padded with the
+    sentinel index ``num_tiles`` which the device scatter drops.
+
+Decoding (device side, consumer):
+    ``ref_tiles = tile_ref(ref)`` once per stream, then
+    ``decode_tile_delta(ref_tiles, idx, tiles, shape=...)`` per batch:
+    a ``vmap``-ed ``.at[idx].set(tiles, mode='drop')`` scatter plus a
+    reshape back to NHWC. Exact reconstruction — ``decode(encode(x)) == x``
+    bit-for-bit (asserted by ``tests/test_tiles.py``).
+
+Wire convention (understood by ``blendjax.data.StreamDataPipeline``): for
+an image field ``name`` a tile-encoded batch message carries
+``name__tileidx`` (B, K) int32, ``name__tiles`` (B, K, t, t, C) uint8 and
+``name__tileshape`` [H, W, C, t]; the reference image travels once per
+producer as ``name__tileref`` (H, W, C) in its first message (ZMQ PUSH is
+FIFO per producer, so the ref always precedes that producer's deltas).
+
+The changed-tile scan runs in C++ when the native helper builds
+(``blendjax/_native/tiledelta.cpp``); the numpy fallback is identical.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+TILE = 32  # default tile side; must divide both image dims
+
+TILEIDX_SUFFIX = "__tileidx"
+TILES_SUFFIX = "__tiles"
+TILESHAPE_SUFFIX = "__tileshape"
+TILEREF_SUFFIX = "__tileref"
+
+
+def tile_grid(shape, tile: int = TILE):
+    """(H, W, C) image shape -> (TH, TW) tile-grid shape.
+
+    Raises if the tile size does not divide the image dims (callers should
+    fall back to raw frames for such shapes).
+    """
+    h, w = int(shape[0]), int(shape[1])
+    if h % tile or w % tile:
+        raise ValueError(f"tile {tile} does not divide image {h}x{w}")
+    return h // tile, w // tile
+
+
+class TileDeltaEncoder:
+    """Per-stream host-side encoder: images -> (idx, tiles) deltas.
+
+    Holds the reference image and preallocated staging buffers so the
+    per-frame cost is one changed-tile scan plus copies of only the
+    changed tiles. Use one encoder per stream/scene.
+    """
+
+    def __init__(self, ref: np.ndarray, tile: int = TILE):
+        ref = np.ascontiguousarray(ref)
+        if ref.dtype != np.uint8 or ref.ndim != 3:
+            raise ValueError(f"ref must be (H, W, C) uint8, got {ref.shape} {ref.dtype}")
+        self.ref = ref
+        self.tile = int(tile)
+        self.grid = tile_grid(ref.shape, self.tile)
+        self.num_tiles = self.grid[0] * self.grid[1]
+        h, w, c = ref.shape
+        self._idx = np.empty((self.num_tiles,), np.int32)
+        self._tiles = np.empty((self.num_tiles, tile, tile, c), np.uint8)
+        from blendjax._native import load_tile_delta
+
+        self._native = load_tile_delta()
+
+    def encode(self, img: np.ndarray):
+        """One frame -> ``(idx int32[K], tiles uint8[K, t, t, C])`` views
+        into internal staging (valid until the next ``encode`` call).
+        """
+        t = self.tile
+        h, w, c = self.ref.shape
+        if img.shape != self.ref.shape or img.dtype != np.uint8:
+            raise ValueError(
+                f"frame shape {img.shape}/{img.dtype} != ref {self.ref.shape}/uint8"
+            )
+        if self._native is not None and img.flags.c_contiguous:
+            import ctypes
+
+            u8 = ctypes.POINTER(ctypes.c_uint8)
+            count = self._native(
+                img.ctypes.data_as(u8),
+                self.ref.ctypes.data_as(u8),
+                h, w, c, t,
+                self._idx.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+                self._tiles.ctypes.data_as(u8),
+            )
+            return self._idx[:count], self._tiles[:count]
+        th, tw = self.grid
+        v = img.reshape(th, t, tw, t, c)
+        r = self.ref.reshape(th, t, tw, t, c)
+        changed = (v != r).any(axis=(1, 3, 4))  # (TH, TW)
+        idx = np.flatnonzero(changed).astype(np.int32)
+        k = len(idx)
+        self._idx[:k] = idx
+        # Advanced indexing (rows, :, cols) puts the K axis first -> (K,t,t,C).
+        self._tiles[:k] = v[idx // tw, :, idx % tw]
+        return self._idx[:k], self._tiles[:k]
+
+
+def pack_batch(deltas, num_tiles: int, bucket: int = 16, capacity=None):
+    """Pack per-frame ``(idx, tiles)`` deltas into fixed-capacity batch
+    arrays.
+
+    Every distinct capacity is a distinct ``(B, K, ...)`` shape, and each
+    shape costs one jit compilation of the consumer's decode — so stable
+    capacities matter more than tight ones. Pass ``capacity`` (a sticky
+    per-stream value the producer grows only on overflow) to pin the
+    shape; without it, capacity is the batch's largest per-frame tile
+    count rounded up to a multiple of ``bucket``. Padding slots carry the
+    sentinel index ``num_tiles`` which the device scatter drops.
+
+    Returns ``(idx (B, K) int32, tiles (B, K, t, t, C) uint8)``.
+    """
+    b = len(deltas)
+    kmax = max((len(i) for i, _ in deltas), default=0)
+    bucket = max(int(bucket), 1)
+    if capacity is not None and int(capacity) >= kmax:
+        cap = int(capacity)
+    else:
+        cap = max(-(-kmax // bucket) * bucket, bucket)
+    cap = min(cap, num_tiles)
+    t, c = deltas[0][1].shape[1], deltas[0][1].shape[3]
+    idx = np.full((b, cap), num_tiles, np.int32)
+    tiles = np.empty((b, cap, t, t, c), np.uint8)
+    for i, (fi, ft) in enumerate(deltas):
+        k = len(fi)
+        idx[i, :k] = fi
+        tiles[i, :k] = ft
+    return idx, tiles
+
+
+# -- device side ------------------------------------------------------------
+
+
+def tile_ref(ref, tile: int = TILE):
+    """Reference image (H, W, C) -> device-resident tiled view
+    (num_tiles, t, t, C); compute once per stream, reuse per batch."""
+    import jax.numpy as jnp
+
+    ref = jnp.asarray(ref)
+    h, w, c = ref.shape
+    th, tw = tile_grid(ref.shape, tile)
+    return ref.reshape(th, tile, tw, tile, c).transpose(0, 2, 1, 3, 4).reshape(
+        th * tw, tile, tile, c
+    )
+
+
+def decode_tile_delta(ref_tiles, idx, tiles, shape):
+    """Reconstruct exact full frames on device.
+
+    ``ref_tiles``: (N, t, t, C) from :func:`tile_ref` (any backend array).
+    ``idx``: (B, K) int32 flattened tile indices, sentinel ``N`` = no-op.
+    ``tiles``: (B, K, t, t, Ct) changed tile contents. ``Ct < C`` means the
+    producer shipped only the leading channels (e.g. RGB of an RGBA stream
+    whose alpha matched the reference everywhere — it verified that before
+    slicing); the remaining channels reconstruct from the reference. Still
+    bit-exact.
+    ``shape``: static (H, W, C) of the full image.
+
+    Returns (B, H, W, C). Jit-safe (static shapes; the sentinel rides on
+    scatter ``mode='drop'``), batch-parallel (``vmap`` over B, so a batch
+    sharded along ``data`` decodes shard-locally with a replicated ref).
+    """
+    import jax
+
+    h, w, c = (int(s) for s in shape)
+    t = tiles.shape[-3]
+    ct = tiles.shape[-1]
+    th, tw = tile_grid((h, w, c), t)
+
+    def one(i, tl):
+        if ct < c:
+            return ref_tiles.at[i, :, :, :ct].set(tl, mode="drop")
+        return ref_tiles.at[i].set(tl, mode="drop")
+
+    out = jax.vmap(one)(idx, tiles)  # (B, N, t, t, C)
+    b = idx.shape[0]
+    return out.reshape(b, th, tw, t, t, c).transpose(0, 1, 3, 2, 4, 5).reshape(
+        b, h, w, c
+    )

@@ -19,6 +19,7 @@ from blendjax.producer.duplex import DuplexChannel
 from blendjax.producer.env import BaseEnv, RemoteControlledAgent
 from blendjax.producer.publisher import DataPublisher
 from blendjax.producer.signal import Signal
+from blendjax.producer.tile_publisher import TileBatchPublisher
 
 __all__ = [
     "parse_launch_args",
@@ -29,4 +30,5 @@ __all__ = [
     "Signal",
     "BaseEnv",
     "RemoteControlledAgent",
+    "TileBatchPublisher",
 ]

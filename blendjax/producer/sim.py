@@ -250,6 +250,14 @@ class SimScene:
     def render(self) -> np.ndarray:
         raise NotImplementedError
 
+    def background_image(self) -> np.ndarray:
+        """The scene with no dynamic geometry — the reference frame for
+        tile-delta streaming (``blendjax.ops.tiles``). Scenes with static
+        scenery should override to include it."""
+        return self.raster.render(
+            self.camera, np.zeros((0, 3, 3)), np.zeros((0, 4), np.uint8)
+        )
+
 
 class CubeScene(SimScene):
     """The benchmark scene: a unit cube, randomly rotated each frame.

@@ -1,0 +1,126 @@
+"""Producer-side sparse streaming: batch + tile-delta-encode + publish.
+
+The producer half of the tile-delta path (``blendjax.ops.tiles``; the
+consumer half is ``blendjax.data.TileStreamDecoder``). Feed it one frame
+at a time; every ``batch_size`` frames it publishes one pre-batched
+message carrying only the tiles that changed vs the reference image —
+plus the reference itself, once, in the stream's first message (ZMQ PUSH
+is FIFO per producer, so the ref always arrives first).
+
+Wire-size behaviors, all transparent to the consumer:
+
+- **Sticky capacity**: every distinct tile-count capacity is a new array
+  shape, and each shape costs one jit compilation of the consumer's
+  decode — so the capacity is a per-stream high-water mark (with ~30%
+  initial headroom) that only grows on overflow.
+- **Alpha slicing**: when every frame's alpha channel matches the
+  reference's (verified per batch), only RGB crosses the wire and the
+  consumer restores alpha from the reference — still bit-exact.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from blendjax.ops.tiles import (
+    TILE,
+    TILEIDX_SUFFIX,
+    TILEREF_SUFFIX,
+    TILES_SUFFIX,
+    TILESHAPE_SUFFIX,
+    TileDeltaEncoder,
+    pack_batch,
+)
+
+
+class TileBatchPublisher:
+    """Accumulates frames and publishes tile-delta batch messages.
+
+    ``publisher``: a :class:`blendjax.producer.DataPublisher` (owned by the
+    caller; not closed here). ``ref``: the (H, W, C) uint8 reference image
+    (typically ``scene.background_image()``). ``field``: the image field
+    name the consumer will see after on-device reconstruction.
+    """
+
+    def __init__(self, publisher, ref: np.ndarray, batch_size: int,
+                 tile: int = TILE, field: str = "image"):
+        if batch_size < 1:
+            raise ValueError("batch_size must be >= 1")
+        self.publisher = publisher
+        self.batch_size = int(batch_size)
+        self.field = field
+        self.encoder = TileDeltaEncoder(ref, tile=tile)
+        self.tile = int(tile)
+        self._ref = self.encoder.ref
+        if self._ref.shape[2] == 4:
+            # Tiled view of the reference's alpha plane, indexed by flat
+            # tile id — the alpha-static check then touches only the
+            # tiles each frame actually changed.
+            th, tw = self.encoder.grid
+            t = self.tile
+            self._ref_tile_alpha = np.ascontiguousarray(
+                self._ref[:, :, 3]
+                .reshape(th, t, tw, t)
+                .transpose(0, 2, 1, 3)
+                .reshape(th * tw, t, t)
+            )
+        else:
+            self._ref_tile_alpha = None
+        self._deltas: list = []
+        self._extras: dict = {}
+        self._alpha_static = True
+        self._ref_sent = False
+        self._capacity: int | None = None
+        self.batches_published = 0
+
+    def add(self, image: np.ndarray, **extras) -> None:
+        """Add one frame plus its per-frame sidecar fields (annotations,
+        frame ids, ...); publishes automatically when the batch fills."""
+        fi, ft = self.encoder.encode(image)
+        if self._ref_tile_alpha is not None and self._alpha_static:
+            # Unchanged tiles are byte-identical to the ref by definition,
+            # so whole-frame alpha equality reduces to the changed tiles.
+            self._alpha_static = np.array_equal(
+                ft[..., 3], self._ref_tile_alpha[fi]
+            )
+        self._deltas.append((fi.copy(), ft.copy()))
+        for k, v in extras.items():
+            self._extras.setdefault(k, []).append(v)
+        if len(self._deltas) == self.batch_size:
+            self._publish()
+
+    def flush(self) -> None:
+        """Publish any buffered partial batch (call when a finite stream
+        ends so trailing frames aren't dropped; the consumer's ingest
+        passes the ragged batch through)."""
+        if self._deltas:
+            self._publish()
+
+    def _publish(self) -> None:
+        idx, tiles = pack_batch(
+            self._deltas, self.encoder.num_tiles, capacity=self._capacity
+        )
+        if self._capacity is None:
+            grown = -(-int(idx.shape[1] * 1.3) // 32) * 32
+            self._capacity = min(grown, self.encoder.num_tiles)
+        else:
+            self._capacity = max(self._capacity, idx.shape[1])
+        if self._alpha_static and self._ref_tile_alpha is not None:
+            tiles = np.ascontiguousarray(tiles[..., :3])
+        h, w, c = self._ref.shape
+        msg = {
+            "_prebatched": True,
+            self.field + TILEIDX_SUFFIX: idx,
+            self.field + TILES_SUFFIX: tiles,
+            self.field + TILESHAPE_SUFFIX: [h, w, c, self.tile],
+        }
+        for k, vals in self._extras.items():
+            msg[k] = np.stack([np.asarray(v) for v in vals])
+        if not self._ref_sent:
+            msg[self.field + TILEREF_SUFFIX] = self._ref
+            self._ref_sent = True
+        self._deltas.clear()
+        self._extras = {}
+        self._alpha_static = True
+        self.publisher.publish(**msg)
+        self.batches_published += 1

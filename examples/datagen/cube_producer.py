@@ -13,6 +13,11 @@ Usage flags (passed via ``instance_args``):
                    B per-frame messages (renders straight into the batch
                    buffer; the consumer's ingest passes full batches
                    through without re-assembly)
+  --encoding E     'raw' (default) ships full frames; 'tile' ships only
+                   the 32x32 tiles that changed vs the scene background
+                   (lossless; decoded on-device by the consumer — see
+                   blendjax.ops.tiles). Requires --batch > 1.
+  --tile T         tile side for --encoding tile (default 32)
 """
 
 from __future__ import annotations
@@ -32,13 +37,47 @@ def main() -> None:
     parser.add_argument("--shape", nargs=2, type=int, default=[480, 640])
     parser.add_argument("--frames", type=int, default=-1)
     parser.add_argument("--batch", type=int, default=1)
+    parser.add_argument("--encoding", choices=["raw", "tile"], default="raw")
+    parser.add_argument("--tile", type=int, default=32)
     opts = parser.parse_args(remainder)
 
     scene = CubeScene(shape=tuple(opts.shape), seed=args.btseed)
     ctrl = AnimationController(SimEngine(scene))
     flush = None
 
-    if opts.batch > 1:
+    if opts.encoding == "tile":
+        # Sparse streaming: per frame, render into a reused framebuffer,
+        # scan for tiles that differ from the background, and ship only
+        # those (plus the one-time reference). Wire bytes scale with scene
+        # activity instead of resolution; the consumer reconstructs exact
+        # frames on device (blendjax.ops.tiles <-> data.TileStreamDecoder).
+        from blendjax.producer import TileBatchPublisher
+
+        if opts.batch < 2:
+            parser.error("--encoding tile requires --batch > 1")
+        h, w = opts.shape
+        pub = DataPublisher(
+            args.btsockets["DATA"], btid=args.btid, lingerms=2000, send_hwm=2
+        )
+        tiles = TileBatchPublisher(
+            pub, scene.background_image(), opts.batch, tile=opts.tile
+        )
+        framebuf = np.empty((h, w, 4), np.uint8)
+        flush = tiles.flush  # ship trailing frames of a partial batch
+
+        def publish(frame: int) -> None:
+            scene.render(out=framebuf)
+            tiles.add(
+                framebuf,
+                xy=scene.camera.world_to_pixel(scene.corners_world()).astype(
+                    np.float32
+                ),
+                frameid=np.int64(frame),
+            )
+            if 0 < opts.frames <= frame:
+                ctrl.cancel()
+
+    elif opts.batch > 1:
         # Zero-copy batch pool: publish_tracked hands buffers to the socket
         # by reference and returns a zmq MessageTracker; a slot is rendered
         # into again only after its tracker reports the IO thread is done

@@ -21,6 +21,8 @@ def main() -> None:
     parser.add_argument("--shape", nargs=2, type=int, default=[480, 640])
     parser.add_argument("--num-cubes", type=int, default=8)
     parser.add_argument("--episode-frames", type=int, default=100)
+    parser.add_argument("--encoding", choices=["raw", "tile"], default="raw")
+    parser.add_argument("--batch", type=int, default=8)
     opts = parser.parse_args(remainder)
 
     pub = DataPublisher(args.btsockets["DATA"], btid=args.btid, lingerms=2000)
@@ -28,7 +30,23 @@ def main() -> None:
         shape=tuple(opts.shape), seed=args.btseed, num_cubes=opts.num_cubes
     )
     ctrl = AnimationController(SimEngine(scene))
-    ctrl.post_frame.add(lambda f: pub.publish(**scene.observation(f)))
+    if opts.encoding == "tile":
+        # Sparse streaming (blendjax.producer.TileBatchPublisher): only
+        # tiles the cubes touch cross the wire; exact frames rebuild on
+        # the consumer's device.
+        from blendjax.producer import TileBatchPublisher
+
+        tiles = TileBatchPublisher(
+            pub, scene.background_image(), opts.batch
+        )
+
+        def publish(f: int) -> None:
+            obs = scene.observation(f)
+            tiles.add(obs.pop("image"), **obs)
+
+        ctrl.post_frame.add(publish)
+    else:
+        ctrl.post_frame.add(lambda f: pub.publish(**scene.observation(f)))
     try:
         ctrl.play(frame_range=(1, opts.episode_frames), num_episodes=-1)
     finally:
