@@ -97,7 +97,12 @@ def main() -> None:
                 state, metrics = step(
                     state, {"image": batch["image"], "xy": batch["xy"]}
                 )
-            jax.block_until_ready(metrics["loss"])
+            # Sync by fetching the value, not block_until_ready: on
+            # tunneled/experimental backends block_until_ready can return
+            # with steps still in flight, and the loss value transitively
+            # depends on every dispatched step (donated-state chain) — a
+            # d2h fetch is the one sync that is honest everywhere.
+            float(metrics["loss"])
 
             images = 0
             t0 = time.perf_counter()
@@ -109,7 +114,7 @@ def main() -> None:
                 images += BATCH
                 if time.perf_counter() - t0 > TIME_CAP_S:
                     break
-            jax.block_until_ready(metrics["loss"])
+            final_loss = float(metrics["loss"])  # full drain, see above
             dt = time.perf_counter() - t0
 
     ips = images / dt
@@ -127,7 +132,7 @@ def main() -> None:
                     "images": images,
                     "seconds": round(dt, 2),
                     "backend": jax.default_backend(),
-                    "final_loss": float(metrics["loss"]),
+                    "final_loss": final_loss,
                 },
             }
         )

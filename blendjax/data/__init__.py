@@ -15,7 +15,7 @@ attach at the same boundaries the reference used (the raw-bytes tee sits
 between receive and decode, ``dataset.py:100-103``).
 """
 
-from blendjax.data.replay import FileDataset, FileReader, FileRecorder, SingleFileDataset
+from blendjax.data.replay import FileDataset, FileReader, FileRecorder, ReplayStream, SingleFileDataset
 from blendjax.data.schema import StreamSchema
 from blendjax.data.stream import RemoteStream
 from blendjax.data.batcher import BatchAssembler, HostIngest
@@ -37,4 +37,5 @@ __all__ = [
     "FileReader",
     "FileDataset",
     "SingleFileDataset",
+    "ReplayStream",
 ]

@@ -176,6 +176,48 @@ class FileReader:
             self._file = None
 
 
+class ReplayStream:
+    """Iterate recorded messages as a live-stream stand-in.
+
+    A drop-in for ``RemoteStream`` as a :class:`StreamDataPipeline`
+    source (``StreamDataPipeline.from_recording``): yields decoded
+    message dicts in recorded order, so producer-batched and tile-delta
+    messages flow through the identical ingest -> decode path as live
+    traffic — a recorded sparse stream replays to bit-exact device
+    frames with no producers running.
+
+    ``source`` may be one ``.bjr`` path, a list of paths, or a recording
+    prefix (globs ``{prefix}_*.bjr`` like :class:`FileDataset`).
+    """
+
+    def __init__(self, source, allow_pickle: bool = True, loop: bool = False):
+        if isinstance(source, str):
+            if os.path.exists(source):
+                paths = [source]
+            else:
+                paths = sorted(globmod.glob(f"{source}_*.bjr"))
+                if not paths:
+                    raise FileNotFoundError(
+                        f"no recording at {source} or {source}_*.bjr"
+                    )
+        else:
+            paths = list(source)
+        self.readers = [FileReader(p, allow_pickle=allow_pickle) for p in paths]
+        self.loop = loop
+
+    def __iter__(self):
+        while True:
+            for reader in self.readers:
+                for i in range(len(reader)):
+                    yield reader[i]
+            if not self.loop:
+                return
+
+    def close(self):
+        for r in self.readers:
+            r.close()
+
+
 class SingleFileDataset:
     """Map-style dataset over one recording (reference ``dataset.py:119-132``)."""
 

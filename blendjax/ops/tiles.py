@@ -12,10 +12,11 @@ batched scatter — so the bytes that cross the host->device boundary scale
 with scene activity, not resolution.
 
 Encoding (host side, producer):
-    ``encode_tile_delta(img, ref)`` -> ``(idx, tiles)`` where ``idx`` holds
-    flattened tile indices (row-major over the tile grid) and ``tiles`` the
-    changed ``t x t x C`` blocks. Unused capacity is padded with the
-    sentinel index ``num_tiles`` which the device scatter drops.
+    ``TileDeltaEncoder(ref).encode(img)`` -> ``(idx, tiles)`` where ``idx``
+    holds flattened tile indices (row-major over the tile grid) and
+    ``tiles`` the changed ``t x t x C`` blocks; ``pack_batch`` pads frames
+    to a shared capacity with the sentinel index ``num_tiles`` which the
+    device scatter drops.
 
 Decoding (device side, consumer):
     ``ref_tiles = tile_ref(ref)`` once per stream, then
@@ -145,7 +146,75 @@ def pack_batch(deltas, num_tiles: int, bucket: int = 16, capacity=None):
         k = len(fi)
         idx[i, :k] = fi
         tiles[i, :k] = ft
+        tiles[i, k:] = 0  # don't ship uninitialized heap bytes in padding
     return idx, tiles
+
+
+# -- packed single-transfer form --------------------------------------------
+#
+# On remote/tunneled device hosts every host->device op pays a round trip,
+# so a batch spread over five arrays (idx, tiles, labels, ids, ...) costs
+# 5x the latency of one. pack_fields/unpack_fields collapse a batch dict
+# into ONE uint8 buffer + a static spec; the unpack runs under jit on
+# device (slice + bitcast), so the whole batch rides a single device_put.
+
+
+# 64-bit payloads are value-cast to 32 bits on the host before packing —
+# the same width jax's dtype canonicalization would give them on
+# device_put (and, for floats, a correct numeric conversion where a raw
+# bitcast would silently produce garbage).
+_PACK_NARROW = {
+    np.dtype(np.float64): np.float32,
+    np.dtype(np.int64): np.int32,
+    np.dtype(np.uint64): np.uint32,
+}
+
+
+def pack_fields(fields: dict):
+    """Concatenate ndarray fields into one uint8 buffer.
+
+    Returns ``(buf uint8[total], spec)`` where ``spec`` is a hashable
+    tuple of ``(name, dtype_str, shape, offset, nbytes)`` suitable as a
+    static jit argument for :func:`unpack_fields`. 64-bit fields are
+    narrowed to 32 bits first (see ``_PACK_NARROW``) and bools travel as
+    bytes, so every packed dtype reconstructs exactly on device.
+    """
+    spec = []
+    offset = 0
+    parts = []
+    for name, arr in fields.items():
+        arr = np.ascontiguousarray(arr)
+        if arr.dtype in _PACK_NARROW:
+            arr = arr.astype(_PACK_NARROW[arr.dtype])
+        raw = arr.view(np.uint8).reshape(-1)
+        spec.append((name, arr.dtype.str, arr.shape, offset, raw.nbytes))
+        parts.append(raw)
+        offset += raw.nbytes
+    return np.concatenate(parts), tuple(
+        (n, d, tuple(int(x) for x in s), o, b) for n, d, s, o, b in spec
+    )
+
+
+def unpack_fields(buf, spec):
+    """Device-side inverse of :func:`pack_fields` (jit-safe: slices +
+    ``lax.bitcast_convert_type``). ``buf`` is the transferred uint8
+    buffer; returns ``{name: array}``."""
+    from jax import lax
+
+    out = {}
+    for name, dtype_str, shape, offset, nbytes in spec:
+        dt = np.dtype(dtype_str)
+        raw = lax.dynamic_slice_in_dim(buf, offset, nbytes)
+        if dt == np.uint8:
+            arr = raw
+        elif dt == np.bool_:
+            arr = raw.astype(np.bool_)  # packed as 0/1 bytes
+        elif dt.itemsize == 1:
+            arr = lax.bitcast_convert_type(raw, dt)
+        else:
+            arr = lax.bitcast_convert_type(raw.reshape(-1, dt.itemsize), dt)
+        out[name] = arr.reshape(shape)
+    return out
 
 
 # -- device side ------------------------------------------------------------

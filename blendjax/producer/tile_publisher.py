@@ -97,14 +97,19 @@ class TileBatchPublisher:
             self._publish()
 
     def _publish(self) -> None:
+        # Fix the sticky capacity BEFORE the first pack so every message
+        # of the stream (first included) shares one shape = one consumer
+        # decode compilation; grow in 32-tile steps only on overflow.
+        kmax = max((len(i) for i, _ in self._deltas), default=0)
+        if self._capacity is None:
+            kmax = max(int(kmax * 1.3), 1)
+        if self._capacity is None or kmax > self._capacity:
+            self._capacity = min(
+                -(-kmax // 32) * 32, self.encoder.num_tiles
+            )
         idx, tiles = pack_batch(
             self._deltas, self.encoder.num_tiles, capacity=self._capacity
         )
-        if self._capacity is None:
-            grown = -(-int(idx.shape[1] * 1.3) // 32) * 32
-            self._capacity = min(grown, self.encoder.num_tiles)
-        else:
-            self._capacity = max(self._capacity, idx.shape[1])
         if self._alpha_static and self._ref_tile_alpha is not None:
             tiles = np.ascontiguousarray(tiles[..., :3])
         h, w, c = self._ref.shape

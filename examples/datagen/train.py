@@ -26,6 +26,10 @@ def main():
     ap.add_argument("--steps", type=int, default=50)
     ap.add_argument("--record", default=None, help="record stream to PREFIX")
     ap.add_argument("--replay", default=None, help="replay from PREFIX (no producers)")
+    ap.add_argument(
+        "--encoding", choices=["raw", "tile"], default="raw",
+        help="'tile' streams only changed tiles (decoded on device)",
+    )
     args = ap.parse_args()
 
     import jax
@@ -61,38 +65,27 @@ def main():
         dt = time.perf_counter() - t0
         print(f"{n / dt:.1f} images/sec ({n} images in {dt:.1f}s)")
 
+    del jax  # device work happens inside the pipeline/step
+
     if args.replay:
-        from blendjax.data import FileDataset
-        from blendjax.data.batcher import BatchAssembler
-        from blendjax.data.schema import StreamSchema
-
-        ds = FileDataset(args.replay)
-
-        def batches():
-            asm = None
-            while True:  # loop the recording like an epoch
-                for item in ds:
-                    if asm is None:
-                        asm = BatchAssembler(
-                            StreamSchema.infer(item), args.batch
-                        )
-                    b = asm.add(item)
-                    if b is not None:
-                        yield {
-                            k: jax.device_put(v, sharding)
-                            for k, v in b.items()
-                            if k != "_meta"
-                        }
-
-        run_steps(batches())
+        # Replays through the identical ingest -> decode path as live
+        # traffic (tile-delta recordings included), looping like epochs.
+        pipe = StreamDataPipeline.from_recording(
+            args.replay, batch_size=args.batch, sharding=sharding, loop=True
+        )
+        with pipe:
+            run_steps(iter(pipe))
         return
 
+    producer_args = ["--shape", str(h), str(w)]
+    if args.encoding == "tile":
+        producer_args += ["--batch", str(args.batch), "--encoding", "tile"]
     with PythonProducerLauncher(
         script=__file__.replace("train.py", "cube_producer.py"),
         num_instances=args.instances,
         named_sockets=["DATA"],
         seed=0,
-        instance_args=[["--shape", str(h), str(w)]] * args.instances,
+        instance_args=[producer_args] * args.instances,
     ) as launcher:
         with StreamDataPipeline(
             launcher.addresses["DATA"],
