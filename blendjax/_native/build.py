@@ -54,8 +54,9 @@ def _build(src_path: str, tag: str):
 def load_tile_delta():
     """Returns the native changed-tile scan or None.
 
-    ``tile_delta(img u8[h,w,c], ref u8[h,w,c], h, w, c, t,
-    idx_out i32[n_tiles], tiles_out u8[n_tiles,t,t,c]) -> count``.
+    ``tile_delta(img u8[h,w,c], ref u8[h,w,c], h, w, c, t, ty0, ty1,
+    tx0, tx1, idx_out i32[n_tiles], tiles_out u8[n_tiles,t,t,c]) ->
+    count`` (tile-grid bounds restrict the scan).
     """
     if os.environ.get("BLENDJAX_NO_NATIVE") == "1":
         return None
@@ -72,6 +73,8 @@ def load_tile_delta():
                     u8p, u8p,
                     ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
                     ctypes.c_int64,
+                    ctypes.c_int64, ctypes.c_int64,
+                    ctypes.c_int64, ctypes.c_int64,
                     ctypes.POINTER(ctypes.c_int32), u8p,
                 ]
                 _CACHE["tiledelta"] = fn
@@ -79,10 +82,11 @@ def load_tile_delta():
 
 
 def load_rasterizer():
-    """Returns ``(fill, clear)`` native functions or None.
+    """Returns ``(fill, clear, clear_rect)`` native functions or None.
 
     ``fill(px f64[n,3,2], depth f64[n,3], rgba u8[n,4], n, color u8[h,w,4],
-    zbuf f32[h,w], h, w)``; ``clear(color, zbuf, h, w, rgba u8[4])``.
+    zbuf f32[h,w], h, w)``; ``clear(color, zbuf, h, w, rgba u8[4])``;
+    ``clear_rect(color, zbuf, h, w, rgba u8[4], y0, y1, x0, x1)``.
     """
     if os.environ.get("BLENDJAX_NO_NATIVE") == "1":
         return None
@@ -106,5 +110,12 @@ def load_rasterizer():
                 clear.argtypes = [
                     u8p, f32p, ctypes.c_int64, ctypes.c_int64, u8p,
                 ]
-                _CACHE["rasterizer"] = (fill, clear)
+                clear_rect = lib.bjx_clear_rect
+                clear_rect.restype = None
+                clear_rect.argtypes = [
+                    u8p, f32p, ctypes.c_int64, ctypes.c_int64, u8p,
+                    ctypes.c_int64, ctypes.c_int64,
+                    ctypes.c_int64, ctypes.c_int64,
+                ]
+                _CACHE["rasterizer"] = (fill, clear, clear_rect)
         return _CACHE["rasterizer"]

@@ -37,6 +37,28 @@ void bjx_clear(uint8_t* color, float* zbuf, int64_t h, int64_t w,
   std::fill(zbuf, zbuf + n, inf);
 }
 
+// Clear only rows [y0,y1) x cols [x0,x1) — the dirty-rect fast path:
+// when the caller knows which region the previous frame touched, the
+// rest of the frame is already background and clearing it again is
+// wasted bandwidth (the full clear moves ~2.4MB/frame at 640x480).
+void bjx_clear_rect(uint8_t* color, float* zbuf, int64_t h, int64_t w,
+                    const uint8_t* rgba, int64_t y0, int64_t y1,
+                    int64_t x0, int64_t x1) {
+  y0 = std::max<int64_t>(y0, 0); y1 = std::min<int64_t>(y1, h);
+  x0 = std::max<int64_t>(x0, 0); x1 = std::min<int64_t>(x1, w);
+  if (y0 >= y1 || x0 >= x1) return;
+  const uint32_t pat = (uint32_t)rgba[0] | ((uint32_t)rgba[1] << 8) |
+                       ((uint32_t)rgba[2] << 16) | ((uint32_t)rgba[3] << 24);
+  const float inf = std::numeric_limits<float>::infinity();
+  const int64_t span = x1 - x0;
+  for (int64_t y = y0; y < y1; ++y) {
+    uint32_t* c32 = reinterpret_cast<uint32_t*>(color) + y * w + x0;
+    std::fill(c32, c32 + span, pat);
+    float* z = zbuf + y * w + x0;
+    std::fill(z, z + span, inf);
+  }
+}
+
 // px:    n*3*2 float64 screen coordinates (x, y per vertex)
 // depth: n*3   float64 view depths per vertex
 // rgba:  n*4   uint8 shaded fill colors per triangle

@@ -6,6 +6,7 @@
 // fallback in TileDeltaEncoder.encode: exact byte equality, row-major
 // flattened tile indices.
 
+#include <algorithm>
 #include <cstdint>
 #include <cstring>
 
@@ -14,17 +15,22 @@ extern "C" {
 // img, ref: h*w*c uint8, C-contiguous. t divides h and w (checked by the
 // Python caller). idx_out has capacity for all (h/t)*(w/t) tiles and
 // tiles_out for as many t*t*c blocks, so overflow is impossible.
-// Returns the number of changed tiles.
+// [ty0,ty1) x [tx0,tx1) bounds the scan to tiles the caller knows may
+// have changed (e.g. the rasterizer's dirty rect); pass the full grid
+// when no such promise exists. Returns the number of changed tiles.
 int64_t bjx_tile_delta(const uint8_t* img, const uint8_t* ref,
                        int64_t h, int64_t w, int64_t c, int64_t t,
+                       int64_t ty0, int64_t ty1, int64_t tx0, int64_t tx1,
                        int32_t* idx_out, uint8_t* tiles_out) {
   const int64_t tw = w / t;
   const int64_t th = h / t;
   const int64_t row_bytes = w * c;    // one image row
   const int64_t trow_bytes = t * c;   // one tile row
+  ty0 = std::max<int64_t>(ty0, 0); ty1 = std::min<int64_t>(ty1, th);
+  tx0 = std::max<int64_t>(tx0, 0); tx1 = std::min<int64_t>(tx1, tw);
   int64_t count = 0;
-  for (int64_t ty = 0; ty < th; ++ty) {
-    for (int64_t tx = 0; tx < tw; ++tx) {
+  for (int64_t ty = ty0; ty < ty1; ++ty) {
+    for (int64_t tx = tx0; tx < tx1; ++tx) {
       const int64_t base = (ty * t) * row_bytes + tx * trow_bytes;
       bool changed = false;
       for (int64_t y = 0; y < t; ++y) {

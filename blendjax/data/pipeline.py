@@ -69,7 +69,12 @@ class DeviceFeeder:
 
         def one_device(s):
             try:
-                return s is not None and len(s.device_set) == 1
+                if s is None or len(s.device_set) != 1:
+                    return False
+                # Only the DEFAULT device: stripping a sharding pinned to
+                # another chip would silently relocate the data.
+                jax = _require_jax()
+                return next(iter(s.device_set)) == jax.devices()[0]
             except Exception:
                 return False
 
@@ -180,8 +185,9 @@ class TileStreamDecoder:
     fair fan-in interleaving.
     """
 
-    def __init__(self, sharding=None):
+    def __init__(self, sharding=None, multihost: bool = False):
         self.sharding = sharding
+        self.multihost = multihost
         self._refs: dict = {}    # (name, btid) -> device ref_tiles
         self._shapes: dict = {}  # name -> (h, w, c, tile)
         self._plans: collections.deque = collections.deque()
@@ -230,6 +236,15 @@ class TileStreamDecoder:
                         f"tile-delta batch for {name!r} from producer "
                         f"{btid!r} arrived before its reference image"
                     )
+            if names and self.multihost:
+                # Global-array assembly of packed/decoded tile batches
+                # across processes is not implemented; raw frames take the
+                # make_array_from_process_local_data path instead.
+                raise NotImplementedError(
+                    "tile-delta streams are not supported with "
+                    "multihost=True yet — use --encoding raw producers "
+                    "for multi-process global batch assembly"
+                )
             if not names:
                 self._plans.append(None)
                 yield hb
@@ -354,7 +369,7 @@ class StreamDataPipeline:
         self.feeder = DeviceFeeder(
             sharding=sharding, prefetch=prefetch, multihost=multihost
         )
-        self.tiles = TileStreamDecoder(sharding=sharding)
+        self.tiles = TileStreamDecoder(sharding=sharding, multihost=multihost)
 
     @classmethod
     def from_recording(cls, source, batch_size: int, loop: bool = False,

@@ -83,16 +83,30 @@ class TileDeltaEncoder:
 
         self._native = load_tile_delta()
 
-    def encode(self, img: np.ndarray):
+    def encode(self, img: np.ndarray, hint=None):
         """One frame -> ``(idx int32[K], tiles uint8[K, t, t, C])`` views
         into internal staging (valid until the next ``encode`` call).
+
+        ``hint`` is an optional pixel rect ``(y0, y1, x0, x1)`` promising
+        that pixels outside it equal the reference (e.g. the rasterizer's
+        ``last_drawn`` dirty rect) — the scan then touches only the tiles
+        the rect overlaps. ``hint=None`` scans the full frame.
         """
         t = self.tile
         h, w, c = self.ref.shape
+        th, tw = self.grid
         if img.shape != self.ref.shape or img.dtype != np.uint8:
             raise ValueError(
                 f"frame shape {img.shape}/{img.dtype} != ref {self.ref.shape}/uint8"
             )
+        if hint is None:
+            ty0, ty1, tx0, tx1 = 0, th, 0, tw
+        else:
+            y0, y1, x0, x1 = hint
+            ty0, ty1 = max(y0 // t, 0), min(-(-y1 // t), th)
+            tx0, tx1 = max(x0 // t, 0), min(-(-x1 // t), tw)
+            if ty0 >= ty1 or tx0 >= tx1:
+                return self._idx[:0], self._tiles[:0]
         if self._native is not None and img.flags.c_contiguous:
             import ctypes
 
@@ -100,16 +114,18 @@ class TileDeltaEncoder:
             count = self._native(
                 img.ctypes.data_as(u8),
                 self.ref.ctypes.data_as(u8),
-                h, w, c, t,
+                h, w, c, t, ty0, ty1, tx0, tx1,
                 self._idx.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
                 self._tiles.ctypes.data_as(u8),
             )
             return self._idx[:count], self._tiles[:count]
-        th, tw = self.grid
         v = img.reshape(th, t, tw, t, c)
         r = self.ref.reshape(th, t, tw, t, c)
-        changed = (v != r).any(axis=(1, 3, 4))  # (TH, TW)
-        idx = np.flatnonzero(changed).astype(np.int32)
+        sub = (v[ty0:ty1, :, tx0:tx1] != r[ty0:ty1, :, tx0:tx1]).any(
+            axis=(1, 3, 4)
+        )  # (ty1-ty0, tx1-tx0)
+        sy, sx = np.nonzero(sub)
+        idx = ((sy + ty0) * tw + (sx + tx0)).astype(np.int32)
         k = len(idx)
         self._idx[:k] = idx
         # Advanced indexing (rows, :, cols) puts the K axis first -> (K,t,t,C).

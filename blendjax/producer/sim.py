@@ -89,10 +89,22 @@ class Rasterizer:
         self._depth = np.empty((h, w), np.float32)
         self._light = np.array([0.4, -0.35, 0.85])
         self._light = self._light / np.linalg.norm(self._light)
+        # Dirty-rect state: the target buffer of the last render and the
+        # pixel rect it drew (y0, y1, x0, x1). When the next render hits
+        # the same buffer, only union(last drawn, new geometry bbox) needs
+        # clearing — everything else is still background by induction.
+        # The buffer reference is held (compared with ``is``): comparing
+        # id() of a temporary view would false-match a freed view whose
+        # address got reused, skipping a needed full clear.
+        self._prev_target: np.ndarray | None = None
+        self._prev_drawn: tuple | None = None
+        self.last_drawn: tuple | None = None
         from blendjax._native import load_rasterizer
 
         native = load_rasterizer()
-        self._native_fill, self._native_clear = native or (None, None)
+        self._native_fill, self._native_clear, self._native_clear_rect = (
+            native or (None, None, None)
+        )
 
     def render(self, camera: Camera, triangles, colors, out=None) -> np.ndarray:
         """Render world-space ``triangles`` (N,3,3) filled with ``colors``
@@ -119,50 +131,98 @@ class Rasterizer:
                     f"shape={target.shape} dtype={target.dtype} "
                     f"contiguous={target.flags.c_contiguous}"
                 )
-        if self._native_clear is not None:
-            import ctypes
+        triangles = np.asarray(triangles, np.float64)
+        if triangles.size == 0:
+            px = depth = colors_v = shade_v = None
+            bbox = None
+        else:
+            colors = np.asarray(colors)
+            if colors.shape[1] == 3:
+                colors = np.concatenate(
+                    [colors, np.full((len(colors), 1), 255, colors.dtype)],
+                    axis=1,
+                )
+            flat = triangles.reshape(-1, 3)
+            px, depth = camera.world_to_pixel(
+                flat, origin="upper-left", return_depth=True
+            )
+            px = px.reshape(-1, 3, 2)
+            depth = depth.reshape(-1, 3)
 
+            # Flat shading from world-space normals.
+            e1 = triangles[:, 1] - triangles[:, 0]
+            e2 = triangles[:, 2] - triangles[:, 0]
+            n = np.cross(e1, e2)
+            nn = np.linalg.norm(n, axis=1, keepdims=True)
+            n = np.divide(n, nn, out=np.zeros_like(n), where=nn > 1e-12)
+            shade = 0.35 + 0.65 * np.abs(n @ self._light)
+
+            visible = ~np.any(depth <= camera.clip_near, axis=1)
+            px, depth = px[visible], depth[visible]
+            colors_v, shade_v = colors[visible], shade[visible]
+            if len(px):
+                y0 = max(int(np.floor(px[:, :, 1].min())), 0)
+                y1 = min(int(np.ceil(px[:, :, 1].max())) + 1, h)
+                x0 = max(int(np.floor(px[:, :, 0].min())), 0)
+                x1 = min(int(np.ceil(px[:, :, 0].max())) + 1, w)
+                bbox = (y0, y1, x0, x1) if y0 < y1 and x0 < x1 else None
+            else:
+                bbox = None
+
+        self._clear(target, bbox)
+
+        if px is not None and len(px):
+            if self._native_fill is not None:
+                self._render_native(target, px, depth, colors_v, shade_v)
+            else:
+                for i in range(len(px)):
+                    self._fill(target, px[i], depth[i], colors_v[i],
+                               shade_v[i])
+        self._prev_target = target
+        self._prev_drawn = bbox
+        self.last_drawn = bbox
+        return target.copy() if out is None else target
+
+    def _clear(self, target, new_bbox) -> None:
+        """Restore background + z where needed before drawing.
+
+        Same-buffer re-render only clears union(previously drawn rect,
+        incoming geometry bbox) — the rest of the frame is untouched
+        background by induction. Any other buffer gets the full clear.
+        """
+        h, w = self.shape
+        rect = None
+        if self._prev_target is target:
+            rects = [r for r in (self._prev_drawn, new_bbox) if r]
+            if not rects:
+                return  # nothing was drawn and nothing will be
+            rect = (
+                min(r[0] for r in rects), max(r[1] for r in rects),
+                min(r[2] for r in rects), max(r[3] for r in rects),
+            )
+        import ctypes
+
+        u8 = ctypes.POINTER(ctypes.c_uint8)
+        f32 = ctypes.POINTER(ctypes.c_float)
+        if rect is not None and self._native_clear_rect is not None:
+            self._native_clear_rect(
+                target.ctypes.data_as(u8),
+                self._depth.ctypes.data_as(f32),
+                h, w, self.background.ctypes.data_as(u8), *rect,
+            )
+        elif rect is not None:
+            y0, y1, x0, x1 = rect
+            target[y0:y1, x0:x1] = self.background
+            self._depth[y0:y1, x0:x1] = np.inf
+        elif self._native_clear is not None:
             self._native_clear(
-                target.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
-                self._depth.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
-                h, w,
-                self.background.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+                target.ctypes.data_as(u8),
+                self._depth.ctypes.data_as(f32),
+                h, w, self.background.ctypes.data_as(u8),
             )
         else:
             target[:] = self.background
             self._depth[:] = np.inf
-        triangles = np.asarray(triangles, np.float64)
-        if triangles.size == 0:
-            return target.copy() if out is None else target
-        colors = np.asarray(colors)
-        if colors.shape[1] == 3:
-            colors = np.concatenate(
-                [colors, np.full((len(colors), 1), 255, colors.dtype)], axis=1
-            )
-
-        flat = triangles.reshape(-1, 3)
-        px, depth = camera.world_to_pixel(
-            flat, origin="upper-left", return_depth=True
-        )
-        px = px.reshape(-1, 3, 2)
-        depth = depth.reshape(-1, 3)
-
-        # Flat shading from world-space normals.
-        e1 = triangles[:, 1] - triangles[:, 0]
-        e2 = triangles[:, 2] - triangles[:, 0]
-        n = np.cross(e1, e2)
-        nn = np.linalg.norm(n, axis=1, keepdims=True)
-        n = np.divide(n, nn, out=np.zeros_like(n), where=nn > 1e-12)
-        shade = 0.35 + 0.65 * np.abs(n @ self._light)
-
-        visible = ~np.any(depth <= camera.clip_near, axis=1)
-        if self._native_fill is not None:
-            self._render_native(target, px[visible], depth[visible],
-                                colors[visible], shade[visible])
-        else:
-            for i in np.nonzero(visible)[0]:
-                self._fill(target, px[i], depth[i], colors[i], shade[i])
-        return target.copy() if out is None else target
 
     def _render_native(self, target, px, depth, colors, shade):
         import ctypes

@@ -73,10 +73,12 @@ class TileBatchPublisher:
         self._capacity: int | None = None
         self.batches_published = 0
 
-    def add(self, image: np.ndarray, **extras) -> None:
+    def add(self, image: np.ndarray, hint=None, **extras) -> None:
         """Add one frame plus its per-frame sidecar fields (annotations,
-        frame ids, ...); publishes automatically when the batch fills."""
-        fi, ft = self.encoder.encode(image)
+        frame ids, ...); publishes automatically when the batch fills.
+        ``hint`` optionally bounds the changed-tile scan to a pixel rect
+        (see :meth:`TileDeltaEncoder.encode`)."""
+        fi, ft = self.encoder.encode(image, hint=hint)
         if self._ref_tile_alpha is not None and self._alpha_static:
             # Unchanged tiles are byte-identical to the ref by definition,
             # so whole-frame alpha equality reduces to the changed tiles.

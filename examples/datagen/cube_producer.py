@@ -69,6 +69,9 @@ def main() -> None:
             scene.render(out=framebuf)
             tiles.add(
                 framebuf,
+                # Everything outside the rect the rasterizer just drew is
+                # untouched background == the reference: bound the scan.
+                hint=scene.raster.last_drawn,
                 xy=scene.camera.world_to_pixel(scene.corners_world()).astype(
                     np.float32
                 ),

@@ -330,3 +330,28 @@ def test_record_then_replay_tile_stream_bit_exact(tmp_path):
         img = np.asarray(b["image"])
         for i, f in enumerate(np.asarray(b["frameid"])):
             np.testing.assert_array_equal(img[i], local[int(f)])
+
+
+def test_encode_hint_matches_full_scan():
+    """A hint rect covering everything that differs from the ref yields
+    the identical delta as the full scan (native and numpy paths)."""
+    from blendjax.producer.sim import CubeScene
+
+    scene = CubeScene(shape=(64, 96), seed=4)
+    ref = scene.background_image()
+    for native in (True, False):
+        enc = TileDeltaEncoder(ref, tile=16)
+        if not native:
+            enc._native = None
+        elif enc._native is None:
+            continue
+        for f in range(1, 6):
+            scene.step(f)
+            img = scene.render()
+            full = tuple(a.copy() for a in enc.encode(img))
+            hinted = enc.encode(img, hint=scene.raster.last_drawn)
+            np.testing.assert_array_equal(hinted[0], full[0])
+            np.testing.assert_array_equal(hinted[1], full[1])
+        # degenerate hint: empty rect -> empty delta
+        i, t = enc.encode(ref.copy(), hint=(5, 5, 0, 0))
+        assert len(i) == 0 and len(t) == 0
