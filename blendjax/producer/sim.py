@@ -97,7 +97,6 @@ class Rasterizer:
         # id() of a temporary view would false-match a freed view whose
         # address got reused, skipping a needed full clear.
         self._prev_target: np.ndarray | None = None
-        self._prev_drawn: tuple | None = None
         self.last_drawn: tuple | None = None
         from blendjax._native import load_rasterizer
 
@@ -113,7 +112,12 @@ class Rasterizer:
 
         With ``out`` (contiguous HxWx4 uint8, e.g. a slot of a batch
         buffer) pixels are written there directly and no copy is made —
-        the zero-copy path for batched producers."""
+        the zero-copy path for batched producers.
+
+        Re-rendering into the same buffer uses dirty-rect clears, which
+        assume the buffer was not mutated by anyone else in between. If
+        external code wrote into it, call :meth:`invalidate` first to
+        force the next render to repaint fully."""
         h, w = self.shape
         if out is None:
             target = self._color
@@ -179,9 +183,14 @@ class Rasterizer:
                     self._fill(target, px[i], depth[i], colors_v[i],
                                shade_v[i])
         self._prev_target = target
-        self._prev_drawn = bbox
         self.last_drawn = bbox
         return target.copy() if out is None else target
+
+    def invalidate(self) -> None:
+        """Forget the dirty-rect state: the next render performs a full
+        clear (call after mutating the last render target externally)."""
+        self._prev_target = None
+        self.last_drawn = None
 
     def _clear(self, target, new_bbox) -> None:
         """Restore background + z where needed before drawing.
@@ -193,7 +202,7 @@ class Rasterizer:
         h, w = self.shape
         rect = None
         if self._prev_target is target:
-            rects = [r for r in (self._prev_drawn, new_bbox) if r]
+            rects = [r for r in (self.last_drawn, new_bbox) if r]
             if not rects:
                 return  # nothing was drawn and nothing will be
             rect = (

@@ -40,15 +40,23 @@ class TileBatchPublisher:
     caller; not closed here). ``ref``: the (H, W, C) uint8 reference image
     (typically ``scene.background_image()``). ``field``: the image field
     name the consumer will see after on-device reconstruction.
+
+    ``alpha_slice=False`` keeps full RGBA tiles on the wire even when the
+    alpha channel is static: ~33% more bytes, but full-channel tiles are
+    eligible for the consumer's Pallas scatter decode (measured ~25x
+    faster than the XLA scatter on TPU) — the right trade when the
+    device link has bandwidth to spare.
     """
 
     def __init__(self, publisher, ref: np.ndarray, batch_size: int,
-                 tile: int = TILE, field: str = "image"):
+                 tile: int = TILE, field: str = "image",
+                 alpha_slice: bool = True):
         if batch_size < 1:
             raise ValueError("batch_size must be >= 1")
         self.publisher = publisher
         self.batch_size = int(batch_size)
         self.field = field
+        self.alpha_slice = bool(alpha_slice)
         self.encoder = TileDeltaEncoder(ref, tile=tile)
         self.tile = int(tile)
         self._ref = self.encoder.ref
@@ -112,7 +120,11 @@ class TileBatchPublisher:
         idx, tiles = pack_batch(
             self._deltas, self.encoder.num_tiles, capacity=self._capacity
         )
-        if self._alpha_static and self._ref_tile_alpha is not None:
+        if (
+            self.alpha_slice
+            and self._alpha_static
+            and self._ref_tile_alpha is not None
+        ):
             tiles = np.ascontiguousarray(tiles[..., :3])
         h, w, c = self._ref.shape
         msg = {

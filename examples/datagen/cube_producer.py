@@ -39,6 +39,11 @@ def main() -> None:
     parser.add_argument("--batch", type=int, default=1)
     parser.add_argument("--encoding", choices=["raw", "tile"], default="raw")
     parser.add_argument("--tile", type=int, default=32)
+    parser.add_argument(
+        "--tile-rgba", action="store_true",
+        help="ship full RGBA tiles (Pallas-decodable) even when alpha is "
+        "static, instead of slicing to RGB",
+    )
     opts = parser.parse_args(remainder)
 
     scene = CubeScene(shape=tuple(opts.shape), seed=args.btseed)
@@ -60,7 +65,8 @@ def main() -> None:
             args.btsockets["DATA"], btid=args.btid, lingerms=2000, send_hwm=2
         )
         tiles = TileBatchPublisher(
-            pub, scene.background_image(), opts.batch, tile=opts.tile
+            pub, scene.background_image(), opts.batch, tile=opts.tile,
+            alpha_slice=not opts.tile_rgba,
         )
         framebuf = np.empty((h, w, 4), np.uint8)
         flush = tiles.flush  # ship trailing frames of a partial batch
