@@ -85,7 +85,8 @@ def _kwargs_to_cli(kwargs: dict) -> list[str]:
 @contextlib.contextmanager
 def launch_env(script: str, scene: str = "", background: bool = False,
                seed: int = 0, real_time: bool = False,
-               use_blender: bool | None = None, **kwargs):
+               use_blender: bool | None = None, proto: str = "tcp",
+               **kwargs):
     """Launch one environment producer and yield a connected
     :class:`RemoteEnv` (reference ``launch_env``, ``btt/env.py:137-189``).
 
@@ -106,12 +107,12 @@ def launch_env(script: str, scene: str = "", background: bool = False,
         launcher = BlenderLauncher(
             scene=scene, script=script, background=background,
             num_instances=1, named_sockets=["GYM"], seed=seed,
-            instance_args=[extra],
+            instance_args=[extra], proto=proto,
         )
     else:
         launcher = PythonProducerLauncher(
             script=script, num_instances=1, named_sockets=["GYM"],
-            seed=seed, instance_args=[extra],
+            seed=seed, instance_args=[extra], proto=proto,
         )
     with launcher as ln:
         env = RemoteEnv(ln.addresses["GYM"][0])

@@ -113,6 +113,10 @@ class StreamFormer(nn.Module):
     ``num_outputs=16`` regresses cube corners like
     :class:`~blendjax.models.cnn.CubeRegressor` so it can train on the
     same stream.
+
+    Block params are named ``block{i}`` (stable across the ``remat``
+    toggle, which would otherwise rename flax auto-named modules and
+    invalidate checkpoints).
     """
 
     patch: int = 16
@@ -128,6 +132,9 @@ class StreamFormer(nn.Module):
     num_experts: int = 0
     moe_every: int = 2  # MoE MLP in every nth block (others stay dense)
     sp_mode: str = "ring"  # sequence-parallel strategy: 'ring' | 'ulysses'
+    remat: bool = False  # rematerialize blocks: ~O(sqrt) activation
+    # memory in backprop for long sequences/deep stacks, recompute on the
+    # backward pass (jax.checkpoint via nn.remat — HBM for FLOPs)
 
     @nn.compact
     def __call__(self, images):
@@ -144,17 +151,22 @@ class StreamFormer(nn.Module):
             jnp.float32,
         )
         x = x + pos.astype(self.dtype)
+        block_cls = nn.remat(Block) if self.remat else Block
         for i in range(self.depth):
             moe = (
                 self.num_experts
                 if self.num_experts > 0 and i % self.moe_every == 0
                 else 0
             )
-            x = Block(
+            # Explicit names keep the param tree identical whether or not
+            # blocks are rematerialized (nn.remat would otherwise rename
+            # Block_i -> remat(CheckpointBlock_i), invalidating
+            # checkpoints on a memory-knob toggle).
+            x = block_cls(
                 self.num_heads, dtype=self.dtype, use_ring=self.use_ring,
                 mesh=self.mesh, seq_axis=self.seq_axis,
                 batch_axis=self.batch_axis, num_experts=moe,
-                sp_mode=self.sp_mode,
+                sp_mode=self.sp_mode, name=f"block{i}",
             )(x)
         x = nn.LayerNorm(dtype=jnp.float32)(x)
         x = x.mean(axis=1)

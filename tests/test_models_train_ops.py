@@ -191,3 +191,33 @@ def test_pallas_gamma_odd_row_count():
     )
     ref = np.asarray(gamma_correct(normalize_uint8(jnp.asarray(x), jnp.float32)))
     np.testing.assert_allclose(out, ref, atol=1e-5)
+
+
+def test_streamformer_remat_matches_baseline_grads():
+    """remat=True (nn.remat blocks — recompute activations on backward)
+    produces identical loss and gradients to the baseline."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from blendjax.models import StreamFormer
+
+    imgs = np.random.default_rng(0).integers(
+        0, 255, (2, 32, 32, 4), np.uint8
+    )
+    kw = dict(patch=8, dim=32, depth=2, num_heads=4, num_outputs=4,
+              dtype=jnp.float32)
+    base = StreamFormer(**kw)
+    rmt = StreamFormer(remat=True, **kw)
+    params = base.init(jax.random.key(0), imgs)["params"]
+
+    def loss(model, p):
+        return jnp.mean(model.apply({"params": p}, imgs) ** 2)
+
+    l0, g0 = jax.value_and_grad(lambda p: loss(base, p))(params)
+    l1, g1 = jax.value_and_grad(lambda p: loss(rmt, p))(params)
+    assert np.allclose(float(l0), float(l1), rtol=1e-6)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6),
+        g0, g1,
+    )
