@@ -188,8 +188,10 @@ class TileStreamDecoder:
     def __init__(self, sharding=None, multihost: bool = False):
         self.sharding = sharding
         self.multihost = multihost
-        self._refs: dict = {}    # (name, btid) -> device ref_tiles
+        self._refs: dict = {}       # (name, btid) -> device ref_tiles
+        self._host_refs: dict = {}  # (name, btid) -> host copy (dedup)
         self._shapes: dict = {}  # name -> (h, w, c, tile)
+        self._skipped: set = set()  # warned-once missing-ref keys
         self._plans: collections.deque = collections.deque()
         self._decode = None
 
@@ -216,26 +218,48 @@ class TileStreamDecoder:
         jax = _require_jax()
         for hb in host_batches:
             btid = hb.get("btid")
-            names = []
-            for key in [k for k in hb if k.endswith(T.TILEREF_SUFFIX)]:
-                name = key[: -len(T.TILEREF_SUFFIX)]
-                ref = hb.pop(key)
-                tile = int(hb.get(name + T.TILESHAPE_SUFFIX, [0, 0, 0, T.TILE])[3])
+            new_refs: dict = {}
+            T.pop_stream_refs(hb, new_refs, btid)
+            for key, ref in new_refs.items():
+                # Keyframe refs usually repeat the one we already hold:
+                # skip the device placement then (host compare is cheap
+                # next to a multi-MB transfer).
+                cached = self._host_refs.get(key)
+                if cached is not None and np.array_equal(cached, ref):
+                    continue
+                self._host_refs[key] = np.asarray(ref).copy()
+                tile = int(
+                    hb.get(key[0] + T.TILESHAPE_SUFFIX, [0, 0, 0, T.TILE])[3]
+                )
                 ref_tiles = T.tile_ref(ref, tile)
                 s = self._replicated()
                 if s is not None:
                     ref_tiles = jax.device_put(ref_tiles, s)
-                self._refs[(name, btid)] = ref_tiles
-            for key in [k for k in hb if k.endswith(T.TILESHAPE_SUFFIX)]:
-                name = key[: -len(T.TILESHAPE_SUFFIX)]
-                self._shapes[name] = tuple(int(v) for v in hb.pop(key))
-                names.append(name)
-            for name in names:
+                self._refs[key] = ref_tiles
+            groups = T.pop_tile_batches(hb)
+            names = []
+            missing = False
+            for name, geom, idx, tiles in groups:
                 if (name, btid) not in self._refs:
-                    raise RuntimeError(
-                        f"tile-delta batch for {name!r} from producer "
-                        f"{btid!r} arrived before its reference image"
-                    )
+                    # Fair fan-in delivered this producer's (keyframe)
+                    # reference to another consumer: skip until one
+                    # arrives here (bounded spam via once-per-key log).
+                    if (name, btid) not in self._skipped:
+                        self._skipped.add((name, btid))
+                        logger.warning(
+                            "skipping tile batches for %r from producer "
+                            "%r until its reference image arrives (use "
+                            "TileBatchPublisher(ref_interval=N) for "
+                            "multi-consumer streams)", name, btid,
+                        )
+                    missing = True
+                    continue
+                self._shapes[name] = geom
+                hb[name + T.TILEIDX_SUFFIX] = idx
+                hb[name + T.TILES_SUFFIX] = tiles
+                names.append(name)
+            if missing:
+                continue  # drop the whole batch, keep plans aligned
             if names and self.multihost:
                 # Global-array assembly of packed/decoded tile batches
                 # across processes is not implemented; raw frames take the

@@ -6,10 +6,20 @@ Gives blendtorch users the exact class shape they had —
 top of blendjax's transport, including per-worker stream splitting via
 ``get_worker_info()`` and recording. Import requires torch (optional
 dependency).
+
+blendjax-native stream forms are normalized back to reference item
+semantics: producer-batched messages (``_batched``/``_prebatched``)
+split into per-item dicts, and tile-delta messages are reconstructed
+host-side (numpy, bit-exact) so torch consumers see plain ``image``
+arrays regardless of the wire encoding. One caveat: ``max_items``
+counts *messages* at the stream layer, so against batch-publishing
+producers it bounds messages, not items (the reference only ever had
+one item per message).
 """
 
 from __future__ import annotations
 
+import numpy as np
 import torch.utils.data as tud
 
 from blendjax import constants
@@ -32,6 +42,8 @@ class RemoteIterableDataset(tud.IterableDataset):
         self.max_items = max_items
         self.item_transform = item_transform
         self.record_path_prefix = record_path_prefix
+        self._refs: dict = {}     # (field, btid) -> reference image
+        self._skipped: set = set()
 
     def enable_recording(self, prefix: str):
         """(reference ``dataset.py:53-58``)"""
@@ -40,6 +52,70 @@ class RemoteIterableDataset(tud.IterableDataset):
     def stream_length(self, max_items: int):
         """(reference ``dataset.py:60-63``)"""
         self.max_items = max_items
+
+    def _items(self, stream):
+        """Messages -> reference-style items: decode tile deltas on the
+        host, split producer-batched messages, apply item_transform.
+
+        Reference images persist on the instance (``self._refs``), so
+        re-iterating (multi-epoch) keeps decoding after the one-time ref
+        message was consumed in epoch 1. Tile messages whose ref hasn't
+        arrived yet — fair fan-in with several DataLoader workers hands
+        each (keyframe) ref to one worker — are skipped with a one-time
+        warning until a keyframe lands here (producers: set
+        ``TileBatchPublisher(ref_interval=N)`` for multi-worker use).
+        """
+        import logging
+
+        from blendjax.ops.tiles import (
+            decode_tile_delta_np,
+            pop_stream_refs,
+            pop_tile_batches,
+        )
+
+        transform = self.item_transform or (lambda x: x)
+        for msg in stream:
+            batched = bool(msg.pop("_batched", False)) | bool(
+                msg.pop("_prebatched", False)
+            )
+            btid = msg.get("btid")
+            pop_stream_refs(msg, self._refs, btid)
+            skip = False
+            for name, geom, idx, tiles in pop_tile_batches(msg):
+                ref = self._refs.get((name, btid))
+                if ref is None:
+                    if (name, btid) not in self._skipped:
+                        self._skipped.add((name, btid))
+                        logging.getLogger("blendjax.data").warning(
+                            "skipping tile messages for %r from producer "
+                            "%r until a reference image arrives", name,
+                            btid,
+                        )
+                    skip = True
+                    continue
+                msg[name] = decode_tile_delta_np(
+                    ref, idx, tiles, tile=int(geom[3])
+                )
+            if skip:
+                continue
+            if not batched:
+                yield transform(msg)
+                continue
+            lead = next(
+                (
+                    v.shape[0]
+                    for v in msg.values()
+                    if isinstance(v, np.ndarray) and v.ndim > 0
+                ),
+                0,
+            )
+            for i in range(lead):
+                yield transform({
+                    k: v[i]
+                    if isinstance(v, np.ndarray) and v.shape[:1] == (lead,)
+                    else v
+                    for k, v in msg.items()
+                })
 
     def __iter__(self):
         info = tud.get_worker_info()
@@ -50,10 +126,9 @@ class RemoteIterableDataset(tud.IterableDataset):
             queue_size=self.queue_size,
             timeoutms=self.timeoutms,
             max_items=self.max_items,
-            item_transform=self.item_transform,
             record_path_prefix=self.record_path_prefix,
             worker_index=worker_index,
             num_workers=num_workers,
             copy_arrays=True,  # torch tensors need writable arrays
         )
-        return iter(stream)
+        return self._items(iter(stream))

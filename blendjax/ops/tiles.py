@@ -166,6 +166,65 @@ def pack_batch(deltas, num_tiles: int, bucket: int = 16, capacity=None):
     return idx, tiles
 
 
+def pop_stream_refs(msg: dict, refs: dict, btid) -> None:
+    """Pop every ``<name>__tileref`` entry of a message into ``refs``
+    keyed ``(name, btid)`` — the shared wire-convention bookkeeping for
+    all tile-stream consumers (device pipeline and torch adapter)."""
+    for key in [k for k in msg if k.endswith(TILEREF_SUFFIX)]:
+        refs[(key[: -len(TILEREF_SUFFIX)], btid)] = msg.pop(key)
+
+
+def pop_tile_batches(msg: dict):
+    """Pop tile-delta field groups from a message.
+
+    Returns ``[(name, (h, w, c, tile), idx, tiles), ...]`` — empty for
+    non-tile messages. Callers look refs up under ``(name, btid)`` and
+    should SKIP (not fail) messages whose ref hasn't arrived yet: with
+    fair fan-in across multiple consumers, the one-time (or keyframe-
+    interval) reference lands on one consumer's socket at a time.
+    """
+    out = []
+    for key in [k for k in msg if k.endswith(TILESHAPE_SUFFIX)]:
+        name = key[: -len(TILESHAPE_SUFFIX)]
+        geom = tuple(int(v) for v in msg.pop(key))
+        out.append(
+            (
+                name,
+                geom,
+                msg.pop(name + TILEIDX_SUFFIX),
+                msg.pop(name + TILES_SUFFIX),
+            )
+        )
+    return out
+
+
+def decode_tile_delta_np(ref: np.ndarray, idx: np.ndarray,
+                         tiles: np.ndarray, tile: int = TILE) -> np.ndarray:
+    """Host-side (numpy) reconstruction — for consumers that never touch
+    a device, e.g. the torch-compat dataset adapter. Same semantics as
+    :func:`decode_tile_delta`: sentinel indices are dropped, channel-
+    sliced tiles restore their remaining channels from the reference.
+
+    ``idx``: (B, K) int32; ``tiles``: (B, K, t, t, Ct). Returns
+    (B, H, W, C) uint8, bit-exact.
+    """
+    h, w, c = ref.shape
+    th, tw = tile_grid(ref.shape, tile)
+    n = th * tw
+    b = idx.shape[0]
+    ct = tiles.shape[-1]
+    out = np.broadcast_to(ref, (b, h, w, c)).copy()
+    ov = out.reshape(b, th, tile, tw, tile, c)
+    for bi in range(b):
+        # Positional like the device decoder: mask BOTH idx and tiles so
+        # sentinels anywhere (not just a suffix) pair correctly.
+        m = idx[bi] < n
+        real = idx[bi][m]
+        # (K,) flat ids -> rows/cols; advanced indexing puts K first
+        ov[bi, real // tw, :, real % tw, :, :ct] = tiles[bi][m]
+    return out
+
+
 # -- packed single-transfer form --------------------------------------------
 #
 # On remote/tunneled device hosts every host->device op pays a round trip,

@@ -46,17 +46,25 @@ class TileBatchPublisher:
     eligible for the consumer's Pallas scatter decode (measured ~25x
     faster than the XLA scatter on TPU) — the right trade when the
     device link has bandwidth to spare.
+
+    ``ref_interval=N`` re-attaches the reference image every N batches
+    (video-keyframe style). With a single consumer the one-shot default
+    suffices (PUSH is FIFO per producer), but fair fan-in across several
+    consumers/workers delivers the one ref to only one of them — a
+    keyframe interval lets the others sync (they skip tile batches until
+    a ref arrives) at ~``ref_bytes / N`` amortized overhead.
     """
 
     def __init__(self, publisher, ref: np.ndarray, batch_size: int,
                  tile: int = TILE, field: str = "image",
-                 alpha_slice: bool = True):
+                 alpha_slice: bool = True, ref_interval: int = 0):
         if batch_size < 1:
             raise ValueError("batch_size must be >= 1")
         self.publisher = publisher
         self.batch_size = int(batch_size)
         self.field = field
         self.alpha_slice = bool(alpha_slice)
+        self.ref_interval = max(0, int(ref_interval))
         self.encoder = TileDeltaEncoder(ref, tile=tile)
         self.tile = int(tile)
         self._ref = self.encoder.ref
@@ -135,7 +143,11 @@ class TileBatchPublisher:
         }
         for k, vals in self._extras.items():
             msg[k] = np.stack([np.asarray(v) for v in vals])
-        if not self._ref_sent:
+        keyframe = (
+            self.ref_interval > 0
+            and self.batches_published % self.ref_interval == 0
+        )
+        if not self._ref_sent or keyframe:
             msg[self.field + TILEREF_SUFFIX] = self._ref
             self._ref_sent = True
         self._deltas.clear()

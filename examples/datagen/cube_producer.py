@@ -44,6 +44,11 @@ def main() -> None:
         help="ship full RGBA tiles (Pallas-decodable) even when alpha is "
         "static, instead of slicing to RGB",
     )
+    parser.add_argument(
+        "--ref-interval", type=int, default=64,
+        help="re-send the tile reference every N batches (keyframes; lets "
+        "multiple consumers/workers join a stream). 0 = send once.",
+    )
     opts = parser.parse_args(remainder)
 
     scene = CubeScene(shape=tuple(opts.shape), seed=args.btseed)
@@ -66,7 +71,7 @@ def main() -> None:
         )
         tiles = TileBatchPublisher(
             pub, scene.background_image(), opts.batch, tile=opts.tile,
-            alpha_slice=not opts.tile_rgba,
+            alpha_slice=not opts.tile_rgba, ref_interval=opts.ref_interval,
         )
         framebuf = np.empty((h, w, 4), np.uint8)
         flush = tiles.flush  # ship trailing frames of a partial batch

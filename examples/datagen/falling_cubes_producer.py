@@ -37,7 +37,7 @@ def main() -> None:
         from blendjax.producer import TileBatchPublisher
 
         tiles = TileBatchPublisher(
-            pub, scene.background_image(), opts.batch
+            pub, scene.background_image(), opts.batch, ref_interval=64
         )
 
         def publish(f: int) -> None:

@@ -455,3 +455,97 @@ def test_tile_stream_survives_producer_respawn():
             np.testing.assert_array_equal(img[i], local[int(f)])
             checked += 1
     assert checked >= 8  # at least first + the post-respawn batch
+
+
+def test_np_decoder_handles_non_suffix_sentinels():
+    """decode_tile_delta_np pairs indices and tiles positionally (like
+    the device decoder), even when sentinels are not a trailing suffix."""
+    from blendjax.ops.tiles import decode_tile_delta_np
+
+    ref, frames = _frames(n=1, shape=(32, 32), seed=17)
+    img = frames[0]
+    enc = TileDeltaEncoder(ref, tile=16)
+    fi, ft = enc.encode(img)
+    fi, ft = fi.copy(), ft.copy()
+    n = enc.num_tiles
+    # interleave sentinels before real entries
+    idx = np.full((1, len(fi) * 2), n, np.int32)
+    tiles = np.zeros((1, len(fi) * 2, 16, 16, 4), np.uint8)
+    idx[0, 1::2] = fi
+    tiles[0, 1::2] = ft
+    out = decode_tile_delta_np(ref, idx, tiles, tile=16)
+    np.testing.assert_array_equal(out[0], img)
+
+
+def test_keyframe_interval_lets_late_consumer_sync():
+    """A consumer that missed the initial reference (simulated by a
+    stream whose first tile messages carry no ref) skips until a
+    keyframe arrives, then decodes exactly — the multi-worker /
+    multi-epoch story for tile streams."""
+    from blendjax.data import StreamDataPipeline
+    from blendjax.ops.tiles import (
+        TILEIDX_SUFFIX,
+        TILEREF_SUFFIX,
+        TILES_SUFFIX,
+        TILESHAPE_SUFFIX,
+    )
+
+    ref, frames = _frames(n=12, shape=(32, 32), seed=19)
+    enc = TileDeltaEncoder(ref, tile=16)
+
+    def messages():
+        for start in range(0, 12, 4):
+            batch = frames[start:start + 4]
+            deltas = [tuple(a.copy() for a in enc.encode(f)) for f in batch]
+            idx, tiles = pack_batch(deltas, enc.num_tiles, capacity=4)
+            msg = {
+                "_prebatched": True,
+                "btid": 0,
+                "image" + TILEIDX_SUFFIX: idx,
+                "image" + TILES_SUFFIX: tiles,
+                "image" + TILESHAPE_SUFFIX: [32, 32, 4, 16],
+                "frameid": np.arange(start, start + 4),
+            }
+            if start == 8:  # ref arrives only in the LAST message
+                msg["image" + TILEREF_SUFFIX] = ref
+            yield msg
+
+    pipe = StreamDataPipeline(messages(), batch_size=4)
+    got = list(pipe)
+    # first two batches skipped (no ref yet); the keyframe batch decodes
+    assert len(got) == 1
+    img = np.asarray(got[0]["image"])
+    for i, f in enumerate(np.asarray(got[0]["frameid"])):
+        np.testing.assert_array_equal(img[i], frames[int(f)])
+
+
+def test_torch_adapter_multi_epoch_tile_stream():
+    """Epoch 2 over the same dataset instance still decodes: refs persist
+    on the instance after the producer's one-time ref message."""
+    from blendjax.data.torch_compat import RemoteIterableDataset
+    from blendjax.launcher import PythonProducerLauncher
+
+    import os as _os
+
+    producer = _os.path.join(
+        _os.path.dirname(__file__), "..", "examples", "datagen",
+        "cube_producer.py",
+    )
+    with PythonProducerLauncher(
+        script=producer,
+        num_instances=1,
+        named_sockets=["DATA"],
+        seed=8,
+        instance_args=[
+            ["--shape", "64", "64", "--batch", "4", "--encoding", "tile",
+             "--tile", "16", "--ref-interval", "0"]  # ref sent ONCE
+        ],
+    ) as launcher:
+        ds = RemoteIterableDataset(
+            launcher.addresses["DATA"], max_items=2, timeoutms=30_000
+        )
+        epoch1 = list(ds)
+        epoch2 = list(ds)  # fresh iterator; refs persist on the instance
+    assert len(epoch1) == 8 and len(epoch2) == 8
+    for it in epoch2:
+        assert it["image"].shape == (64, 64, 4)
