@@ -19,7 +19,6 @@ one item per message).
 
 from __future__ import annotations
 
-import numpy as np
 import torch.utils.data as tud
 
 from blendjax import constants
@@ -73,7 +72,10 @@ class RemoteIterableDataset(tud.IterableDataset):
             pop_tile_batches,
         )
 
+        from blendjax.data.batcher import HostIngest
+
         transform = self.item_transform or (lambda x: x)
+        consecutive_skips = 0
         for msg in stream:
             batched = bool(msg.pop("_batched", False)) | bool(
                 msg.pop("_prebatched", False)
@@ -97,25 +99,26 @@ class RemoteIterableDataset(tud.IterableDataset):
                     ref, idx, tiles, tile=int(geom[3])
                 )
             if skip:
+                # Skipped messages still count against the stream's
+                # max_items budget — a worker that never gets a ref
+                # would otherwise end its epoch empty and silently.
+                consecutive_skips += 1
+                if consecutive_skips >= 64:
+                    raise RuntimeError(
+                        "64 consecutive tile messages skipped waiting "
+                        "for a reference image — with multiple "
+                        "DataLoader workers the one-shot ref reaches "
+                        "only one of them; set "
+                        "TileBatchPublisher(ref_interval=N) on the "
+                        "producer so keyframes resync every consumer"
+                    )
                 continue
+            consecutive_skips = 0
             if not batched:
                 yield transform(msg)
                 continue
-            lead = next(
-                (
-                    v.shape[0]
-                    for v in msg.values()
-                    if isinstance(v, np.ndarray) and v.ndim > 0
-                ),
-                0,
-            )
-            for i in range(lead):
-                yield transform({
-                    k: v[i]
-                    if isinstance(v, np.ndarray) and v.shape[:1] == (lead,)
-                    else v
-                    for k, v in msg.items()
-                })
+            for item in HostIngest._batched_views(msg):
+                yield transform(item)
 
     def __iter__(self):
         info = tud.get_worker_info()

@@ -51,6 +51,7 @@ def make_supervised_step(
     batch_sharding=None,
     loss_fn=None,
     donate: bool = True,
+    accum_steps: int = 1,
 ):
     """Build ``step(state, batch) -> (state, metrics)``.
 
@@ -60,6 +61,11 @@ def make_supervised_step(
       batch under ``batch_sharding`` and params under the mesh rules; jit
       infers and GSPMD propagates, so no explicit in_shardings needed.
     - donation reuses the state's device buffers step-over-step.
+    - ``accum_steps=N`` splits the batch's leading axis into N
+      microbatches and accumulates gradients over a ``lax.scan`` before
+      the single optimizer update — activation memory scales with the
+      microbatch while the optimizer sees the full batch (gradients are
+      identical to the unaccumulated step up to float associativity).
     """
     del mesh, batch_sharding  # layouts ride on the arrays (see above)
     loss_fn = loss_fn or (
@@ -69,12 +75,64 @@ def make_supervised_step(
             image_shape=batch["image"].shape[1:3],
         )
     )
+    accum_steps = max(1, int(accum_steps))
 
     def step(state, batch):
-        def scalar_loss(params):
-            return loss_fn(state, params, batch)
+        def scalar_loss(params, b):
+            return loss_fn(state, params, b)
 
-        loss, grads = jax.value_and_grad(scalar_loss)(state.params)
+        if accum_steps == 1:
+            loss, grads = jax.value_and_grad(scalar_loss)(
+                state.params, batch
+            )
+        else:
+            # Split only the real batch tensors; scalar sidecar fields
+            # the pipeline attaches (producer btid stamps, '_meta', ...)
+            # ride alongside every microbatch unchanged.
+            lead = next(
+                (
+                    v.shape[0]
+                    for v in batch.values()
+                    if hasattr(v, "ndim") and getattr(v, "ndim", 0) >= 1
+                ),
+                0,
+            )
+            if lead % accum_steps:
+                raise ValueError(
+                    f"batch leading dim {lead} not divisible by "
+                    f"accum_steps={accum_steps}"
+                )
+
+            def splittable(v):
+                return (
+                    hasattr(v, "ndim")
+                    and getattr(v, "ndim", 0) >= 1
+                    and v.shape[0] == lead
+                )
+
+            micro = {
+                k: v.reshape(accum_steps, lead // accum_steps, *v.shape[1:])
+                for k, v in batch.items()
+                if splittable(v)
+            }
+            side = {k: v for k, v in batch.items() if k not in micro}
+
+            def body(carry, mb):
+                loss_sum, grad_sum = carry
+                loss, grads = jax.value_and_grad(scalar_loss)(
+                    state.params, {**side, **mb}
+                )
+                return (
+                    loss_sum + loss,
+                    jax.tree.map(jnp.add, grad_sum, grads),
+                ), None
+
+            zeros = jax.tree.map(jnp.zeros_like, state.params)
+            (loss_sum, grad_sum), _ = jax.lax.scan(
+                body, (jnp.zeros(()), zeros), micro
+            )
+            loss = loss_sum / accum_steps
+            grads = jax.tree.map(lambda g: g / accum_steps, grad_sum)
         state = state.apply_gradients(grads=grads)
         metrics = {"loss": loss}
         return state, metrics
