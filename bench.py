@@ -29,6 +29,7 @@ MEASURE_ITEMS = 512
 BASELINE_IMG_PER_SEC = 1.0 / 0.012  # Readme.md:92, 4 instances
 TIME_CAP_S = 120.0
 ENCODING = os.environ.get("BLENDJAX_BENCH_ENCODING", "tile")
+CHUNK = int(os.environ.get("BLENDJAX_BENCH_CHUNK", "8"))
 
 
 def main() -> None:
@@ -50,7 +51,11 @@ def main() -> None:
     from blendjax.launcher import PythonProducerLauncher
     from blendjax.models import CubeRegressor
     from blendjax.parallel import batch_sharding, create_mesh
-    from blendjax.train import make_supervised_step, make_train_state
+    from blendjax.train import (
+        make_chunked_supervised_step,
+        make_supervised_step,
+        make_train_state,
+    )
 
     cpu = os.cpu_count() or 1
     instances = max(1, min(6, cpu - 1)) if cpu > 1 else 1
@@ -61,7 +66,15 @@ def main() -> None:
     state = make_train_state(
         model, np.zeros((BATCH, *SHAPE, 4), np.uint8), mesh=mesh
     )
-    step = make_supervised_step(mesh=mesh, batch_sharding=sharding)
+    # One jitted scan of CHUNK sequential updates per device call: same
+    # SGD trajectory as per-batch stepping, 1/CHUNK the device round
+    # trips (the binding constraint on high-latency links). Chunking
+    # rides the tile pipeline; raw mode steps per batch.
+    chunk = CHUNK if ENCODING == "tile" else 1
+    if chunk > 1:
+        step = make_chunked_supervised_step()
+    else:
+        step = make_supervised_step(mesh=mesh, batch_sharding=sharding)
 
     producer = os.path.join(
         os.path.dirname(os.path.abspath(__file__)),
@@ -88,36 +101,48 @@ def main() -> None:
              "--encoding", ENCODING, "--tile", "16", "--tile-rgba"]
         ] * instances,
     ) as launcher:
+        def batch_images(sb):
+            # chunked superbatches are (K, B, ...); raw batches (B, ...)
+            return (
+                sb["image"].shape[0] * sb["image"].shape[1]
+                if chunk > 1 else sb["image"].shape[0]
+            )
+
+        def last_loss(metrics):
+            loss = metrics["loss"]
+            return float(loss[-1] if getattr(loss, "ndim", 0) else loss)
+
         with StreamDataPipeline(
             launcher.addresses["DATA"],
             batch_size=BATCH,
             sharding=sharding,
+            chunk=chunk,
             timeoutms=60_000,
         ) as pipe:
             it = iter(pipe)
-            for _ in range(WARMUP_BATCHES):  # warmup: compile + fill queues
-                batch = next(it)
+            for _ in range(max(1, WARMUP_BATCHES // chunk)):
+                sb = next(it)  # warmup: compile + fill queues
                 state, metrics = step(
-                    state, {"image": batch["image"], "xy": batch["xy"]}
+                    state, {"image": sb["image"], "xy": sb["xy"]}
                 )
             # Sync by fetching the value, not block_until_ready: on
             # tunneled/experimental backends block_until_ready can return
             # with steps still in flight, and the loss value transitively
             # depends on every dispatched step (donated-state chain) — a
             # d2h fetch is the one sync that is honest everywhere.
-            float(metrics["loss"])
+            last_loss(metrics)
 
             images = 0
             t0 = time.perf_counter()
             while images < MEASURE_ITEMS:
-                batch = next(it)
+                sb = next(it)
                 state, metrics = step(
-                    state, {"image": batch["image"], "xy": batch["xy"]}
+                    state, {"image": sb["image"], "xy": sb["xy"]}
                 )
-                images += BATCH
+                images += batch_images(sb)
                 if time.perf_counter() - t0 > TIME_CAP_S:
                     break
-            final_loss = float(metrics["loss"])  # full drain, see above
+            final_loss = last_loss(metrics)  # full drain, see above
             dt = time.perf_counter() - t0
 
     ips = images / dt
@@ -131,6 +156,7 @@ def main() -> None:
                 "detail": {
                     "instances": instances,
                     "encoding": ENCODING,
+                    "chunk": chunk,
                     "batch": BATCH,
                     "images": images,
                     "seconds": round(dt, 2),

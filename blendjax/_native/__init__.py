@@ -8,6 +8,10 @@ with g++ (see ``build.py``); every caller must work when the toolchain is
 absent.
 """
 
-from blendjax._native.build import load_rasterizer, load_tile_delta
+from blendjax._native.build import (
+    load_palettize,
+    load_rasterizer,
+    load_tile_delta,
+)
 
-__all__ = ["load_rasterizer", "load_tile_delta"]
+__all__ = ["load_rasterizer", "load_tile_delta", "load_palettize"]

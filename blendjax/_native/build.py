@@ -81,6 +81,31 @@ def load_tile_delta():
         return _CACHE["tiledelta"]
 
 
+def load_palettize():
+    """Returns the native palette-build pass or None.
+
+    ``palettize(px u8[n,c], n, c, cap, palette_out u8[cap,c],
+    idx_out u8[n]) -> count | -1``.
+    """
+    if os.environ.get("BLENDJAX_NO_NATIVE") == "1":
+        return None
+    with _LOCK:
+        if "palettize" not in _CACHE:
+            lib = _build(os.path.join(_HERE, "tiledelta.cpp"), "tiledelta")
+            if lib is None:
+                _CACHE["palettize"] = None
+            else:
+                u8p = ctypes.POINTER(ctypes.c_uint8)
+                fn = lib.bjx_palettize
+                fn.restype = ctypes.c_int64
+                fn.argtypes = [
+                    u8p, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+                    u8p, u8p,
+                ]
+                _CACHE["palettize"] = fn
+        return _CACHE["palettize"]
+
+
 def load_rasterizer():
     """Returns ``(fill, clear, clear_rect)`` native functions or None.
 

@@ -53,4 +53,45 @@ int64_t bjx_tile_delta(const uint8_t* img, const uint8_t* ref,
   return count;
 }
 
+// Palette-build pass for tile compression: maps each c-byte pixel
+// (c <= 4, zero-padded into a u32 key) to a palette index in one linear
+// scan with a small open-addressing table. Returns the palette size
+// (palette_out receives size*c bytes, idx_out one byte per pixel), or
+// -1 if more than `cap` distinct colors exist (caller ships raw tiles).
+int64_t bjx_palettize(const uint8_t* px, int64_t n, int64_t c,
+                      int64_t cap, uint8_t* palette_out,
+                      uint8_t* idx_out) {
+  if (cap > 256 || c > 4) return -1;  // uint8 indices; fixed tables
+  // table size: next power of two >= 4*cap (max cap 256 -> 1024 slots)
+  int64_t tsize = 1;
+  while (tsize < cap * 4) tsize <<= 1;
+  const int64_t mask = tsize - 1;
+  uint32_t keys[1024];
+  int16_t vals[1024];
+  for (int64_t i = 0; i < tsize; ++i) vals[i] = -1;
+  int64_t count = 0;
+  for (int64_t i = 0; i < n; ++i) {
+    uint32_t key = 0;
+    for (int64_t j = 0; j < c; ++j)
+      key |= (uint32_t)px[i * c + j] << (8 * j);
+    // probe
+    int64_t h = (int64_t)((key * 2654435761u) & mask);
+    for (;;) {
+      if (vals[h] < 0) {
+        if (count == cap) return -1;
+        keys[h] = key;
+        vals[h] = (int16_t)count;
+        for (int64_t j = 0; j < c; ++j)
+          palette_out[count * c + j] = px[i * c + j];
+        ++count;
+        break;
+      }
+      if (keys[h] == key) break;
+      h = (h + 1) & mask;
+    }
+    idx_out[i] = (uint8_t)vals[h];
+  }
+  return count;
+}
+
 }  // extern "C"

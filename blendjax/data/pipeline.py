@@ -183,17 +183,31 @@ class TileStreamDecoder:
     Refs are keyed per (field, producer btid): ZMQ PUSH is FIFO per
     producer, so a producer's ref always precedes its deltas even under
     fair fan-in interleaving.
+
+    ``chunk=K`` coalesces K consecutive compatible tile batches into ONE
+    transfer and ONE decode call yielding a superbatch with a leading
+    chunk axis — (K, B, H, W, C) — for consumption by
+    :func:`blendjax.train.make_chunked_supervised_step`. One device
+    round trip then covers K batches, which is what keeps throughput up
+    on high-latency device links. Batches group only while their packed
+    layout and reference images match; mismatches flush a shorter group
+    (one extra decode compilation per distinct K'). Chunked superbatches
+    skip the per-field resharding (single-device oriented).
     """
 
-    def __init__(self, sharding=None, multihost: bool = False):
+    def __init__(self, sharding=None, multihost: bool = False,
+                 chunk: int = 1):
         self.sharding = sharding
         self.multihost = multihost
+        self.chunk = max(1, int(chunk))
         self._refs: dict = {}       # (name, btid) -> device ref_tiles
         self._host_refs: dict = {}  # (name, btid) -> host copy (dedup)
+        self._ref_digest: dict = {}  # (name, btid) -> bytes digest
         self._shapes: dict = {}  # name -> (h, w, c, tile)
         self._skipped: set = set()  # warned-once missing-ref keys
         self._plans: collections.deque = collections.deque()
         self._decode = None
+        self._decode_chunk = None
 
     def reset(self) -> None:
         """Drop queued per-batch decode plans (call when re-iterating a
@@ -216,6 +230,7 @@ class TileStreamDecoder:
         from blendjax.ops import tiles as T
 
         jax = _require_jax()
+        group: dict = {}
         for hb in host_batches:
             btid = hb.get("btid")
             new_refs: dict = {}
@@ -228,6 +243,7 @@ class TileStreamDecoder:
                 if cached is not None and np.array_equal(cached, ref):
                     continue
                 self._host_refs[key] = np.asarray(ref).copy()
+                self._ref_digest[key] = hash(self._host_refs[key].tobytes())
                 tile = int(
                     hb.get(key[0] + T.TILESHAPE_SUFFIX, [0, 0, 0, T.TILE])[3]
                 )
@@ -239,7 +255,7 @@ class TileStreamDecoder:
             groups = T.pop_tile_batches(hb)
             names = []
             missing = False
-            for name, geom, idx, tiles in groups:
+            for name, geom in groups:
                 if (name, btid) not in self._refs:
                     # Fair fan-in delivered this producer's (keyframe)
                     # reference to another consumer: skip until one
@@ -255,8 +271,6 @@ class TileStreamDecoder:
                     missing = True
                     continue
                 self._shapes[name] = geom
-                hb[name + T.TILEIDX_SUFFIX] = idx
-                hb[name + T.TILES_SUFFIX] = tiles
                 names.append(name)
             if missing:
                 continue  # drop the whole batch, keep plans aligned
@@ -270,6 +284,7 @@ class TileStreamDecoder:
                     "for multi-process global batch assembly"
                 )
             if not names:
+                yield from self._flush_group(group)
                 self._plans.append(None)
                 yield hb
                 continue
@@ -282,8 +297,40 @@ class TileStreamDecoder:
             }
             rest = {k: v for k, v in hb.items() if k not in arrays}
             buf, spec = T.pack_fields(arrays)
-            self._plans.append((names, btid, spec, rest))
-            yield {"__packed__": buf}
+            if self.chunk == 1:
+                self._plans.append((names, btid, spec, rest))
+                yield {"__packed__": buf}
+                continue
+            # Chunk mode: group while the packed layout AND reference
+            # content match (one shared ref lets the whole group decode
+            # flattened in a single call).
+            gkey = (
+                tuple(names), spec,
+                tuple(self._ref_digest.get((n, btid)) for n in names),
+            )
+            if group and group["key"] != gkey:
+                yield from self._flush_group(group)
+            if not group:
+                group.update(key=gkey, bufs=[], btids=[], rests=[])
+            group["bufs"].append(buf)
+            group["btids"].append(btid)
+            group["rests"].append(rest)
+            if len(group["bufs"]) == self.chunk:
+                yield from self._flush_group(group)
+        yield from self._flush_group(group)
+
+    def _flush_group(self, group):
+        """Emit a buffered chunk group (possibly shorter than ``chunk``)
+        as one stacked packed transfer; no-op when empty."""
+        if not group:
+            return
+        names, spec, _digests = group["key"]
+        self._plans.append(
+            ("chunk", names, tuple(group["btids"]), spec, group["rests"])
+        )
+        stacked = np.stack(group["bufs"])
+        group.clear()
+        yield {"__packed__": stacked}
 
     def device_stage(self, device_batches):
         from blendjax.ops import tiles as T
@@ -291,21 +338,92 @@ class TileStreamDecoder:
         jax = _require_jax()
         if self._decode is None:
 
-            def _decode_packed(packed, refs, spec, names, shapes):
+            def _decode_packed(packed, refs, spec, names, geoms):
                 fields = T.unpack_fields(packed, spec)
-                for name, shape in zip(names, shapes):
+                for name, geom in zip(names, geoms):
                     idx = fields.pop(name + T.TILEIDX_SUFFIX)
-                    tiles = fields.pop(name + T.TILES_SUFFIX)
+                    tiles = T.pop_tile_payload(
+                        fields, name, geom, T.expand_palette_tiles
+                    )
                     fields[name] = T.decode_tile_delta(
-                        refs[name], idx, tiles, shape
+                        refs[name], idx, tiles, geom[:3]
                     )
                 return fields
 
             self._decode = jax.jit(
-                _decode_packed, static_argnames=("spec", "names", "shapes")
+                _decode_packed, static_argnames=("spec", "names", "geoms")
+            )
+        if self._decode_chunk is None:
+
+            def _decode_packed_chunk(packed, refs, spec, names, geoms):
+                # packed: (K, total). Unpack each row, then decode every
+                # name's tiles flattened over (K*B) in ONE scatter call
+                # against the group's shared reference.
+                fields = jax.vmap(
+                    lambda p: T.unpack_fields(p, spec)
+                )(packed)
+                for name, geom in zip(names, geoms):
+                    idx = fields.pop(name + T.TILEIDX_SUFFIX)
+                    tiles = T.pop_tile_payload(
+                        fields, name, geom, T.expand_palette_tiles
+                    )
+                    k, b = idx.shape[:2]
+                    img = T.decode_tile_delta(
+                        refs[name],
+                        idx.reshape(k * b, *idx.shape[2:]),
+                        tiles.reshape(k * b, *tiles.shape[2:]),
+                        geom[:3],
+                    )
+                    fields[name] = img.reshape(k, b, *img.shape[1:])
+                return fields
+
+            self._decode_chunk = jax.jit(
+                _decode_packed_chunk,
+                static_argnames=("spec", "names", "geoms"),
             )
         for db in device_batches:
             plan = self._plans.popleft()
+            if plan is not None and plan[0] == "chunk":
+                _, names, btids, spec, rests = plan
+                fields = self._decode_chunk(
+                    db.pop("__packed__"),
+                    # group membership guarantees equal ref content; use
+                    # the first btid's device copy for all
+                    {n: self._refs[(n, btids[0])] for n in names},
+                    spec=spec,
+                    names=tuple(names),
+                    geoms=tuple(self._shapes[n] for n in names),
+                )
+                # Superbatch fields are (K, B, ...): move them to the
+                # configured batch sharding with the chunk axis
+                # replicated (async reshard; no-op on one device).
+                for k, v in fields.items():
+                    s = (
+                        self.sharding.get(k)
+                        if isinstance(self.sharding, dict)
+                        else self.sharding
+                    )
+                    spec_ = getattr(s, "spec", None)
+                    if (
+                        s is not None
+                        and spec_ is not None
+                        and getattr(v, "ndim", 0) >= len(spec_) + 1
+                    ):
+                        from jax.sharding import (
+                            NamedSharding,
+                            PartitionSpec,
+                        )
+
+                        fields[k] = jax.device_put(
+                            v,
+                            NamedSharding(
+                                s.mesh, PartitionSpec(None, *spec_)
+                            ),
+                        )
+                db["_meta"] = rests
+                db.update(fields)
+                yield db
+                continue
             if plan is not None:
                 names, btid, spec, rest = plan
                 fields = self._decode(
@@ -313,9 +431,7 @@ class TileStreamDecoder:
                     {n: self._refs[(n, btid)] for n in names},
                     spec=spec,
                     names=tuple(names),
-                    shapes=tuple(
-                        self._shapes[n][:3] for n in names
-                    ),
+                    geoms=tuple(self._shapes[n] for n in names),
                 )
                 # The packed buffer travels unsharded, so on a multi-
                 # device mesh the unpacked fields must be moved to their
@@ -353,6 +469,7 @@ class StreamDataPipeline:
         prefetch: int = 2,
         multihost: bool = False,
         launcher=None,
+        chunk: int = 1,
         **stream_kwargs,
     ):
         from blendjax.data.stream import RemoteStream
@@ -393,7 +510,9 @@ class StreamDataPipeline:
         self.feeder = DeviceFeeder(
             sharding=sharding, prefetch=prefetch, multihost=multihost
         )
-        self.tiles = TileStreamDecoder(sharding=sharding, multihost=multihost)
+        self.tiles = TileStreamDecoder(
+            sharding=sharding, multihost=multihost, chunk=chunk
+        )
 
     @classmethod
     def from_recording(cls, source, batch_size: int, loop: bool = False,

@@ -67,9 +67,12 @@ class RemoteIterableDataset(tud.IterableDataset):
         import logging
 
         from blendjax.ops.tiles import (
+            TILEIDX_SUFFIX,
             decode_tile_delta_np,
+            expand_palette_tiles_np,
             pop_stream_refs,
             pop_tile_batches,
+            pop_tile_payload,
         )
 
         from blendjax.data.batcher import HostIngest
@@ -83,7 +86,7 @@ class RemoteIterableDataset(tud.IterableDataset):
             btid = msg.get("btid")
             pop_stream_refs(msg, self._refs, btid)
             skip = False
-            for name, geom, idx, tiles in pop_tile_batches(msg):
+            for name, geom in pop_tile_batches(msg):
                 ref = self._refs.get((name, btid))
                 if ref is None:
                     if (name, btid) not in self._skipped:
@@ -95,6 +98,10 @@ class RemoteIterableDataset(tud.IterableDataset):
                         )
                     skip = True
                     continue
+                idx = msg.pop(name + TILEIDX_SUFFIX)
+                tiles = pop_tile_payload(
+                    msg, name, geom, expand_palette_tiles_np
+                )
                 msg[name] = decode_tile_delta_np(
                     ref, idx, tiles, tile=int(geom[3])
                 )

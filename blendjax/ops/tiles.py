@@ -46,6 +46,10 @@ TILEIDX_SUFFIX = "__tileidx"
 TILES_SUFFIX = "__tiles"
 TILESHAPE_SUFFIX = "__tileshape"
 TILEREF_SUFFIX = "__tileref"
+# palette-compressed tile payloads (PNG-8 style; lossless):
+TILEPAL4_SUFFIX = "__tilepal4"   # two 4-bit palette indices per byte
+TILEPAL8_SUFFIX = "__tilepal8"   # one byte per pixel
+PALETTE_SUFFIX = "__palette"     # (cap, C) uint8, zero-padded
 
 
 def tile_grid(shape, tile: int = TILE):
@@ -175,27 +179,40 @@ def pop_stream_refs(msg: dict, refs: dict, btid) -> None:
 
 
 def pop_tile_batches(msg: dict):
-    """Pop tile-delta field groups from a message.
+    """Pop tile-delta geometry entries from a message.
 
-    Returns ``[(name, (h, w, c, tile), idx, tiles), ...]`` — empty for
-    non-tile messages. Callers look refs up under ``(name, btid)`` and
-    should SKIP (not fail) messages whose ref hasn't arrived yet: with
-    fair fan-in across multiple consumers, the one-time (or keyframe-
-    interval) reference lands on one consumer's socket at a time.
+    Returns ``[(name, (h, w, c, tile)), ...]`` — empty for non-tile
+    messages. The payload fields (``__tileidx`` plus ``__tiles`` or the
+    palette-compressed ``__tilepal4/8`` + ``__palette``) stay in the
+    message for the caller to transfer/decode. Callers look refs up
+    under ``(name, btid)`` and should SKIP (not fail) messages whose ref
+    hasn't arrived yet: with fair fan-in across multiple consumers, the
+    one-time (or keyframe-interval) reference lands on one consumer's
+    socket at a time.
     """
     out = []
     for key in [k for k in msg if k.endswith(TILESHAPE_SUFFIX)]:
         name = key[: -len(TILESHAPE_SUFFIX)]
-        geom = tuple(int(v) for v in msg.pop(key))
-        out.append(
-            (
-                name,
-                geom,
-                msg.pop(name + TILEIDX_SUFFIX),
-                msg.pop(name + TILES_SUFFIX),
-            )
-        )
+        out.append((name, tuple(int(v) for v in msg.pop(key))))
     return out
+
+
+def pop_tile_payload(fields: dict, name: str, geom, expand):
+    """Pop ``name``'s tile payload from ``fields`` and return the
+    expanded (K-leading) tile array, where ``expand`` is
+    :func:`expand_palette_tiles` (device) or
+    :func:`expand_palette_tiles_np` (host). Shared by every consumer so
+    the raw-vs-palette wire variants stay in one place."""
+    t = int(geom[3])
+    if name + TILEPAL4_SUFFIX in fields:
+        packed = fields.pop(name + TILEPAL4_SUFFIX)
+        pal = fields.pop(name + PALETTE_SUFFIX)
+        return expand(packed, pal, 4, t, pal.shape[-1])
+    if name + TILEPAL8_SUFFIX in fields:
+        packed = fields.pop(name + TILEPAL8_SUFFIX)
+        pal = fields.pop(name + PALETTE_SUFFIX)
+        return expand(packed, pal, 8, t, pal.shape[-1])
+    return fields.pop(name + TILES_SUFFIX)
 
 
 def decode_tile_delta_np(ref: np.ndarray, idx: np.ndarray,
@@ -223,6 +240,100 @@ def decode_tile_delta_np(ref: np.ndarray, idx: np.ndarray,
         # (K,) flat ids -> rows/cols; advanced indexing puts K first
         ov[bi, real // tw, :, real % tw, :, :ct] = tiles[bi][m]
     return out
+
+
+# -- palette compression (host encode / device expand) ----------------------
+#
+# Flat-shaded synthetic frames carry very few distinct colors, so the
+# changed tiles compress losslessly to palette indices: <=16 colors ->
+# two 4-bit indices per byte (8x fewer bytes than RGBA), <=256 -> one
+# byte per pixel (4x). The device side is a trivial fused gather.
+
+
+def palettize_tiles(tiles: np.ndarray, max_colors: int = 256):
+    """Try to palette-compress a packed tile array (B, K, t, t, C).
+
+    Returns ``(packed, palette, bits)`` — ``packed`` is (B, K, t*t/2)
+    uint8 nibbles for ``bits=4`` or (B, K, t*t) bytes for ``bits=8``,
+    ``palette`` is (16|256, C) zero-padded — or ``None`` when the tiles
+    hold more than ``max_colors`` distinct colors (ship raw instead).
+    Runs as one native C pass when available; numpy fallback.
+    """
+    from blendjax._native import load_palettize
+
+    max_colors = min(int(max_colors), 256)  # uint8 indices; native tables
+    b, k, t, _, c = tiles.shape
+    flat = np.ascontiguousarray(tiles).reshape(-1, c)
+    n = flat.shape[0]
+    native = load_palettize()
+    if native is not None:
+        import ctypes
+
+        pal = np.zeros((max_colors, c), np.uint8)
+        idx = np.empty((n,), np.uint8)
+        u8 = ctypes.POINTER(ctypes.c_uint8)
+        count = native(
+            flat.ctypes.data_as(u8), n, c, max_colors,
+            pal.ctypes.data_as(u8), idx.ctypes.data_as(u8),
+        )
+        if count < 0:
+            return None
+    else:
+        key = np.zeros(n, np.uint32)
+        for j in range(c):
+            key |= flat[:, j].astype(np.uint32) << (8 * j)
+        uniq, idx32 = np.unique(key, return_inverse=True)
+        count = len(uniq)
+        if count > max_colors:
+            return None
+        idx = idx32.astype(np.uint8)
+        pal = np.zeros((max_colors, c), np.uint8)
+        for j in range(c):
+            pal[:count, j] = (uniq >> (8 * j)).astype(np.uint8)
+    if count <= 16 and (t * t) % 2 == 0:
+        pal16 = np.zeros((16, c), np.uint8)
+        pal16[:] = pal[:16]
+        packed = ((idx[0::2] << 4) | idx[1::2]).reshape(b, k, (t * t) // 2)
+        return packed, pal16, 4
+    return idx.reshape(b, k, t * t), pal, 8
+
+
+def expand_palette_tiles(packed, palette, bits: int, t: int, c: int):
+    """Device-side inverse of :func:`palettize_tiles` (jit-safe gather).
+
+    ``packed``: (..., K, t*t/2|t*t) uint8; ``palette``: (cap, C), or
+    (G, cap, C) with a leading group axis matching ``packed``'s first
+    dim (the chunked-decode case) — then each group gathers through its
+    own palette. Returns (..., K, t, t, C) uint8.
+    """
+    import jax.numpy as jnp
+
+    if palette.ndim == 3:
+        import jax
+
+        return jax.vmap(
+            lambda p, q: expand_palette_tiles(p, q, bits, t, c)
+        )(packed, palette)
+    lead = packed.shape[:-1]
+    if bits == 4:
+        hi = packed >> 4
+        lo = packed & 0xF
+        idx = jnp.stack([hi, lo], axis=-1).reshape(*lead, t * t)
+    else:
+        idx = packed
+    return palette[idx].reshape(*lead, t, t, c)
+
+
+def expand_palette_tiles_np(packed, palette, bits: int, t: int, c: int):
+    """Host (numpy) twin of :func:`expand_palette_tiles`."""
+    lead = packed.shape[:-1]
+    if bits == 4:
+        idx = np.stack([packed >> 4, packed & 0xF], axis=-1).reshape(
+            *lead, t * t
+        )
+    else:
+        idx = packed
+    return palette[idx].reshape(*lead, t, t, c)
 
 
 # -- packed single-transfer form --------------------------------------------

@@ -8,6 +8,7 @@ donated, mesh-sharded steps fed by ``blendjax.data``.
 
 from blendjax.train.steps import (
     corner_loss,
+    make_chunked_supervised_step,
     make_eval_step,
     make_train_state,
     make_supervised_step,
@@ -17,6 +18,7 @@ from blendjax.train.checkpoint import CheckpointManager
 __all__ = [
     "make_train_state",
     "make_supervised_step",
+    "make_chunked_supervised_step",
     "make_eval_step",
     "corner_loss",
     "CheckpointManager",

@@ -140,6 +140,43 @@ def make_supervised_step(
     return jax.jit(step, donate_argnums=(0,) if donate else ())
 
 
+def make_chunked_supervised_step(
+    loss_fn=None,
+    donate: bool = True,
+):
+    """Build ``step(state, superbatch) -> (state, metrics)`` where
+    ``superbatch`` fields carry a leading chunk axis: (K, B, ...).
+
+    Runs K sequential optimizer updates (bit-identical training
+    semantics to K calls of the per-batch step) inside ONE jitted
+    ``lax.scan`` — one device round trip per K batches instead of per
+    batch, which is the difference between working and crawling on
+    high-latency device links (see docs/performance.md). Pairs with
+    ``StreamDataPipeline(chunk=K)``. ``metrics['loss']`` is the K-vector
+    of per-update losses.
+    """
+    loss_fn = loss_fn or (
+        lambda state, params, batch: corner_loss(
+            state.apply_fn({"params": params}, batch["image"]),
+            batch["xy"],
+            image_shape=batch["image"].shape[1:3],
+        )
+    )
+
+    def step(state, superbatch):
+        def body(st, batch):
+            def scalar_loss(params):
+                return loss_fn(st, params, batch)
+
+            loss, grads = jax.value_and_grad(scalar_loss)(st.params)
+            return st.apply_gradients(grads=grads), loss
+
+        state, losses = jax.lax.scan(body, state, superbatch)
+        return state, {"loss": losses}
+
+    return jax.jit(step, donate_argnums=(0,) if donate else ())
+
+
 def make_eval_step():
     def evaluate(state, batch):
         pred = state.apply_fn({"params": state.params}, batch["image"])

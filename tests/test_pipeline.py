@@ -100,3 +100,22 @@ def test_batched_producer_end_to_end_and_tail_flush():
             assert msg["_batched"] is True
             frames.extend(msg["frameid"].tolist())
         assert sorted(frames) == list(range(1, 11))
+
+
+def test_device_feeder_multihost_assembles_global_batch():
+    """multihost=True routes through jax.make_array_from_process_local_data
+    (degenerate single-process case here: local data == global batch);
+    the result is a global array under the requested sharding."""
+    mesh, sharding = _data_sharding()
+    batches = [
+        {
+            "image": np.arange(8 * 4 * 4 * 4, dtype=np.uint8).reshape(
+                8, 4, 4, 4
+            ),
+            "frameid": np.arange(8),
+        }
+    ]
+    feeder = DeviceFeeder(sharding=sharding, prefetch=1, multihost=True)
+    (out,) = list(feeder(batches))
+    assert out["image"].sharding.is_equivalent_to(sharding, 4)
+    np.testing.assert_array_equal(np.asarray(out["image"]), batches[0]["image"])
