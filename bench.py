@@ -96,9 +96,13 @@ def main() -> None:
         # --tile-rgba: full-channel tiles decode through the Pallas
         # scatter kernel (~25x faster than the XLA scatter on TPU); the
         # ~33% extra wire bytes are the cheaper side of that trade.
+        # --tile-capacity pins one wire shape across the fleet: one
+        # consumer decode compilation, unbroken chunk groups (the cube
+        # touches ~200-280 of 1200 tiles at this size).
         instance_args=[
             ["--shape", str(SHAPE[0]), str(SHAPE[1]), "--batch", str(BATCH),
-             "--encoding", ENCODING, "--tile", "16", "--tile-rgba"]
+             "--encoding", ENCODING, "--tile", "16", "--tile-rgba",
+             "--tile-capacity", "320"]
         ] * instances,
     ) as launcher:
         def batch_images(sb):

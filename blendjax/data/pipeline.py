@@ -190,9 +190,11 @@ class TileStreamDecoder:
     :func:`blendjax.train.make_chunked_supervised_step`. One device
     round trip then covers K batches, which is what keeps throughput up
     on high-latency device links. Batches group only while their packed
-    layout and reference images match; mismatches flush a shorter group
-    (one extra decode compilation per distinct K'). Chunked superbatches
-    skip the per-field resharding (single-device oriented).
+    layout and reference images match — pin
+    ``TileBatchPublisher(capacity=...)`` across a producer fleet so
+    groups never fragment; mismatches flush a shorter group (one extra
+    decode compilation per distinct K'). Chunked fields reshard to the
+    configured batch sharding with the chunk axis replicated.
     """
 
     def __init__(self, sharding=None, multihost: bool = False,
@@ -284,7 +286,13 @@ class TileStreamDecoder:
                     "for multi-process global batch assembly"
                 )
             if not names:
-                yield from self._flush_group(group)
+                if self.chunk > 1:
+                    raise RuntimeError(
+                        "chunk>1 requires an all-tile-encoded stream: a "
+                        "non-tile message arrived, and the chunked step "
+                        "consumer expects (K, B, ...) superbatches only "
+                        "(run raw/mixed streams with chunk=1)"
+                    )
                 self._plans.append(None)
                 yield hb
                 continue

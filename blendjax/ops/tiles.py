@@ -292,7 +292,7 @@ def palettize_tiles(tiles: np.ndarray, max_colors: int = 256):
             pal[:count, j] = (uniq >> (8 * j)).astype(np.uint8)
     if count <= 16 and (t * t) % 2 == 0:
         pal16 = np.zeros((16, c), np.uint8)
-        pal16[:] = pal[:16]
+        pal16[: min(len(pal), 16)] = pal[:16]
         packed = ((idx[0::2] << 4) | idx[1::2]).reshape(b, k, (t * t) // 2)
         return packed, pal16, 4
     return idx.reshape(b, k, t * t), pal, 8

@@ -63,12 +63,19 @@ class TileBatchPublisher:
     usually do): <=16 colors ship as 4-bit indices (8x fewer bytes),
     <=256 as bytes (4x); more falls back to raw tiles. Lossless either
     way — the consumer's decode gathers through the palette on device.
+
+    ``capacity`` pins the per-frame tile capacity from the first batch
+    (it still grows on overflow). Every distinct capacity is a distinct
+    wire/array shape — one consumer decode compilation, and a chunk-group
+    boundary — so a fleet of producers streaming the same scene should
+    share an explicit capacity rather than each settling its own
+    high-water mark.
     """
 
     def __init__(self, publisher, ref: np.ndarray, batch_size: int,
                  tile: int = TILE, field: str = "image",
                  alpha_slice: bool = True, ref_interval: int = 0,
-                 palette: bool = True):
+                 palette: bool = True, capacity: int | None = None):
         if batch_size < 1:
             raise ValueError("batch_size must be >= 1")
         self.publisher = publisher
@@ -99,7 +106,10 @@ class TileBatchPublisher:
         self._extras: dict = {}
         self._alpha_static = True
         self._ref_sent = False
-        self._capacity: int | None = None
+        self._capacity: int | None = (
+            min(int(capacity), self.encoder.num_tiles)
+            if capacity else None
+        )
         self.batches_published = 0
 
     def add(self, image: np.ndarray, hint=None, **extras) -> None:

@@ -49,6 +49,12 @@ def main() -> None:
         help="re-send the tile reference every N batches (keyframes; lets "
         "multiple consumers/workers join a stream). 0 = send once.",
     )
+    parser.add_argument(
+        "--tile-capacity", type=int, default=0,
+        help="pin the per-frame changed-tile capacity (stable shapes "
+        "across a producer fleet => one consumer decode compilation and "
+        "unbroken chunk groups). 0 = per-stream high-water mark.",
+    )
     opts = parser.parse_args(remainder)
 
     scene = CubeScene(shape=tuple(opts.shape), seed=args.btseed)
@@ -72,6 +78,7 @@ def main() -> None:
         tiles = TileBatchPublisher(
             pub, scene.background_image(), opts.batch, tile=opts.tile,
             alpha_slice=not opts.tile_rgba, ref_interval=opts.ref_interval,
+            capacity=opts.tile_capacity or None,
         )
         framebuf = np.empty((h, w, 4), np.uint8)
         flush = tiles.flush  # ship trailing frames of a partial batch

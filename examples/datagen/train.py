@@ -30,6 +30,11 @@ def main():
         "--encoding", choices=["raw", "tile"], default="raw",
         help="'tile' streams only changed tiles (decoded on device)",
     )
+    ap.add_argument(
+        "--chunk", type=int, default=1,
+        help="coalesce K tile batches into one transfer + one jitted "
+        "scan of K updates (needs --encoding tile)",
+    )
     args = ap.parse_args()
 
     import jax
@@ -38,7 +43,11 @@ def main():
     from blendjax.launcher import PythonProducerLauncher
     from blendjax.models import CubeRegressor
     from blendjax.parallel import batch_sharding, create_mesh
-    from blendjax.train import make_supervised_step, make_train_state
+    from blendjax.train import (
+        make_chunked_supervised_step,
+        make_supervised_step,
+        make_train_state,
+    )
 
     mesh = create_mesh({"data": -1})
     sharding = batch_sharding(mesh)
@@ -48,7 +57,12 @@ def main():
     state = make_train_state(
         model, np.zeros((args.batch, h, w, 4), np.uint8), mesh=mesh
     )
-    step = make_supervised_step(mesh=mesh, batch_sharding=sharding)
+    chunk = args.chunk if args.encoding == "tile" else 1
+    if chunk > 1:
+        # K sequential updates per device call (see docs/performance.md)
+        step = make_chunked_supervised_step()
+    else:
+        step = make_supervised_step(mesh=mesh, batch_sharding=sharding)
 
     def run_steps(batches):
         nonlocal state
@@ -59,9 +73,14 @@ def main():
             state, metrics = step(
                 state, {"image": batch["image"], "xy": batch["xy"]}
             )
-            n += args.batch
+            # superbatches are (K', B, ...) and K' can run short on a
+            # group flush; count what actually arrived
+            shp = batch["image"].shape
+            n += shp[0] * shp[1] if chunk > 1 else shp[0]
             if i % 10 == 0:
-                print(f"step {i}: loss={float(metrics['loss']):.5f}")
+                loss = metrics["loss"]
+                loss = loss[-1] if getattr(loss, "ndim", 0) else loss
+                print(f"step {i}: loss={float(loss):.5f}")
         dt = time.perf_counter() - t0
         print(f"{n / dt:.1f} images/sec ({n} images in {dt:.1f}s)")
 
@@ -91,6 +110,7 @@ def main():
             launcher.addresses["DATA"],
             batch_size=args.batch,
             sharding=sharding,
+            chunk=chunk,
             record_path_prefix=args.record,
         ) as pipe:
             run_steps(iter(pipe))
