@@ -25,12 +25,16 @@ Decoding (device side, consumer):
     reshape back to NHWC. Exact reconstruction — ``decode(encode(x)) == x``
     bit-for-bit (asserted by ``tests/test_tiles.py``).
 
-Wire convention (understood by ``blendjax.data.StreamDataPipeline``): for
-an image field ``name`` a tile-encoded batch message carries
-``name__tileidx`` (B, K) int32, ``name__tiles`` (B, K, t, t, C) uint8 and
-``name__tileshape`` [H, W, C, t]; the reference image travels once per
-producer as ``name__tileref`` (H, W, C) in its first message (ZMQ PUSH is
-FIFO per producer, so the ref always precedes that producer's deltas).
+Wire convention (understood by ``blendjax.data.StreamDataPipeline`` and
+the torch adapter; full table in ``docs/wire-protocol.md``): for an image
+field ``name`` a tile-encoded batch message carries ``name__tileidx``
+(B, K) int32, ``name__tileshape`` [H, W, C, t], and the tile payload —
+``name__tiles`` (B, K, t, t, C) uint8 raw, or the palette-compressed
+``name__tilepal4``/``name__tilepal8`` + ``name__palette`` when the
+batch's colors fit 4/8-bit indices. The reference image travels as
+``name__tileref`` (H, W, C) in the producer's first message — and, when
+``TileBatchPublisher(ref_interval=N)`` is set (default off), every Nth
+batch as a keyframe so late-joining consumers can sync.
 
 The changed-tile scan runs in C++ when the native helper builds
 (``blendjax/_native/tiledelta.cpp``); the numpy fallback is identical.

@@ -1,0 +1,62 @@
+"""Opt-in soak test: long-running tile stream, stable memory.
+
+Run with ``BLENDJAX_SOAK=1 pytest tests/test_soak.py -q``. Guards
+against slow leaks in the pipeline's per-batch bookkeeping (plans,
+refs, chunk groups, recycled buffers) that short functional tests
+can't see.
+"""
+
+import os
+
+import pytest
+
+pytest.importorskip("jax")
+
+pytestmark = pytest.mark.skipif(
+    os.environ.get("BLENDJAX_SOAK") != "1",
+    reason="soak test (set BLENDJAX_SOAK=1)",
+)
+
+PRODUCER = os.path.join(
+    os.path.dirname(__file__), "..", "examples", "datagen", "cube_producer.py"
+)
+
+
+def _rss_mb() -> float:
+    # Current RSS (not getrusage's monotone high-water mark, which a
+    # warm-up compile spike would pin above any later leak).
+    with open("/proc/self/status") as f:
+        for line in f:
+            if line.startswith("VmRSS:"):
+                return int(line.split()[1]) / 1024
+    raise RuntimeError("VmRSS not found (non-Linux host?)")
+
+
+def test_tile_stream_memory_stable_over_many_batches():
+    from blendjax.data import StreamDataPipeline
+    from blendjax.launcher import PythonProducerLauncher
+
+    with PythonProducerLauncher(
+        script=PRODUCER,
+        num_instances=1,
+        named_sockets=["DATA"],
+        seed=0,
+        instance_args=[
+            ["--shape", "64", "64", "--batch", "8", "--encoding", "tile",
+             "--tile", "16"]
+        ],
+    ) as launcher:
+        with StreamDataPipeline(
+            launcher.addresses["DATA"], batch_size=8, chunk=4,
+            timeoutms=30_000,
+        ) as pipe:
+            it = iter(pipe)
+            for _ in range(50):  # settle allocators/compiles
+                next(it)
+            baseline = _rss_mb()
+            for _ in range(1500):
+                next(it)
+            grown = _rss_mb() - baseline
+    # max-RSS only grows; allow slack for allocator noise but catch a
+    # per-batch leak (1500 batches x even 100KB would be 150MB)
+    assert grown < 100, f"RSS grew {grown:.0f}MB over 1500 batches"

@@ -201,3 +201,38 @@ def test_publish_tracked_bounds_buffer_reuse():
     finally:
         recv.close()
         pub.close()
+
+
+def test_decode_rejects_malformed_frames():
+    """Corrupt wire input fails with clear errors, not silent garbage."""
+    import pytest as _pytest
+
+    from blendjax.transport.wire import decode_message, encode_message
+
+    frames = encode_message({"a": np.arange(6).reshape(2, 3)})
+    # bad magic: not tensor codec, not pickle -> pickle path raises
+    bad = [b"XXXX" + bytes(frames[0])[4:], *frames[1:]]
+    with _pytest.raises(Exception):
+        decode_message(bad)
+    # truncated payload frame: frombuffer size mismatch
+    truncated = [frames[0], bytes(frames[1])[:-8]]
+    with _pytest.raises(ValueError):
+        decode_message(truncated)
+    # unsupported wire version
+    import msgpack
+
+    from blendjax.constants import WIRE_MAGIC
+
+    hdr = WIRE_MAGIC + msgpack.packb([99, []], use_bin_type=True)
+    with _pytest.raises(ValueError, match="version"):
+        decode_message([hdr])
+
+
+def test_decode_rejects_pickle_when_disallowed():
+    from blendjax.transport.wire import decode_message, encode_message
+
+    frames = encode_message({"x": 1}, codec="pickle")
+    import pytest as _pytest
+
+    with _pytest.raises(ValueError, match="pickle"):
+        decode_message(frames, allow_pickle=False)
