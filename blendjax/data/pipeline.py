@@ -19,6 +19,7 @@ import collections
 import numpy as np
 
 from blendjax.utils.logging import get_logger
+from blendjax.utils.metrics import metrics
 
 logger = get_logger("data")
 
@@ -237,6 +238,9 @@ class TileStreamDecoder:
             btid = hb.get("btid")
             new_refs: dict = {}
             T.pop_stream_refs(hb, new_refs, btid)
+            for ref in new_refs.values():
+                # keyframe refs are wire bytes too (ratio honesty)
+                metrics.count("tiles.wire_bytes", int(ref.nbytes))
             for key, ref in new_refs.items():
                 # Keyframe refs usually repeat the one we already hold:
                 # skip the device placement then (host compare is cheap
@@ -305,6 +309,15 @@ class TileStreamDecoder:
             }
             rest = {k: v for k, v in hb.items() if k not in arrays}
             buf, spec = T.pack_fields(arrays)
+            metrics.count("tiles.batches")
+            metrics.count("tiles.wire_bytes", int(buf.nbytes))
+            for name in names:
+                h_, w_, c_ = self._shapes[name][:3]
+                lead = int(arrays[name + T.TILEIDX_SUFFIX].shape[0])
+                # what the equivalent raw frames would have transferred
+                metrics.count(
+                    "tiles.decoded_bytes", int(h_ * w_ * c_) * lead
+                )
             if self.chunk == 1:
                 self._plans.append((names, btid, spec, rest))
                 yield {"__packed__": buf}

@@ -117,3 +117,43 @@ def test_pipeline_respawn_keeps_stream_alive():
             launcher.processes[0].terminate()
             batch = next(it)
             assert batch["image"].shape == (4, 32, 32, 4)
+
+
+def test_tile_stream_metrics_expose_compression_ratio():
+    """The pipeline counts wire vs decoded bytes so the sparse-stream
+    compression ratio is observable (SURVEY.md §5: instrument ingest)."""
+    import os
+
+    from blendjax.data import StreamDataPipeline
+    from blendjax.launcher import PythonProducerLauncher
+    from blendjax.utils.metrics import metrics
+
+    producer = os.path.join(
+        os.path.dirname(__file__), "..", "examples", "datagen",
+        "cube_producer.py",
+    )
+    before = dict(metrics.counters)
+    with PythonProducerLauncher(
+        script=producer,
+        num_instances=1,
+        named_sockets=["DATA"],
+        seed=0,
+        instance_args=[
+            ["--shape", "64", "64", "--batch", "4", "--encoding", "tile",
+             "--tile", "16"]
+        ],
+    ) as launcher:
+        with StreamDataPipeline(
+            launcher.addresses["DATA"], batch_size=4, timeoutms=30_000,
+            max_items=3,
+        ) as pipe:
+            batches = list(pipe)
+    assert len(batches) == 3
+    wire = metrics.counters["tiles.wire_bytes"] - before.get(
+        "tiles.wire_bytes", 0
+    )
+    decoded = metrics.counters["tiles.decoded_bytes"] - before.get(
+        "tiles.decoded_bytes", 0
+    )
+    assert decoded == 3 * 4 * 64 * 64 * 4
+    assert 0 < wire < decoded  # compressed on the wire

@@ -57,6 +57,6 @@ def test_tile_stream_memory_stable_over_many_batches():
             for _ in range(1500):
                 next(it)
             grown = _rss_mb() - baseline
-    # max-RSS only grows; allow slack for allocator noise but catch a
-    # per-batch leak (1500 batches x even 100KB would be 150MB)
+    # current RSS; slack covers allocator noise, but a per-batch leak
+    # shows clearly (1500 batches x even 100KB would be 150MB)
     assert grown < 100, f"RSS grew {grown:.0f}MB over 1500 batches"
