@@ -418,7 +418,8 @@ def test_tile_stream_survives_producer_respawn():
             launcher.addresses["DATA"], batch_size=4,
             # generous timeout: the respawned interpreter needs a few
             # seconds to boot on a loaded core before publishing resumes
-            launcher=launcher, timeoutms=8000,
+            # (3 retries x this budget before the stream gives up)
+            launcher=launcher, timeoutms=15000,
         ) as pipe:
             it = iter(pipe)
             first = next(it)
