@@ -472,7 +472,7 @@ def _pallas_decode_scatter(ref_tiles, idx, tiles, interpret: bool = False):
         num_scalar_prefetch=1,
         grid=(b, k),
         in_specs=[
-            pl.BlockSpec(memory_space=pltpu.ANY),  # base: alias target
+            pl.BlockSpec(memory_space=pl.ANY),  # base: alias target
             pl.BlockSpec(
                 (1, 1, 8, lanes), lambda bi, ki, idxp: (bi, ki, 0, 0)
             ),
