@@ -736,3 +736,60 @@ def test_palettize_roundtrip_and_fallbacks():
             expand_palette_tiles_np(*native_res[:2], native_res[2], 16, 4),
             expand_palette_tiles_np(*numpy_res[:2], numpy_res[2], 16, 4),
         )
+
+
+def test_chunk_mode_rejects_raw_messages():
+    """chunk>1 over a stream containing a non-tile message fails loudly
+    (the chunked-step consumer contract expects superbatches only)."""
+    from blendjax.data import StreamDataPipeline
+
+    def messages():
+        yield {"_batched": True, "btid": 0,
+               "image": np.zeros((4, 32, 32, 4), np.uint8)}
+
+    pipe = StreamDataPipeline(messages(), batch_size=4, chunk=4)
+    with pytest.raises(RuntimeError, match="all-tile"):
+        list(pipe)
+
+
+def test_prebatched_size_mismatch_warns_once(caplog):
+    """A producer batch size differing from the pipeline's passes through
+    ragged, flagged by a single warning."""
+    import logging
+
+    from blendjax.data import StreamDataPipeline
+    from blendjax.ops.tiles import (
+        TILEIDX_SUFFIX,
+        TILEREF_SUFFIX,
+        TILES_SUFFIX,
+        TILESHAPE_SUFFIX,
+    )
+
+    ref, frames = _frames(n=6, shape=(32, 32), seed=2)
+    enc = TileDeltaEncoder(ref, tile=16)
+
+    def messages():
+        for start in (0, 3):
+            batch = frames[start:start + 3]  # producer batches of 3
+            deltas = [tuple(a.copy() for a in enc.encode(f)) for f in batch]
+            idx, tiles = pack_batch(deltas, enc.num_tiles, capacity=4)
+            msg = {
+                "_prebatched": True, "btid": 0,
+                "image" + TILEIDX_SUFFIX: idx,
+                "image" + TILES_SUFFIX: tiles,
+                "image" + TILESHAPE_SUFFIX: [32, 32, 4, 16],
+            }
+            if start == 0:
+                msg["image" + TILEREF_SUFFIX] = ref
+            yield msg
+
+    with caplog.at_level(logging.WARNING, logger="blendjax.data"):
+        pipe = StreamDataPipeline(messages(), batch_size=8)  # != 3
+        got = list(pipe)
+    assert [b["image"].shape[0] for b in got] == [3, 3]  # ragged pass-through
+    for start, b in zip((0, 3), got):
+        img = np.asarray(b["image"])
+        for i in range(3):
+            np.testing.assert_array_equal(img[i], frames[start + i])
+    warns = [r for r in caplog.records if "prebatched" in r.message]
+    assert len(warns) == 1  # warned once, not per message
