@@ -10,6 +10,11 @@ Baseline (BASELINE.md): reference best published aggregate is 0.012
 s/image = 83.3 images/s with 4 Blender instances; ``vs_baseline`` is
 measured_throughput / 83.3.
 
+The headline metric is the tile-delta stream (the flagship encoding); a
+shorter raw-frame measurement is embedded as ``detail.raw_row`` so the
+non-sparse regression is tracked per round (VERDICT r1 item 7). Disable
+it with ``BLENDJAX_BENCH_RAW_ROW=0``.
+
 Prints exactly one JSON line.
 """
 
@@ -30,22 +35,18 @@ BASELINE_IMG_PER_SEC = 1.0 / 0.012  # Readme.md:92, 4 instances
 TIME_CAP_S = 120.0
 ENCODING = os.environ.get("BLENDJAX_BENCH_ENCODING", "tile")
 CHUNK = int(os.environ.get("BLENDJAX_BENCH_CHUNK", "8"))
+# Fusing decode into the train jit halves device calls but XLA compiles
+# a measurably slower combined program on v5e (212 vs ~355 img/s
+# end-to-end, repeated A/B) — so decode-then-step stays the default and
+# the fused step remains an opt-in for high-latency-dispatch links.
+FUSED = os.environ.get("BLENDJAX_BENCH_FUSED", "0") == "1"
+RAW_ROW = os.environ.get("BLENDJAX_BENCH_RAW_ROW", "1") == "1"
 
 
-def main() -> None:
+def measure(encoding: str, chunk: int, items: int, time_cap: float,
+            with_stages: bool = True) -> dict:
+    """One full producer-fleet + pipeline + train measurement pass."""
     import jax
-
-    # Persistent XLA compile cache: the train step costs a few seconds to
-    # compile (twice: jit outputs carry device layouts the first executable
-    # didn't see), which otherwise lands on every fresh bench process.
-    try:
-        cache = os.path.join(
-            os.path.dirname(os.path.abspath(__file__)), ".xla_cache"
-        )
-        jax.config.update("jax_compilation_cache_dir", cache)
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
-    except Exception:
-        pass  # older jax without these flags: compile per run
 
     from blendjax.data import StreamDataPipeline
     from blendjax.launcher import PythonProducerLauncher
@@ -53,9 +54,11 @@ def main() -> None:
     from blendjax.parallel import batch_sharding, create_mesh
     from blendjax.train import (
         make_chunked_supervised_step,
+        make_fused_tile_step,
         make_supervised_step,
         make_train_state,
     )
+    from blendjax.utils.metrics import metrics as reg
 
     cpu = os.cpu_count() or 1
     instances = max(1, min(6, cpu - 1)) if cpu > 1 else 1
@@ -66,12 +69,14 @@ def main() -> None:
     state = make_train_state(
         model, np.zeros((BATCH, *SHAPE, 4), np.uint8), mesh=mesh
     )
-    # One jitted scan of CHUNK sequential updates per device call: same
-    # SGD trajectory as per-batch stepping, 1/CHUNK the device round
-    # trips (the binding constraint on high-latency links). Chunking
-    # rides the tile pipeline; raw mode steps per batch.
-    chunk = CHUNK if ENCODING == "tile" else 1
-    if chunk > 1:
+    # One jitted scan of `chunk` sequential updates per device call: same
+    # SGD trajectory as per-batch stepping, 1/chunk the transfers and
+    # device round trips (the binding constraint on high-latency links).
+    # Raw mode steps per batch.
+    chunk = chunk if encoding == "tile" else 1
+    if chunk > 1 and FUSED:
+        step = make_fused_tile_step()
+    elif chunk > 1:
         step = make_chunked_supervised_step()
     else:
         step = make_supervised_step(mesh=mesh, batch_sharding=sharding)
@@ -91,8 +96,7 @@ def main() -> None:
         # 16x16 tiles the cube touches cross the wire and the host->device
         # link; the consumer reconstructs bit-exact full frames on device
         # (blendjax.ops.tiles — the sustained host->HBM bandwidth is the
-        # end-to-end bottleneck for raw 1.2MB frames). Set
-        # BLENDJAX_BENCH_ENCODING=raw to ship full frames instead.
+        # end-to-end bottleneck for raw 1.2MB frames).
         # --tile-rgba: full-channel tiles decode through the Pallas
         # scatter kernel (~25x faster than the XLA scatter on TPU); the
         # ~33% extra wire bytes are the cheaper side of that trade.
@@ -101,11 +105,20 @@ def main() -> None:
         # touches ~200-280 of 1200 tiles at this size).
         instance_args=[
             ["--shape", str(SHAPE[0]), str(SHAPE[1]), "--batch", str(BATCH),
-             "--encoding", ENCODING, "--tile", "16", "--tile-rgba",
+             "--encoding", encoding, "--tile", "16", "--tile-rgba",
              "--tile-capacity", "320"]
         ] * instances,
     ) as launcher:
         def batch_images(sb):
+            if "_packed" in sb:
+                from blendjax.ops.tiles import TILEIDX_SUFFIX
+
+                # packed chunk group: K' rows x the tileidx lead dim B
+                idx_shape = next(
+                    s for n, d, s, o, b in sb["_spec"]
+                    if n.endswith(TILEIDX_SUFFIX)
+                )
+                return sb["_packed"].shape[0] * idx_shape[0]
             # chunked superbatches are (K, B, ...); raw batches (B, ...)
             return (
                 sb["image"].shape[0] * sb["image"].shape[1]
@@ -116,19 +129,23 @@ def main() -> None:
             loss = metrics["loss"]
             return float(loss[-1] if getattr(loss, "ndim", 0) else loss)
 
+        def run_step(state, sb):
+            if "_packed" in sb:
+                return step(state, sb)
+            return step(state, {"image": sb["image"], "xy": sb["xy"]})
+
         with StreamDataPipeline(
             launcher.addresses["DATA"],
             batch_size=BATCH,
             sharding=sharding,
             chunk=chunk,
+            emit_packed=chunk > 1 and FUSED,
             timeoutms=60_000,
         ) as pipe:
             it = iter(pipe)
             for _ in range(max(1, WARMUP_BATCHES // chunk)):
                 sb = next(it)  # warmup: compile + fill queues
-                state, metrics = step(
-                    state, {"image": sb["image"], "xy": sb["xy"]}
-                )
+                state, metrics = run_step(state, sb)
             # Sync by fetching the value, not block_until_ready: on
             # tunneled/experimental backends block_until_ready can return
             # with steps still in flight, and the loss value transitively
@@ -136,37 +153,95 @@ def main() -> None:
             # d2h fetch is the one sync that is honest everywhere.
             last_loss(metrics)
 
+            reg.reset()  # stage spans cover the measured window only
             images = 0
+            t_next = t_step = 0.0
             t0 = time.perf_counter()
-            while images < MEASURE_ITEMS:
+            while images < items:
+                ta = time.perf_counter()
                 sb = next(it)
-                state, metrics = step(
-                    state, {"image": sb["image"], "xy": sb["xy"]}
-                )
+                tb = time.perf_counter()
+                state, metrics = run_step(state, sb)
+                tc = time.perf_counter()
+                t_next += tb - ta
+                t_step += tc - tb
                 images += batch_images(sb)
-                if time.perf_counter() - t0 > TIME_CAP_S:
+                if tc - t0 > time_cap:
                     break
+            t_sync0 = time.perf_counter()
             final_loss = last_loss(metrics)  # full drain, see above
+            t_sync = time.perf_counter() - t_sync0
             dt = time.perf_counter() - t0
 
-    ips = images / dt
+    result = {
+        "value": round(images / dt, 2),
+        "instances": instances,
+        "encoding": encoding,
+        "chunk": chunk,
+        "batch": BATCH,
+        "images": images,
+        "seconds": round(dt, 2),
+        "final_loss": final_loss,
+    }
+    if with_stages:
+        # Per-stage breakdown (VERDICT r1 item 1): consumer-loop wall
+        # split + pipeline spans, so the binding constraint is
+        # driver-evidenced. `consumer_wall` buckets are disjoint and sum
+        # to ~dt; span totals overlap them (spans run inside next())
+        # except ingest.recv, which runs in the ingest thread
+        # concurrently with the main loop.
+        result["stages"] = {
+            "consumer_wall": {
+                "next_batch_s": round(t_next, 3),
+                "step_dispatch_s": round(t_step, 3),
+                "final_sync_s": round(t_sync, 3),
+            },
+            "spans": {
+                k: {"count": v["count"],
+                    "total_s": round(v["total_s"], 3),
+                    "mean_ms": round(v["mean_ms"], 3)}
+                for k, v in reg.spans().items()
+            },
+            "counters": {
+                k: int(v) for k, v in reg.counters.items()
+                if k.startswith(("tiles.", "ingest."))
+            },
+        }
+    return result
+
+
+def main() -> None:
+    import jax
+
+    # Persistent XLA compile cache: the train step costs a few seconds to
+    # compile (twice: jit outputs carry device layouts the first executable
+    # didn't see), which otherwise lands on every fresh bench process.
+    try:
+        cache = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), ".xla_cache"
+        )
+        jax.config.update("jax_compilation_cache_dir", cache)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    except Exception:
+        pass  # older jax without these flags: compile per run
+
+    primary = measure(ENCODING, CHUNK, MEASURE_ITEMS, TIME_CAP_S)
+    detail = dict(primary)
+    ips = detail.pop("value")
+    detail["backend"] = jax.default_backend()
+    if ENCODING == "tile" and RAW_ROW:
+        # Shorter raw-frame row: tracks the non-sparse path (full 1.2MB
+        # frames over wire + host->device) without doubling bench time.
+        raw = measure("raw", 1, 128, 45.0, with_stages=False)
+        detail["raw_row"] = raw
     print(
         json.dumps(
             {
                 "metric": "cube_640x480_stream+train images/sec/chip",
-                "value": round(ips, 2),
+                "value": ips,
                 "unit": "images/s",
                 "vs_baseline": round(ips / BASELINE_IMG_PER_SEC, 3),
-                "detail": {
-                    "instances": instances,
-                    "encoding": ENCODING,
-                    "chunk": chunk,
-                    "batch": BATCH,
-                    "images": images,
-                    "seconds": round(dt, 2),
-                    "backend": jax.default_backend(),
-                    "final_loss": final_loss,
-                },
+                "detail": detail,
             }
         )
     )

@@ -157,7 +157,15 @@ class HostIngest:
     def _run(self):
         try:
             assembler = None
-            for item in self.stream:
+            stream_it = iter(self.stream)
+            while True:
+                # span: time blocked on the socket/decode (vs assembly
+                # below) — the ingest half of the bench stage breakdown
+                with metrics.span("ingest.recv"):
+                    try:
+                        item = next(stream_it)
+                    except StopIteration:
+                        break
                 if self._stop.is_set():
                     break
                 if item.pop("_prebatched", False):
@@ -169,14 +177,30 @@ class HostIngest:
                     # allowed (ragged tails from a producer flush) but
                     # flagged once, since a jitted train step will
                     # recompile for the odd shape.
+                    # A `*__tileidx` field's leading dim is authoritative
+                    # for tile messages (sidecar palette/keyframe arrays
+                    # carry unrelated leading dims); fall back to the
+                    # first array field for other prebatched producers.
+                    from blendjax.ops.tiles import TILEIDX_SUFFIX
+
                     lead = next(
                         (
                             v.shape[0]
-                            for v in item.values()
-                            if isinstance(v, np.ndarray) and v.ndim > 0
+                            for k, v in item.items()
+                            if k.endswith(TILEIDX_SUFFIX)
+                            and isinstance(v, np.ndarray) and v.ndim > 0
                         ),
-                        0,
+                        None,
                     )
+                    if lead is None:
+                        lead = next(
+                            (
+                                v.shape[0]
+                                for v in item.values()
+                                if isinstance(v, np.ndarray) and v.ndim > 0
+                            ),
+                            0,
+                        )
                     if lead != self.batch_size and not self._warned_prebatch:
                         self._warned_prebatch = True
                         logger.warning(
@@ -253,7 +277,10 @@ class HostIngest:
         if self._thread is None:
             self.start()
         while True:
-            batch = self._queue.get()
+            # span: consumer-side wait for the ingest thread — near-zero
+            # when ingest outruns the device, the whole story when not
+            with metrics.span("ingest.queue_wait"):
+                batch = self._queue.get()
             if batch is self._DONE:
                 if self._error is not None:
                     raise self._error

@@ -148,8 +148,10 @@ class DeviceFeeder:
             while self.throttle and len(window) >= self.throttle:
                 oldest = window.popleft()
                 if oldest is not None:
-                    jax.block_until_ready(oldest)
-            db = self._place(hb)
+                    with metrics.span("feed.throttle_wait"):
+                        jax.block_until_ready(oldest)
+            with metrics.span("feed.place"):
+                db = self._place(hb)
             if self.throttle:
                 window.append(self._largest(db))
             return db
@@ -199,10 +201,23 @@ class TileStreamDecoder:
     """
 
     def __init__(self, sharding=None, multihost: bool = False,
-                 chunk: int = 1):
+                 chunk: int = 1, chunk_strict: bool = False,
+                 emit_packed: bool = False):
         self.sharding = sharding
         self.multihost = multihost
         self.chunk = max(1, int(chunk))
+        # emit_packed=True skips the decode jit: device_stage yields
+        # ``{"_packed", "_refs", "_spec", "_names", "_geoms", ...}`` for
+        # :func:`blendjax.train.make_fused_tile_step`, which fuses the
+        # decode into the train jit — one device call per chunk group
+        # instead of two. Tile groups always route through the chunk
+        # path (K'=1 groups when chunk==1).
+        self.emit_packed = bool(emit_packed)
+        # strict=True restores the fail-fast contract: any non-tile
+        # message in a chunk>1 stream raises instead of degrading to a
+        # K'=1 superbatch (see host_stage).
+        self.chunk_strict = bool(chunk_strict)
+        self._warned_mixed = False
         self._refs: dict = {}       # (name, btid) -> device ref_tiles
         self._host_refs: dict = {}  # (name, btid) -> host copy (dedup)
         self._ref_digest: dict = {}  # (name, btid) -> bytes digest
@@ -290,13 +305,33 @@ class TileStreamDecoder:
                     "for multi-process global batch assembly"
                 )
             if not names:
-                if self.chunk > 1:
-                    raise RuntimeError(
-                        "chunk>1 requires an all-tile-encoded stream: a "
-                        "non-tile message arrived, and the chunked step "
-                        "consumer expects (K, B, ...) superbatches only "
-                        "(run raw/mixed streams with chunk=1)"
-                    )
+                if self.chunk > 1 or self.emit_packed:
+                    if self.chunk_strict:
+                        raise RuntimeError(
+                            "chunk>1 requires an all-tile-encoded stream: "
+                            "a non-tile message arrived, and the chunked "
+                            "step consumer expects (K, B, ...) "
+                            "superbatches only (chunk_strict=True)"
+                        )
+                    # Degrade instead of killing training: flush the
+                    # in-flight group, then ship this raw batch as a
+                    # K'=1 superbatch (device_stage adds the leading
+                    # chunk axis post-placement so batch sharding stays
+                    # on the batch dim). One misconfigured producer in a
+                    # fleet costs throughput, not the run.
+                    if not self._warned_mixed:
+                        self._warned_mixed = True
+                        logger.warning(
+                            "non-tile message in a chunk=%d stream: "
+                            "flushing the group and degrading to K'=1 "
+                            "superbatches for raw batches (pass "
+                            "chunk_strict=True to fail fast instead)",
+                            self.chunk,
+                        )
+                    yield from self._flush_group(group)
+                    self._plans.append(("raw1",))
+                    yield hb
+                    continue
                 self._plans.append(None)
                 yield hb
                 continue
@@ -308,7 +343,8 @@ class TileStreamDecoder:
                 k: v for k, v in hb.items() if isinstance(v, np.ndarray)
             }
             rest = {k: v for k, v in hb.items() if k not in arrays}
-            buf, spec = T.pack_fields(arrays)
+            with metrics.span("tiles.pack"):
+                buf, spec = T.pack_fields(arrays)
             metrics.count("tiles.batches")
             metrics.count("tiles.wire_bytes", int(buf.nbytes))
             for name in names:
@@ -318,8 +354,18 @@ class TileStreamDecoder:
                 metrics.count(
                     "tiles.decoded_bytes", int(h_ * w_ * c_) * lead
                 )
-            if self.chunk == 1:
-                self._plans.append((names, btid, spec, rest))
+            if self.chunk == 1 and not self.emit_packed:
+                # Pin the device refs + geometry INTO the plan: host_stage
+                # runs `prefetch` batches ahead of device_stage, and a
+                # producer restarting with new scene content would replace
+                # self._refs[(name, btid)] while this batch is in flight —
+                # a decode-time lookup would then reconstruct against the
+                # wrong reference.
+                self._plans.append((
+                    names, spec, rest,
+                    {n: self._refs[(n, btid)] for n in names},
+                    tuple(self._shapes[n] for n in names),
+                ))
                 yield {"__packed__": buf}
                 continue
             # Chunk mode: group while the packed layout AND reference
@@ -332,9 +378,15 @@ class TileStreamDecoder:
             if group and group["key"] != gkey:
                 yield from self._flush_group(group)
             if not group:
-                group.update(key=gkey, bufs=[], btids=[], rests=[])
+                # Refs/geoms pinned at group-formation time (same
+                # staleness hazard as the chunk==1 plan); the gkey digest
+                # guarantees later members share this ref content.
+                group.update(
+                    key=gkey, bufs=[], rests=[],
+                    refs={n: self._refs[(n, btid)] for n in names},
+                    geoms=tuple(self._shapes[n] for n in names),
+                )
             group["bufs"].append(buf)
-            group["btids"].append(btid)
             group["rests"].append(rest)
             if len(group["bufs"]) == self.chunk:
                 yield from self._flush_group(group)
@@ -347,7 +399,8 @@ class TileStreamDecoder:
             return
         names, spec, _digests = group["key"]
         self._plans.append(
-            ("chunk", names, tuple(group["btids"]), spec, group["rests"])
+            ("chunk", names, spec, group["rests"],
+             group["refs"], group["geoms"])
         )
         stacked = np.stack(group["bufs"])
         group.clear()
@@ -375,46 +428,41 @@ class TileStreamDecoder:
                 _decode_packed, static_argnames=("spec", "names", "geoms")
             )
         if self._decode_chunk is None:
-
-            def _decode_packed_chunk(packed, refs, spec, names, geoms):
-                # packed: (K, total). Unpack each row, then decode every
-                # name's tiles flattened over (K*B) in ONE scatter call
-                # against the group's shared reference.
-                fields = jax.vmap(
-                    lambda p: T.unpack_fields(p, spec)
-                )(packed)
-                for name, geom in zip(names, geoms):
-                    idx = fields.pop(name + T.TILEIDX_SUFFIX)
-                    tiles = T.pop_tile_payload(
-                        fields, name, geom, T.expand_palette_tiles
-                    )
-                    k, b = idx.shape[:2]
-                    img = T.decode_tile_delta(
-                        refs[name],
-                        idx.reshape(k * b, *idx.shape[2:]),
-                        tiles.reshape(k * b, *tiles.shape[2:]),
-                        geom[:3],
-                    )
-                    fields[name] = img.reshape(k, b, *img.shape[1:])
-                return fields
-
             self._decode_chunk = jax.jit(
-                _decode_packed_chunk,
+                T.decode_packed_superbatch,
                 static_argnames=("spec", "names", "geoms"),
             )
         for db in device_batches:
             plan = self._plans.popleft()
+            if plan is not None and plan[0] == "raw1":
+                # Mixed-stream degradation (chunk_strict=False): lift the
+                # already-placed raw batch to a K'=1 superbatch. The
+                # expand happens AFTER device placement so the batch dim
+                # kept its data sharding; v[None] infers (None, *spec).
+                for k, v in list(db.items()):
+                    if k != "_meta" and getattr(v, "ndim", 0) >= 1:
+                        db[k] = v[None]
+                yield db
+                continue
             if plan is not None and plan[0] == "chunk":
-                _, names, btids, spec, rests = plan
-                fields = self._decode_chunk(
-                    db.pop("__packed__"),
-                    # group membership guarantees equal ref content; use
-                    # the first btid's device copy for all
-                    {n: self._refs[(n, btids[0])] for n in names},
-                    spec=spec,
-                    names=tuple(names),
-                    geoms=tuple(self._shapes[n] for n in names),
-                )
+                _, names, spec, rests, refs, geoms = plan
+                if self.emit_packed:
+                    db["_packed"] = db.pop("__packed__")
+                    db["_refs"] = refs
+                    db["_spec"] = spec
+                    db["_names"] = tuple(names)
+                    db["_geoms"] = geoms
+                    db["_meta"] = rests
+                    yield db
+                    continue
+                with metrics.span("decode.dispatch"):
+                    fields = self._decode_chunk(
+                        db.pop("__packed__"),
+                        refs,
+                        spec=spec,
+                        names=tuple(names),
+                        geoms=geoms,
+                    )
                 # Superbatch fields are (K, B, ...): move them to the
                 # configured batch sharding with the chunk axis
                 # replicated (async reshard; no-op on one device).
@@ -446,14 +494,15 @@ class TileStreamDecoder:
                 yield db
                 continue
             if plan is not None:
-                names, btid, spec, rest = plan
-                fields = self._decode(
-                    db.pop("__packed__"),
-                    {n: self._refs[(n, btid)] for n in names},
-                    spec=spec,
-                    names=tuple(names),
-                    geoms=tuple(self._shapes[n] for n in names),
-                )
+                names, spec, rest, refs, geoms = plan
+                with metrics.span("decode.dispatch"):
+                    fields = self._decode(
+                        db.pop("__packed__"),
+                        refs,
+                        spec=spec,
+                        names=tuple(names),
+                        geoms=geoms,
+                    )
                 # The packed buffer travels unsharded, so on a multi-
                 # device mesh the unpacked fields must be moved to their
                 # configured shardings (async reshard; a no-op when the
@@ -491,6 +540,8 @@ class StreamDataPipeline:
         multihost: bool = False,
         launcher=None,
         chunk: int = 1,
+        chunk_strict: bool = False,
+        emit_packed: bool = False,
         **stream_kwargs,
     ):
         from blendjax.data.stream import RemoteStream
@@ -528,11 +579,18 @@ class StreamDataPipeline:
         # resharding) sees the same simplified value and none pays the
         # explicit-sharding slow path on a 1-device mesh.
         sharding = DeviceFeeder._simplify(sharding)
+        # chunk>1 disables the transfer throttle: chunk grouping already
+        # cuts transfer count K-fold, and on serialized tunnel runtimes a
+        # throttle block waits behind ALL queued compute (measured
+        # ~150ms/wait on an axon link), costing far more than the queue
+        # depth it bounds.
         self.feeder = DeviceFeeder(
-            sharding=sharding, prefetch=prefetch, multihost=multihost
+            sharding=sharding, prefetch=prefetch, multihost=multihost,
+            throttle=0 if chunk > 1 else 8,
         )
         self.tiles = TileStreamDecoder(
-            sharding=sharding, multihost=multihost, chunk=chunk
+            sharding=sharding, multihost=multihost, chunk=chunk,
+            chunk_strict=chunk_strict, emit_packed=emit_packed,
         )
 
     @classmethod

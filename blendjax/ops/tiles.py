@@ -352,12 +352,35 @@ def expand_palette_tiles_np(packed, palette, bits: int, t: int, c: int):
 # 64-bit payloads are value-cast to 32 bits on the host before packing —
 # the same width jax's dtype canonicalization would give them on
 # device_put (and, for floats, a correct numeric conversion where a raw
-# bitcast would silently produce garbage).
+# bitcast would silently produce garbage). Skipped entirely when
+# jax_enable_x64 is set (device_put would keep 64 bits then, and the
+# packed path must match the raw-frame path bit for bit). Integer
+# narrowing is range-checked: a value that doesn't fit 32 bits (e.g. a
+# time_ns timestamp) raises instead of silently wrapping.
 _PACK_NARROW = {
     np.dtype(np.float64): np.float32,
     np.dtype(np.int64): np.int32,
     np.dtype(np.uint64): np.uint32,
 }
+
+
+def _narrow_for_pack(name: str, arr: np.ndarray) -> np.ndarray:
+    import jax
+
+    if jax.config.jax_enable_x64:
+        return arr  # device keeps 64 bits; pack must too
+    target = _PACK_NARROW[arr.dtype]
+    if arr.dtype.kind in "iu" and arr.size:
+        info = np.iinfo(target)
+        lo, hi = int(arr.min()), int(arr.max())
+        if lo < info.min or hi > info.max:
+            raise ValueError(
+                f"pack_fields: field {name!r} ({arr.dtype}) holds values "
+                f"[{lo}, {hi}] that do not fit {np.dtype(target)} — "
+                "pre-cast the field on the producer (e.g. ms instead of "
+                "time_ns) or enable jax_enable_x64"
+            )
+    return arr.astype(target)
 
 
 def pack_fields(fields: dict):
@@ -375,7 +398,7 @@ def pack_fields(fields: dict):
     for name, arr in fields.items():
         arr = np.ascontiguousarray(arr)
         if arr.dtype in _PACK_NARROW:
-            arr = arr.astype(_PACK_NARROW[arr.dtype])
+            arr = _narrow_for_pack(name, arr)
         raw = arr.view(np.uint8).reshape(-1)
         spec.append((name, arr.dtype.str, arr.shape, offset, raw.nbytes))
         parts.append(raw)
@@ -405,6 +428,38 @@ def unpack_fields(buf, spec):
             arr = lax.bitcast_convert_type(raw.reshape(-1, dt.itemsize), dt)
         out[name] = arr.reshape(shape)
     return out
+
+
+def decode_packed_superbatch(packed, refs, spec, names, geoms):
+    """Decode a stacked packed chunk group to full fields — jit-safe.
+
+    ``packed``: (K, total) uint8, K packed batches of identical layout
+    ``spec``. Each image field in ``names`` is reconstructed against its
+    device reference ``refs[name]`` with the per-name geometry in
+    ``geoms``; every name's tiles decode flattened over (K*B) in ONE
+    scatter call. Returns ``{field: (K, B, ...)}`` — all sidecar fields
+    keep their packed (K, ...) shapes.
+
+    Shared by :class:`blendjax.data.TileStreamDecoder` (decode-then-step)
+    and :func:`blendjax.train.make_fused_tile_step` (decode fused into
+    the train jit: one device call per K batches instead of two, which
+    matters on high-latency device links).
+    """
+    import jax
+
+    fields = jax.vmap(lambda p: unpack_fields(p, spec))(packed)
+    for name, geom in zip(names, geoms):
+        idx = fields.pop(name + TILEIDX_SUFFIX)
+        tiles = pop_tile_payload(fields, name, geom, expand_palette_tiles)
+        k, b = idx.shape[:2]
+        img = decode_tile_delta(
+            refs[name],
+            idx.reshape(k * b, *idx.shape[2:]),
+            tiles.reshape(k * b, *tiles.shape[2:]),
+            geom[:3],
+        )
+        fields[name] = img.reshape(k, b, *img.shape[1:])
+    return fields
 
 
 # -- device side ------------------------------------------------------------

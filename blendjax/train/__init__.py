@@ -10,6 +10,7 @@ from blendjax.train.steps import (
     corner_loss,
     make_chunked_supervised_step,
     make_eval_step,
+    make_fused_tile_step,
     make_train_state,
     make_supervised_step,
 )
@@ -20,6 +21,7 @@ __all__ = [
     "make_supervised_step",
     "make_chunked_supervised_step",
     "make_eval_step",
+    "make_fused_tile_step",
     "corner_loss",
     "CheckpointManager",
 ]

@@ -90,7 +90,8 @@ def main():
         # Replays through the identical ingest -> decode path as live
         # traffic (tile-delta recordings included), looping like epochs.
         pipe = StreamDataPipeline.from_recording(
-            args.replay, batch_size=args.batch, sharding=sharding, loop=True
+            args.replay, batch_size=args.batch, sharding=sharding, loop=True,
+            chunk=chunk,
         )
         with pipe:
             run_steps(iter(pipe))

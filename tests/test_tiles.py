@@ -279,6 +279,32 @@ def test_pack_unpack_fields_dtypes_roundtrip():
     np.testing.assert_array_equal(np.asarray(out["i32"]), fields["i32"])
 
 
+def test_pack_fields_overflowing_int64_raises():
+    """Integer narrowing is range-checked: a time_ns-style sidecar value
+    that doesn't fit 32 bits raises instead of silently wrapping."""
+    from blendjax.ops.tiles import pack_fields
+
+    with pytest.raises(ValueError, match="do not fit"):
+        pack_fields({"t_ns": np.array([1_722_000_000_000_000_000], np.int64)})
+    with pytest.raises(ValueError, match="do not fit"):
+        pack_fields({"u": np.array([2**33], np.uint64)})
+
+
+def test_pack_fields_keeps_64bit_under_x64():
+    """With jax_enable_x64, device_put would keep 64 bits — the packed
+    path must match the raw-frame path bit for bit, so no narrowing."""
+    from blendjax.ops.tiles import pack_fields, unpack_fields
+
+    big = np.array([2**40, -(2**40)], np.int64)
+    jax.config.update("jax_enable_x64", True)
+    try:
+        buf, spec = pack_fields({"big": big})
+        out = jax.jit(unpack_fields, static_argnames=("spec",))(buf, spec)
+        np.testing.assert_array_equal(np.asarray(out["big"]), big)
+    finally:
+        jax.config.update("jax_enable_x64", False)
+
+
 def test_pack_batch_padding_is_zeroed():
     ref, frames = _frames()
     enc = TileDeltaEncoder(ref, tile=16)
@@ -680,6 +706,85 @@ def test_chunked_step_equals_sequential_steps():
     )
 
 
+def test_fused_tile_step_matches_decode_then_step():
+    """emit_packed + make_fused_tile_step trains bit-identically to the
+    decode-then-chunked-step pipeline over the same synthetic tile
+    stream (same SGD trajectory, same losses)."""
+    import optax
+
+    from blendjax.data import StreamDataPipeline
+    from blendjax.models import CubeRegressor
+    from blendjax.ops.tiles import (
+        TILEIDX_SUFFIX,
+        TILEREF_SUFFIX,
+        TILES_SUFFIX,
+        TILESHAPE_SUFFIX,
+    )
+    from blendjax.train import (
+        make_chunked_supervised_step,
+        make_fused_tile_step,
+        make_train_state,
+    )
+
+    ref, frames = _frames(n=8, shape=(32, 32), seed=11)
+    rng = np.random.default_rng(5)
+    xys = (rng.random((4, 2, 8, 2)) * 32).astype(np.float32)
+    enc = TileDeltaEncoder(ref, tile=16)
+
+    def messages():
+        for g in range(4):  # 4 batches of 2 frames
+            batch = frames[2 * g: 2 * g + 2]
+            deltas = [tuple(a.copy() for a in enc.encode(f)) for f in batch]
+            idx, tiles = pack_batch(deltas, enc.num_tiles, capacity=4)
+            msg = {
+                "_prebatched": True, "btid": 0,
+                "image" + TILEIDX_SUFFIX: idx,
+                "image" + TILES_SUFFIX: tiles,
+                "image" + TILESHAPE_SUFFIX: [32, 32, 4, 16],
+                "xy": xys[g],
+            }
+            if g == 0:
+                msg["image" + TILEREF_SUFFIX] = ref
+            yield msg
+
+    s0 = make_train_state(
+        CubeRegressor(), frames[0][None].repeat(2, 0),
+        optimizer=optax.sgd(0.01),
+    )
+
+    with StreamDataPipeline(messages(), batch_size=2, chunk=2) as pipe:
+        decoded = list(pipe)
+    assert [np.asarray(b["image"]).shape for b in decoded] == [
+        (2, 2, 32, 32, 4)
+    ] * 2
+    chunked = make_chunked_supervised_step(donate=False)
+    s_ref = s0
+    ref_losses = []
+    for b in decoded:
+        s_ref, m = chunked(s_ref, {"image": b["image"], "xy": b["xy"]})
+        ref_losses.extend(np.asarray(m["loss"]).tolist())
+
+    with StreamDataPipeline(
+        messages(), batch_size=2, chunk=2, emit_packed=True
+    ) as pipe:
+        packed_batches = list(pipe)
+    assert all("_packed" in b for b in packed_batches)
+    fused = make_fused_tile_step(donate=False)
+    s_fused = s0
+    fused_losses = []
+    for b in packed_batches:
+        s_fused, m = fused(s_fused, b)
+        fused_losses.extend(np.asarray(m["loss"]).tolist())
+
+    np.testing.assert_allclose(fused_losses, ref_losses, rtol=1e-6)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-6, atol=1e-8
+        ),
+        s_ref.params, s_fused.params,
+    )
+
+
 def test_palettize_roundtrip_and_fallbacks():
     """Palette compression: 4-bit for <=16 colors, 8-bit for <=256, None
     beyond; native and numpy passes agree; expansion is bit-exact."""
@@ -738,18 +843,75 @@ def test_palettize_roundtrip_and_fallbacks():
         )
 
 
-def test_chunk_mode_rejects_raw_messages():
-    """chunk>1 over a stream containing a non-tile message fails loudly
-    (the chunked-step consumer contract expects superbatches only)."""
+def test_chunk_strict_rejects_raw_messages():
+    """chunk>1 with chunk_strict=True over a stream containing a non-tile
+    message fails loudly (opt-in fail-fast contract)."""
     from blendjax.data import StreamDataPipeline
 
     def messages():
         yield {"_batched": True, "btid": 0,
                "image": np.zeros((4, 32, 32, 4), np.uint8)}
 
-    pipe = StreamDataPipeline(messages(), batch_size=4, chunk=4)
+    pipe = StreamDataPipeline(
+        messages(), batch_size=4, chunk=4, chunk_strict=True
+    )
     with pytest.raises(RuntimeError, match="all-tile"):
         list(pipe)
+
+
+def test_chunk_mode_degrades_on_mixed_stream(caplog):
+    """Default chunk>1 behavior on a mixed stream: the in-flight tile
+    group flushes, the raw batch passes through as a K'=1 superbatch with
+    one warning, and every frame still reconstructs bit-exactly."""
+    import logging
+
+    from blendjax.data import StreamDataPipeline
+    from blendjax.ops.tiles import (
+        TILEIDX_SUFFIX,
+        TILEREF_SUFFIX,
+        TILES_SUFFIX,
+        TILESHAPE_SUFFIX,
+    )
+
+    ref, frames = _frames(n=8, shape=(32, 32), seed=4)
+    enc = TileDeltaEncoder(ref, tile=16)
+    raw = np.stack(frames[4:6])  # the misconfigured producer's batch
+
+    def tile_msg(batch, with_ref):
+        deltas = [tuple(a.copy() for a in enc.encode(f)) for f in batch]
+        idx, tiles = pack_batch(deltas, enc.num_tiles, capacity=4)
+        msg = {
+            "_prebatched": True, "btid": 0,
+            "image" + TILEIDX_SUFFIX: idx,
+            "image" + TILES_SUFFIX: tiles,
+            "image" + TILESHAPE_SUFFIX: [32, 32, 4, 16],
+        }
+        if with_ref:
+            msg["image" + TILEREF_SUFFIX] = ref
+        return msg
+
+    def messages():
+        yield tile_msg(frames[0:2], True)   # group member 1
+        yield {"_batched": True, "btid": 1, "image": raw}  # intruder
+        yield tile_msg(frames[2:4], False)  # group member after flush
+        yield tile_msg(frames[6:8], False)
+
+    with caplog.at_level(logging.WARNING, logger="blendjax.data"):
+        pipe = StreamDataPipeline(messages(), batch_size=2, chunk=2)
+        got = list(pipe)
+
+    # flushed group of 1, the K'=1 raw superbatch, then a full group of 2
+    shapes = [np.asarray(b["image"]).shape for b in got]
+    assert shapes == [
+        (1, 2, 32, 32, 4), (1, 2, 32, 32, 4), (2, 2, 32, 32, 4)
+    ]
+    np.testing.assert_array_equal(np.asarray(got[0]["image"])[0, 0], frames[0])
+    np.testing.assert_array_equal(np.asarray(got[0]["image"])[0, 1], frames[1])
+    np.testing.assert_array_equal(np.asarray(got[1]["image"])[0], raw)
+    np.testing.assert_array_equal(np.asarray(got[2]["image"])[0, 0], frames[2])
+    np.testing.assert_array_equal(np.asarray(got[2]["image"])[1, 1], frames[7])
+    warns = [r for r in caplog.records if "non-tile message" in r.message]
+    assert len(warns) == 1
 
 
 def test_prebatched_size_mismatch_warns_once(caplog):
