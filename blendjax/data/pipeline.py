@@ -226,6 +226,7 @@ class TileStreamDecoder:
         self._plans: collections.deque = collections.deque()
         self._decode = None
         self._decode_chunk = None
+        self._decode_mh = None
 
     def reset(self) -> None:
         """Drop queued per-batch decode plans (call when re-iterating a
@@ -243,6 +244,21 @@ class TileStreamDecoder:
 
             return NamedSharding(s.mesh, PartitionSpec())
         return None
+
+    def _decode_mesh(self):
+        """(mesh, data_axis) for the sharded Pallas decode — taken from
+        the configured batch sharding's mesh and its leading spec axis;
+        (None, 'data') on single-device/unsharded pipelines (the decode
+        then auto-selects as before)."""
+        s = self.sharding
+        if isinstance(s, dict):
+            s = next((v for v in s.values() if v is not None), None)
+        mesh = getattr(s, "mesh", None)
+        if mesh is None or np.prod(list(mesh.shape.values())) <= 1:
+            return None, "data"
+        spec = getattr(s, "spec", None) or ()
+        axis = spec[0] if spec and isinstance(spec[0], str) else "data"
+        return mesh, axis
 
     def host_stage(self, host_batches):
         from blendjax.ops import tiles as T
@@ -268,10 +284,19 @@ class TileStreamDecoder:
                 tile = int(
                     hb.get(key[0] + T.TILESHAPE_SUFFIX, [0, 0, 0, T.TILE])[3]
                 )
-                ref_tiles = T.tile_ref(ref, tile)
                 s = self._replicated()
-                if s is not None:
-                    ref_tiles = jax.device_put(ref_tiles, s)
+                if self.multihost and s is not None:
+                    # Global replicated ref: every process holds the same
+                    # tiled view on its local devices (multihost tile
+                    # streams require fleet-shared reference content —
+                    # see _host_stage_multihost).
+                    ref_tiles = jax.make_array_from_process_local_data(
+                        s, T.tile_ref_np(np.asarray(ref), tile)
+                    )
+                else:
+                    ref_tiles = T.tile_ref(ref, tile)
+                    if s is not None:
+                        ref_tiles = jax.device_put(ref_tiles, s)
                 self._refs[key] = ref_tiles
             groups = T.pop_tile_batches(hb)
             names = []
@@ -296,14 +321,15 @@ class TileStreamDecoder:
             if missing:
                 continue  # drop the whole batch, keep plans aligned
             if names and self.multihost:
-                # Global-array assembly of packed/decoded tile batches
-                # across processes is not implemented; raw frames take the
-                # make_array_from_process_local_data path instead.
-                raise NotImplementedError(
-                    "tile-delta streams are not supported with "
-                    "multihost=True yet — use --encoding raw producers "
-                    "for multi-process global batch assembly"
-                )
+                if self.chunk > 1:
+                    # Chunk groups would need lockstep flush boundaries
+                    # across processes; run multihost tiles with chunk=1.
+                    raise NotImplementedError(
+                        "chunk>1 is not supported with multihost tile "
+                        "streams yet — use chunk=1 (per-batch decode)"
+                    )
+                yield from self._host_stage_multihost(hb, names, btid)
+                continue
             if not names:
                 if self.chunk > 1 or self.emit_packed:
                     if self.chunk_strict:
@@ -392,6 +418,74 @@ class TileStreamDecoder:
                 yield from self._flush_group(group)
         yield from self._flush_group(group)
 
+    def _host_stage_multihost(self, hb, names, btid):
+        """Tile batch -> per-field global assembly plan (multihost).
+
+        The packed single-buffer transfer cannot shard (bytes, not
+        batch), so each batch-leading tile field rides the feeder's
+        ``make_array_from_process_local_data`` path individually and the
+        DECODE runs on the assembled global batch — GSPMD partitions the
+        scatter shard-locally per device (or the shard_map Pallas kernel
+        takes over when eligible), which is exactly "decode
+        shard-locally, assemble globally".
+
+        SPMD contract: every process must stream identical wire shapes
+        (pin ``TileBatchPublisher(capacity=...)`` across the fleet) and
+        fleet-shared reference content — the global batch decodes
+        against ONE replicated reference per field; a producer whose ref
+        digest differs from the one this process holds would reconstruct
+        wrong rows (warned once per field below).
+        """
+        from blendjax.ops import tiles as T
+
+        fields = {}
+        rest = {}
+        for k, v in hb.items():
+            if isinstance(v, np.ndarray) and v.ndim >= 1:
+                fields[k] = v
+            else:
+                rest[k] = v
+        refs = {}
+        for name in names:
+            # Deterministic shared ref: the first producer's (insertion
+            # order), so every process resolves the same content when
+            # the fleet shares one scene background.
+            first_key = next(k for k in self._refs if k[0] == name)
+            if (
+                self._ref_digest.get((name, btid))
+                != self._ref_digest.get(first_key)
+                and (name, "mh") not in self._skipped
+            ):
+                self._skipped.add((name, "mh"))
+                logger.warning(
+                    "multihost tile stream %r: producer %r sent a "
+                    "reference differing from the fleet's — its rows "
+                    "will decode against the shared reference (pin one "
+                    "scene background across the fleet)", name, btid,
+                )
+            refs[name] = self._refs[first_key]
+            pal_key = name + T.PALETTE_SUFFIX
+            if pal_key in fields:
+                # Per-row palettes: expand_palette_tiles' grouped path
+                # gathers row i through palette row i, and the global
+                # assembly stacks processes on the leading axis, so each
+                # process's rows keep their own palette.
+                packed_key = (
+                    name + T.TILEPAL4_SUFFIX
+                    if name + T.TILEPAL4_SUFFIX in fields
+                    else name + T.TILEPAL8_SUFFIX
+                )
+                b = fields[packed_key].shape[0]
+                pal = fields[pal_key]
+                fields[pal_key] = np.ascontiguousarray(
+                    np.broadcast_to(pal[None], (b, *pal.shape))
+                )
+        self._plans.append(
+            ("mh", tuple(names), tuple(self._shapes[n] for n in names),
+             rest, refs)
+        )
+        yield fields
+
     def _flush_group(self, group):
         """Emit a buffered chunk group (possibly shorter than ``chunk``)
         as one stacked packed transfer; no-op when empty."""
@@ -411,6 +505,7 @@ class TileStreamDecoder:
 
         jax = _require_jax()
         if self._decode is None:
+            mesh, axis = self._decode_mesh()
 
             def _decode_packed(packed, refs, spec, names, geoms):
                 fields = T.unpack_fields(packed, spec)
@@ -420,7 +515,8 @@ class TileStreamDecoder:
                         fields, name, geom, T.expand_palette_tiles
                     )
                     fields[name] = T.decode_tile_delta(
-                        refs[name], idx, tiles, geom[:3]
+                        refs[name], idx, tiles, geom[:3],
+                        mesh=mesh, data_axis=axis,
                     )
                 return fields
 
@@ -428,12 +524,47 @@ class TileStreamDecoder:
                 _decode_packed, static_argnames=("spec", "names", "geoms")
             )
         if self._decode_chunk is None:
+            import functools
+
+            mesh, axis = self._decode_mesh()
             self._decode_chunk = jax.jit(
-                T.decode_packed_superbatch,
+                functools.partial(
+                    T.decode_packed_superbatch, mesh=mesh, data_axis=axis
+                ),
                 static_argnames=("spec", "names", "geoms"),
+            )
+        if self._decode_mh is None:
+            mesh, axis = self._decode_mesh()
+
+            def _decode_fields(fields, refs, names, geoms):
+                for name, geom in zip(names, geoms):
+                    idx = fields.pop(name + T.TILEIDX_SUFFIX)
+                    tiles = T.pop_tile_payload(
+                        fields, name, geom, T.expand_palette_tiles
+                    )
+                    fields[name] = T.decode_tile_delta(
+                        refs[name], idx, tiles, geom[:3],
+                        mesh=mesh, data_axis=axis,
+                    )
+                return fields
+
+            self._decode_mh = jax.jit(
+                _decode_fields, static_argnames=("names", "geoms")
             )
         for db in device_batches:
             plan = self._plans.popleft()
+            if plan is not None and plan[0] == "mh":
+                _, names, geoms, rest, refs = plan
+                meta = db.pop("_meta", None)
+                with metrics.span("decode.dispatch"):
+                    fields = self._decode_mh(
+                        db, refs, names=names, geoms=geoms
+                    )
+                fields.update(rest)
+                if meta is not None:
+                    fields["_meta"] = meta
+                yield fields
+                continue
             if plan is not None and plan[0] == "raw1":
                 # Mixed-stream degradation (chunk_strict=False): lift the
                 # already-placed raw batch to a K'=1 superbatch. The
@@ -574,6 +705,16 @@ class StreamDataPipeline:
         self.batch_size = batch_size
         self.schema = schema
         self.prefetch = prefetch
+        if emit_packed and multihost:
+            # The packed single-buffer form cannot shard (bytes, not
+            # batch): multihost tile batches are decoded via global-array
+            # assembly instead, so there is nothing packed to emit and
+            # make_fused_tile_step would mis-consume the decoded batches.
+            raise NotImplementedError(
+                "emit_packed=True is incompatible with multihost=True — "
+                "multihost tile streams decode via global-array assembly "
+                "(use the regular decode-then-step path)"
+            )
         # Single-device shardings are stripped ONCE here so every stage
         # below (feeder placement, tile ref placement, decoded-field
         # resharding) sees the same simplified value and none pays the

@@ -11,10 +11,10 @@ blendjax-native stream forms are normalized back to reference item
 semantics: producer-batched messages (``_batched``/``_prebatched``)
 split into per-item dicts, and tile-delta messages are reconstructed
 host-side (numpy, bit-exact) so torch consumers see plain ``image``
-arrays regardless of the wire encoding. One caveat: ``max_items``
-counts *messages* at the stream layer, so against batch-publishing
-producers it bounds messages, not items (the reference only ever had
-one item per message).
+arrays regardless of the wire encoding. ``max_items`` counts *items*
+after that normalization — batch-publishing producers' messages are
+split before the count — matching the reference's per-item semantics
+(``dataset.py:80-97``) exactly.
 """
 
 from __future__ import annotations
@@ -128,17 +128,38 @@ class RemoteIterableDataset(tud.IterableDataset):
                 yield transform(item)
 
     def __iter__(self):
+        import itertools
+
         info = tud.get_worker_info()
         worker_index = info.id if info is not None else 0
         num_workers = info.num_workers if info is not None else 1
+        # max_items bounds ITEMS, so the message-level stream runs
+        # unbounded and the cap applies after batch splitting (islice
+        # closes the generator, which closes the socket). The per-worker
+        # split mirrors the reference: max_items // num_workers each,
+        # remainder to worker 0 (``dataset.py:80-97``).
         stream = RemoteStream(
             self.addresses,
             queue_size=self.queue_size,
             timeoutms=self.timeoutms,
-            max_items=self.max_items,
             record_path_prefix=self.record_path_prefix,
             worker_index=worker_index,
             num_workers=num_workers,
             copy_arrays=True,  # torch tensors need writable arrays
         )
-        return self._items(iter(stream))
+        messages = iter(stream)
+        items = self._items(messages)
+        if self.max_items is None:
+            return items
+        share = self.max_items // num_workers
+        if worker_index == 0:
+            share += self.max_items % num_workers
+
+        def capped():
+            try:
+                yield from itertools.islice(items, share)
+            finally:
+                items.close()
+                messages.close()  # deterministic socket teardown at the cap
+
+        return capped()

@@ -430,7 +430,8 @@ def unpack_fields(buf, spec):
     return out
 
 
-def decode_packed_superbatch(packed, refs, spec, names, geoms):
+def decode_packed_superbatch(packed, refs, spec, names, geoms,
+                             mesh=None, data_axis: str = "data"):
     """Decode a stacked packed chunk group to full fields — jit-safe.
 
     ``packed``: (K, total) uint8, K packed batches of identical layout
@@ -457,6 +458,7 @@ def decode_packed_superbatch(packed, refs, spec, names, geoms):
             idx.reshape(k * b, *idx.shape[2:]),
             tiles.reshape(k * b, *tiles.shape[2:]),
             geom[:3],
+            mesh=mesh, data_axis=data_axis,
         )
         fields[name] = img.reshape(k, b, *img.shape[1:])
     return fields
@@ -475,6 +477,19 @@ def tile_ref(ref, tile: int = TILE):
     th, tw = tile_grid(ref.shape, tile)
     return ref.reshape(th, tile, tw, tile, c).transpose(0, 2, 1, 3, 4).reshape(
         th * tw, tile, tile, c
+    )
+
+
+def tile_ref_np(ref: np.ndarray, tile: int = TILE) -> np.ndarray:
+    """Host (numpy) twin of :func:`tile_ref` — for consumers that must
+    assemble the tiled reference into a multi-process global array
+    (``jax.make_array_from_process_local_data`` takes host data)."""
+    h, w, c = ref.shape
+    th, tw = tile_grid(ref.shape, tile)
+    return np.ascontiguousarray(
+        ref.reshape(th, tile, tw, tile, c)
+        .transpose(0, 2, 1, 3, 4)
+        .reshape(th * tw, tile, tile, c)
     )
 
 
@@ -547,7 +562,8 @@ def _pallas_decode_scatter(ref_tiles, idx, tiles, interpret: bool = False):
     return out[:, :n].reshape(b, n, ttc)
 
 
-def decode_tile_delta(ref_tiles, idx, tiles, shape, use_pallas=None):
+def decode_tile_delta(ref_tiles, idx, tiles, shape, use_pallas=None,
+                      mesh=None, data_axis: str = "data"):
     """Reconstruct exact full frames on device.
 
     ``ref_tiles``: (N, t, t, C) from :func:`tile_ref` (any backend array).
@@ -564,13 +580,15 @@ def decode_tile_delta(ref_tiles, idx, tiles, shape, use_pallas=None):
     sharded along ``data`` decodes shard-locally with a replicated ref).
 
     ``use_pallas=None`` auto-selects the Pallas scatter kernel
-    (:func:`_pallas_decode_scatter`) on a SINGLE-device TPU for
-    full-channel tiles, and the XLA scatter elsewhere. The vmap'd XLA
-    path is the one with a sharding rule — on a multi-device mesh the
-    batch decodes shard-locally through it; the Pallas kernel is not
-    partitioned, so auto-select leaves it off there (force with
-    ``use_pallas=True`` on replicated/single-device data if wanted; off
-    TPU the kernel runs in interpreter mode, which the tests use).
+    (:func:`_pallas_decode_scatter`) on TPU for full-channel tiles. On a
+    multi-device mesh pass ``mesh`` (with ``data_axis`` naming its batch
+    axis): the kernel is wrapped in ``shard_map`` over that axis — each
+    device scatters its local batch shard against the replicated
+    reference, so the fast path survives scale-out (the kernel alone is
+    not GSPMD-partitionable). Without ``mesh`` on multi-device, or when
+    B doesn't divide by the axis size, auto-select falls back to the
+    vmap'd XLA scatter, which partitions like any other op. Off TPU the
+    kernel runs in interpreter mode (what the virtual-mesh tests use).
     """
     import jax
 
@@ -578,21 +596,47 @@ def decode_tile_delta(ref_tiles, idx, tiles, shape, use_pallas=None):
     t = tiles.shape[-3]
     ct = tiles.shape[-1]
     th, tw = tile_grid((h, w, c), t)
+    b = idx.shape[0]
+    n_axis = (
+        int(np.prod([mesh.shape[a] for a in (data_axis,)]))
+        if mesh is not None and data_axis in getattr(mesh, "shape", {})
+        else 1
+    )
+    eligible = ct == c and (t * t * ct) % 1024 == 0
     if use_pallas is None:
         use_pallas = (
             jax.default_backend() == "tpu"
-            and jax.device_count() == 1
-            and ct == c
-            and (t * t * ct) % 1024 == 0
+            and eligible
+            and (
+                jax.device_count() == 1
+                or (mesh is not None and n_axis > 1 and b % n_axis == 0)
+            )
         )
     if use_pallas:
-        b = idx.shape[0]
-        return _pallas_decode_scatter(
-            ref_tiles, idx, tiles,
-            interpret=jax.default_backend() != "tpu",
-        ).reshape(b, th, tw, t, t, c).transpose(
-            0, 1, 3, 2, 4, 5
-        ).reshape(b, h, w, c)
+        interpret = jax.default_backend() != "tpu"
+
+        def scatter(r, i, tl):
+            return _pallas_decode_scatter(r, i, tl, interpret=interpret)
+
+        if mesh is not None and n_axis > 1 and b % n_axis == 0:
+            # Partition over the batch: each device runs the kernel on
+            # its local shard against the replicated reference (the
+            # kernel alone is not GSPMD-partitionable).
+            from jax.sharding import PartitionSpec as P
+
+            from blendjax.parallel.collectives import _shard_map
+
+            # check=False: pallas_call's out_shape carries no varying-
+            # mesh-axes annotation, which the VMA checker requires.
+            scatter = _shard_map(
+                scatter, mesh,
+                in_specs=(P(), P(data_axis), P(data_axis)),
+                out_specs=P(data_axis),
+                check=False,
+            )
+        return scatter(ref_tiles, idx, tiles).reshape(
+            b, th, tw, t, t, c
+        ).transpose(0, 1, 3, 2, 4, 5).reshape(b, h, w, c)
 
     def one(i, tl):
         if ct < c:

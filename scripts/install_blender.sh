@@ -1,0 +1,38 @@
+#!/usr/bin/env bash
+# Headless Blender bootstrap for the opt-in real-Blender test tier.
+#
+# Counterpart of the reference's scripts/install_blender.sh (download a
+# pinned tarball, cache it, extract, emit a PATH export) updated to a
+# Blender LTS whose bundled Python (3.10) can import blendjax — the
+# package uses 3.10+ syntax.
+#
+# Usage:
+#   scripts/install_blender.sh          # download + extract + write .envs
+#   source .envs                        # put blender on PATH
+#   blender --background --python scripts/install_producer.py
+#   BLENDJAX_TEST_BLENDER=1 pytest tests -m blender
+set -euo pipefail
+
+VERSION="${BLENDER_VERSION:-3.6.5}"
+SERIES="${VERSION%.*}"
+NAME="blender-${VERSION}-linux-x64"
+NAMETAR="${NAME}.tar.xz"
+CACHE="${BLENDER_CACHE:-${HOME}/.blender-cache}"
+TAR="${CACHE}/${NAMETAR}"
+DEST="${BLENDER_DEST:-${HOME}}"
+URL="https://download.blender.org/release/Blender${SERIES}/${NAMETAR}"
+
+echo "Installing Blender ${NAME} -> ${DEST}/${NAME}"
+mkdir -p "${CACHE}"
+if [ ! -f "${TAR}" ]; then
+    # Download to a temp name and mv on success: an interrupted transfer
+    # must not leave a truncated tarball at the cached path (CI caches
+    # the directory under an immutable key and would never self-heal).
+    wget -q --show-progress -O "${TAR}.part" "${URL}"
+    mv "${TAR}.part" "${TAR}"
+fi
+tar -xf "${TAR}" -C "${DEST}"
+
+# Consumed by CI (`source .envs`) like the reference's .travis.yml:15-17.
+echo "export PATH=\"\${PATH}:${DEST}/${NAME}\"" > .envs
+echo "wrote .envs; run: source .envs"

@@ -1,0 +1,37 @@
+"""Blender fixture: record the animation lifecycle signal order.
+
+Paired with tests/test_blender.py::test_blender_animation_lifecycle
+(reference pairing: ``tests/test_animation.py:7-26`` with
+``tests/blender/anim.blend.py:8-39`` — two episodes of frames 1..3 must
+produce pre_play -> [pre_animation -> (pre_frame -> post_frame) x N ->
+post_animation] x 2 -> post_play).
+"""
+
+import sys
+
+from blendjax.producer import AnimationController, DataPublisher, parse_launch_args
+from blendjax.producer.bpy_engine import BpyEngine
+
+
+def main():
+    args, _ = parse_launch_args(sys.argv)
+    pub = DataPublisher(args.btsockets["DATA"], btid=args.btid, lingerms=5000)
+    ctrl = AnimationController(BpyEngine())
+    seq = []
+
+    ctrl.pre_play.add(lambda: seq.append("pre_play"))
+    ctrl.pre_animation.add(lambda: seq.append("pre_animation"))
+    ctrl.pre_frame.add(lambda f: seq.append(f"pre_frame:{f}"))
+    ctrl.post_frame.add(lambda f: seq.append(f"post_frame:{f}"))
+    ctrl.post_animation.add(lambda: seq.append("post_animation"))
+
+    def post_play():
+        seq.append("post_play")
+        pub.publish(seq=seq)
+
+    ctrl.post_play.add(post_play)
+    ctrl.play(frame_range=(1, 3), num_episodes=2)
+    pub.close()
+
+
+main()

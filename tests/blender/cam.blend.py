@@ -1,0 +1,82 @@
+"""Blender fixture: project known geometry through bpy-derived cameras.
+
+Paired with tests/test_blender.py::test_blender_camera_projection
+(reference pairing: ``tests/test_camera.py:10-49`` with
+``tests/blender/cam.blend.py`` + the prepared ``cam.blend`` scene holding
+an ortho and a perspective camera).
+
+Instead of a binary .blend asset, this script CONSTRUCTS the scene:
+a unit cube at a known offset plus one ortho and one perspective camera
+with pinned poses/intrinsics — so the consumer test can compute the
+expected pixels analytically with blendjax's standalone Camera and
+assert the bpy-derived projection matches.
+"""
+
+import math
+import sys
+
+import bpy
+
+from blendjax.producer import DataPublisher, parse_launch_args
+from blendjax.producer.bpy_engine import (
+    camera_from_bpy,
+    world_coordinates,
+)
+from blendjax.producer.camera import Camera
+
+
+def _scene():
+    bpy.ops.mesh.primitive_cube_add(size=2.0, location=(0.5, -0.25, 0.75))
+    cube = bpy.context.active_object
+    cube.name = "TestCube"
+
+    def add_cam(name, kind, **props):
+        cam_data = bpy.data.cameras.new(name)
+        cam_data.type = kind
+        for k, v in props.items():
+            setattr(cam_data, k, v)
+        cam = bpy.data.objects.new(name, cam_data)
+        bpy.context.collection.objects.link(cam)
+        return cam
+
+    proj = add_cam("CamProj", "PERSP", lens=50.0, sensor_width=36.0,
+                   clip_start=0.1, clip_end=100.0)
+    proj.location = (8.0, -8.0, 6.0)
+    proj.rotation_euler = (math.radians(60.0), 0.0, math.radians(45.0))
+
+    ortho = add_cam("CamOrtho", "ORTHO", ortho_scale=12.0,
+                    clip_start=0.1, clip_end=100.0)
+    ortho.location = (0.0, 0.0, 10.0)
+    ortho.rotation_euler = (0.0, 0.0, 0.0)
+
+    render = bpy.context.scene.render
+    render.resolution_x, render.resolution_y = 640, 480
+    render.resolution_percentage = 100
+    bpy.context.view_layer.update()
+    return cube, proj, ortho
+
+
+def main():
+    args, _ = parse_launch_args(sys.argv)
+    cube, proj, ortho = _scene()
+    xyz = world_coordinates(cube)
+
+    cam_p = camera_from_bpy(Camera, proj)
+    cam_o = camera_from_bpy(Camera, ortho)
+    pix_p, z_p = cam_p.world_to_pixel(xyz, return_depth=True)
+    pix_o, z_o = cam_o.world_to_pixel(xyz, return_depth=True)
+
+    pub = DataPublisher(args.btsockets["DATA"], btid=args.btid, lingerms=5000)
+    pub.publish(
+        xyz=xyz,
+        proj_xy=pix_p, proj_z=z_p,
+        ortho_xy=pix_o, ortho_z=z_o,
+        # raw camera params so the consumer can rebuild the SAME analytic
+        # camera and assert bit-level agreement
+        proj_pose=[list(r) for r in proj.matrix_world],
+        ortho_pose=[list(r) for r in ortho.matrix_world],
+    )
+    pub.close()
+
+
+main()

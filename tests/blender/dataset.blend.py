@@ -1,0 +1,34 @@
+"""Blender fixture: stream fixed-size frames through the animation loop.
+
+Paired with tests/test_blender.py::test_blender_stream_ingest (reference
+pairing: ``tests/test_dataset.py:11-33`` with
+``tests/blender/dataset.blend.py:5-17`` — 16 items of (64, 64) through
+DataLoader workers).
+"""
+
+import sys
+
+import numpy as np
+
+from blendjax.producer import AnimationController, DataPublisher, parse_launch_args
+from blendjax.producer.bpy_engine import BpyEngine
+
+
+def main():
+    args, _ = parse_launch_args(sys.argv)
+    pub = DataPublisher(args.btsockets["DATA"], btid=args.btid, lingerms=5000)
+    ctrl = AnimationController(BpyEngine())
+
+    def post_frame(frame):
+        pub.publish(
+            frameid=frame,
+            img=np.full((64, 64), frame % 251, dtype=np.uint8),
+        )
+
+    ctrl.post_frame.add(post_frame)
+    # 4 episodes x frames 1..4 = 16 messages, then exit.
+    ctrl.play(frame_range=(1, 4), num_episodes=4)
+    pub.close()
+
+
+main()

@@ -1,0 +1,24 @@
+"""Blender fixture: echo one duplex message, then signal end.
+
+Paired with tests/test_blender.py::test_blender_duplex_echo (reference
+pairing: ``tests/test_duplex.py:9-47`` with
+``tests/blender/duplex.blend.py:3-11`` — asserts btid/btmid stamping).
+"""
+
+import sys
+
+from blendjax.producer import DuplexChannel, parse_launch_args
+
+
+def main():
+    args, _ = parse_launch_args(sys.argv)
+    duplex = DuplexChannel(
+        args.btsockets["CTRL"], btid=args.btid, lingerms=5000
+    )
+    msg = duplex.recv(timeoutms=10000)
+    duplex.send(echo=msg)
+    duplex.send(msg="end")
+    duplex.close()
+
+
+main()

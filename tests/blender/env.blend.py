@@ -1,0 +1,66 @@
+"""Blender fixture: minimal rotate-the-cube env served over the GYM RPC.
+
+Paired with tests/test_blender.py::test_blender_remote_env (reference
+pairing: ``tests/test_env.py:12-43`` with ``tests/blender/env.blend.py:
+7-47`` — reset/step/reward/done semantics across two episodes).
+
+Builds its own scene (a default cube) so no .blend asset is needed.
+"""
+
+import sys
+
+import bpy
+
+from blendjax.producer import BaseEnv, RemoteControlledAgent, parse_launch_args
+from blendjax.producer.bpy_engine import BpyEngine
+
+
+def _ensure_cube():
+    if "Cube" not in bpy.data.objects:
+        bpy.ops.mesh.primitive_cube_add()
+        bpy.context.active_object.name = "Cube"
+    return bpy.data.objects["Cube"]
+
+
+class RotateEnv(BaseEnv):
+    def __init__(self, agent, done_after=10):
+        super().__init__(agent)
+        self.cube = _ensure_cube()
+        self.count = 0
+        self.done_after = done_after
+
+    def _env_reset(self):
+        self.cube.rotation_euler[2] = 0.0
+        self.count = 0
+
+    def _env_prepare_step(self, action):
+        self.cube.rotation_euler[2] = float(action)
+
+    def _env_post_step(self):
+        self.count += 1
+        angle = float(self.cube.rotation_euler[2])
+        return dict(
+            obs=angle,
+            reward=1.0 if abs(angle) > 0.5 else 0.0,
+            done=self.events.frameid > self.done_after,
+            count=self.count,
+        )
+
+
+def main():
+    args, remainder = parse_launch_args(sys.argv)
+    import argparse
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--done-after", default=10, type=int)
+    opts = ap.parse_args(remainder)
+
+    agent = RemoteControlledAgent(args.btsockets["GYM"])
+    env = RotateEnv(agent, done_after=opts.done_after)
+    try:
+        env.run(BpyEngine(), frame_range=(1, 10000))
+    finally:
+        agent.close()
+
+
+main()

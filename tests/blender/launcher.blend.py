@@ -1,0 +1,29 @@
+"""Blender fixture: publish the parsed launch handshake back to the test.
+
+Paired with tests/test_blender.py::test_blender_launcher_handshake
+(reference pairing: ``tests/test_launcher.py:20-44`` with
+``tests/blender/launcher.blend.py:3-9`` — the producer echoes its argv so
+the torch side can assert btid/seed/socket plumbing).
+"""
+
+import sys
+
+from blendjax.producer import DataPublisher, parse_launch_args
+
+
+def main():
+    args, remainder = parse_launch_args(sys.argv)
+    # Linger so the single message is flushed before Blender exits.
+    pub = DataPublisher(
+        args.btsockets["DATA"], btid=args.btid, lingerms=10000
+    )
+    pub.publish(
+        btid=args.btid,
+        btseed=args.btseed,
+        btsockets=list(args.btsockets),
+        remainder=list(remainder),
+    )
+    pub.close()
+
+
+main()

@@ -1,0 +1,120 @@
+"""Multi-process JAX worker for tests/test_multiprocess.py.
+
+Runs as one of N coordinated processes (``jax.distributed.initialize``
+over a localhost coordinator, 4 virtual CPU devices per process — the
+CPU stand-in for one TPU host of a multi-host pod, SURVEY.md §4
+"multi-process CPU JAX tests mirroring the reference's mp.Process
+trick"). Asserts, from every process:
+
+- DeviceFeeder(multihost=True) assembles per-process local batches into
+  ONE global array of the right shape, content, and sharding;
+- a psum collective over the assembled batch sees every process's rows;
+- a tile-delta stream decodes through the multihost pipeline path with
+  each process's local shard rows bit-exact vs its own frames.
+
+Usage: mp_worker.py PROCESS_ID NUM_PROCESSES COORD_PORT
+(env JAX_PLATFORMS/XLA_FLAGS are set by the parent test).
+"""
+
+import sys
+
+import numpy as np
+
+
+def main() -> int:
+    pid, nproc, port = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
+    import jax
+
+    # The machine image pre-imports jax and pins a device plugin via
+    # sitecustomize, so the env var alone is read too late (same
+    # workaround as tests/conftest.py).
+    jax.config.update("jax_platforms", "cpu")
+    jax.distributed.initialize(
+        f"localhost:{port}", num_processes=nproc, process_id=pid
+    )
+    assert jax.process_count() == nproc
+    local = jax.local_device_count()
+    ndev = jax.device_count()
+    assert ndev == local * nproc
+
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from blendjax.data.pipeline import DeviceFeeder, StreamDataPipeline
+    from blendjax.parallel import create_mesh
+
+    mesh = create_mesh({"data": -1})
+    sharding = NamedSharding(mesh, P("data"))
+
+    # -- raw multihost assembly ------------------------------------------
+    b_local = local  # one row per local device
+    rows = pid * b_local + np.arange(b_local)
+    batch = {
+        "image": (rows[:, None, None] * np.ones((1, 2, 2))).astype(np.uint8),
+        "frameid": rows,
+    }
+    feeder = DeviceFeeder(sharding=sharding, multihost=True)
+    (out,) = list(feeder([batch]))
+    assert out["image"].shape == (ndev, 2, 2), out["image"].shape
+    assert out["image"].sharding.is_equivalent_to(sharding, 3)
+    # every process holds its own rows, in global order
+    for shard in out["image"].addressable_shards:
+        row = int(np.asarray(shard.data)[0, 0, 0])
+        assert row == (shard.index[0].start or 0), (row, shard.index)
+
+    # -- a collective sees all rows --------------------------------------
+    total = jax.jit(
+        lambda x: jax.numpy.sum(x.astype(jax.numpy.int32)),
+        out_shardings=NamedSharding(mesh, P()),
+    )(out["frameid"])
+    # replicated output: fully addressable on every process
+    got = int(np.asarray(total.addressable_shards[0].data))
+    assert got == sum(range(ndev)), got
+
+    # -- tile stream through the multihost pipeline path ------------------
+    from blendjax.ops.tiles import (
+        TILEIDX_SUFFIX,
+        TILEREF_SUFFIX,
+        TILES_SUFFIX,
+        TILESHAPE_SUFFIX,
+        TileDeltaEncoder,
+        pack_batch,
+    )
+
+    rng = np.random.default_rng(7)  # SAME ref content on every process
+    ref = rng.integers(0, 255, (32, 32, 4), np.uint8)
+    enc = TileDeltaEncoder(ref, tile=16)
+    frames = []
+    for i in range(ndev):
+        img = ref.copy()
+        img[8:16, 8:16] = (i * 29) % 251
+        frames.append(img)
+    local_frames = frames[pid * b_local: (pid + 1) * b_local]
+    deltas = [tuple(a.copy() for a in enc.encode(f)) for f in local_frames]
+    idx, tiles = pack_batch(deltas, enc.num_tiles, capacity=4)
+
+    def messages():
+        yield {
+            "_prebatched": True, "btid": pid,
+            "image" + TILEIDX_SUFFIX: idx,
+            "image" + TILES_SUFFIX: tiles,
+            "image" + TILESHAPE_SUFFIX: [32, 32, 4, 16],
+            "image" + TILEREF_SUFFIX: ref,
+            "frameid": np.asarray(rows),
+        }
+
+    with StreamDataPipeline(
+        messages(), batch_size=b_local, sharding=sharding, multihost=True
+    ) as pipe:
+        (got_batch,) = list(pipe)
+    img = got_batch["image"]
+    assert img.shape == (ndev, 32, 32, 4), img.shape
+    for shard in img.addressable_shards:
+        g = shard.index[0].start or 0
+        np.testing.assert_array_equal(np.asarray(shard.data)[0], frames[g])
+
+    print(f"mp_worker {pid}/{nproc} ok: ndev={ndev}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,220 @@
+"""Opt-in real-Blender integration tier (VERDICT r1 item 2).
+
+Every test here spawns a REAL Blender process through the production
+``BlenderLauncher`` path against a paired ``tests/blender/*.blend.py``
+producer — the reference's entire test identity
+(``tests/test_launcher.py:20-44`` + ``tests/blender/*.blend.py``; CI via
+``scripts/install_blender.sh``). The hermetic sim tier covers the same
+consumer code paths without Blender; this tier is what first executes
+``finder.py``, ``bpy_engine.py``, and the Blender halves of the producer
+package.
+
+Run:  scripts/install_blender.sh && source .envs
+      blender --background --python scripts/install_producer.py
+      pytest tests -m blender
+Tests skip (not fail) when no usable Blender is on PATH.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from blendjax.launcher.finder import discover_blender
+
+BLENDER = discover_blender()
+pytestmark = [
+    pytest.mark.blender,
+    pytest.mark.skipif(
+        BLENDER is None,
+        reason="no usable Blender on PATH (scripts/install_blender.sh)",
+    ),
+]
+
+FIXTURES = os.path.join(os.path.dirname(__file__), "blender")
+
+
+def _script(name: str) -> str:
+    return os.path.join(FIXTURES, name)
+
+
+def test_blender_launcher_handshake():
+    """Two instances get distinct btids/seeds/addresses and per-instance
+    remainder args (reference ``test_launcher.py:20-44``)."""
+    from blendjax.data.stream import RemoteStream
+    from blendjax.launcher import BlenderLauncher
+
+    with BlenderLauncher(
+        script=_script("launcher.blend.py"),
+        background=True,
+        num_instances=2,
+        named_sockets=["DATA"],
+        seed=10,
+        instance_args=[["--x", "a"], ["--x", "b"]],
+    ) as launcher:
+        got = {}
+        for msg in RemoteStream(
+            launcher.addresses["DATA"], timeoutms=60_000, max_items=2
+        ):
+            got[msg["btid"]] = msg
+    assert sorted(got) == [0, 1]
+    assert [got[i]["btseed"] for i in (0, 1)] == [10, 11]
+    assert got[0]["remainder"] == ["--x", "a"]
+    assert got[1]["remainder"] == ["--x", "b"]
+    for i in (0, 1):
+        assert got[i]["btsockets"] == ["DATA"]
+
+
+def test_blender_stream_ingest():
+    """A real Blender animation loop streams 16 (64, 64) frames into the
+    pipeline's host ingest (reference ``test_dataset.py:11-33``)."""
+    from blendjax.data.stream import RemoteStream
+    from blendjax.launcher import BlenderLauncher
+
+    with BlenderLauncher(
+        script=_script("dataset.blend.py"),
+        background=True,
+        num_instances=1,
+        named_sockets=["DATA"],
+        seed=0,
+    ) as launcher:
+        frames = []
+        for msg in RemoteStream(
+            launcher.addresses["DATA"], timeoutms=60_000, max_items=16
+        ):
+            assert msg["img"].shape == (64, 64)
+            assert (msg["img"] == msg["frameid"] % 251).all()
+            frames.append(int(msg["frameid"]))
+    # 4 episodes x frames 1..4
+    assert sorted(frames) == sorted(list(range(1, 5)) * 4)
+
+
+def test_blender_duplex_echo():
+    """Duplex echo incl. btid/btmid stamping (reference
+    ``test_duplex.py:9-47``)."""
+    from blendjax.launcher import BlenderLauncher
+    from blendjax.transport.channels import PairChannel
+
+    with BlenderLauncher(
+        script=_script("duplex.blend.py"),
+        background=True,
+        num_instances=1,
+        named_sockets=["CTRL"],
+        seed=0,
+    ) as launcher:
+        duplex = PairChannel(
+            launcher.addresses["CTRL"][0], btid=99, bind=False
+        )
+        try:
+            mid = duplex.send(hello=[1, 2, 3])
+            echo = duplex.recv(timeoutms=60_000)
+            end = duplex.recv(timeoutms=60_000)
+        finally:
+            duplex.close()
+    assert echo["echo"]["hello"] == [1, 2, 3]
+    assert echo["echo"]["btid"] == 99
+    assert echo["echo"]["btmid"] == mid
+    assert echo["btid"] == 0  # producer stamp
+    assert end["msg"] == "end"
+
+
+def test_blender_animation_lifecycle():
+    """Signal ordering over two episodes of frames 1..3 (reference
+    ``test_animation.py:7-26``)."""
+    from blendjax.data.stream import RemoteStream
+    from blendjax.launcher import BlenderLauncher
+
+    with BlenderLauncher(
+        script=_script("anim.blend.py"),
+        background=True,
+        num_instances=1,
+        named_sockets=["DATA"],
+        seed=0,
+    ) as launcher:
+        (msg,) = list(
+            RemoteStream(
+                launcher.addresses["DATA"], timeoutms=60_000, max_items=1
+            )
+        )
+    episode = (
+        ["pre_animation"]
+        + [s for f in (1, 2, 3) for s in (f"pre_frame:{f}", f"post_frame:{f}")]
+        + ["post_animation"]
+    )
+    assert msg["seq"] == ["pre_play"] + episode * 2 + ["post_play"]
+
+
+def test_blender_remote_env():
+    """reset/step/reward/done across two episodes against a real Blender
+    physics loop (reference ``test_env.py:12-43``)."""
+    from blendjax.env.remote import RemoteEnv
+    from blendjax.launcher import BlenderLauncher
+
+    with BlenderLauncher(
+        script=_script("env.blend.py"),
+        background=True,
+        num_instances=1,
+        named_sockets=["GYM"],
+        seed=0,
+        instance_args=[["--done-after", "5"]],
+    ) as launcher:
+        env = RemoteEnv(launcher.addresses["GYM"][0], timeoutms=60_000)
+        try:
+            for _ in range(2):  # two episodes
+                obs, info = env.reset()
+                assert obs == pytest.approx(0.0)
+                done = False
+                steps = 0
+                while not done:
+                    obs, reward, done, info = env.step(0.6)
+                    assert obs == pytest.approx(0.6)
+                    assert reward == pytest.approx(1.0)
+                    steps += 1
+                    assert steps < 50
+                assert steps >= 1
+        finally:
+            env.close()
+
+
+def test_blender_camera_projection():
+    """bpy-derived Camera (camera_from_bpy) projects identically to the
+    standalone analytic camera rebuilt from the published pose (reference
+    ``test_camera.py:10-49`` against the cam.blend scene)."""
+    from blendjax.data.stream import RemoteStream
+    from blendjax.launcher import BlenderLauncher
+    from blendjax.producer.camera import Camera
+
+    with BlenderLauncher(
+        script=_script("cam.blend.py"),
+        background=True,
+        num_instances=1,
+        named_sockets=["DATA"],
+        seed=0,
+    ) as launcher:
+        (msg,) = list(
+            RemoteStream(
+                launcher.addresses["DATA"], timeoutms=60_000, max_items=1
+            )
+        )
+    xyz = msg["xyz"]
+    assert xyz.shape == (8, 3)
+
+    pose = np.asarray(msg["proj_pose"])
+    cam = Camera(
+        position=pose[:3, 3], rotation=pose[:3, :3], shape=(480, 640),
+        focal_mm=50.0, sensor_mm=36.0, clip_near=0.1, clip_far=100.0,
+    )
+    pix, z = cam.world_to_pixel(xyz, return_depth=True)
+    np.testing.assert_allclose(pix, msg["proj_xy"], atol=1e-2)
+    np.testing.assert_allclose(z, msg["proj_z"], atol=1e-4)
+
+    pose_o = np.asarray(msg["ortho_pose"])
+    cam_o = Camera(
+        position=pose_o[:3, 3], rotation=pose_o[:3, :3], shape=(480, 640),
+        ortho_scale=12.0, clip_near=0.1, clip_far=100.0,
+    )
+    pix_o, z_o = cam_o.world_to_pixel(xyz, return_depth=True)
+    np.testing.assert_allclose(pix_o, msg["ortho_xy"], atol=1e-2)
+    np.testing.assert_allclose(z_o, msg["ortho_z"], atol=1e-4)
+    # the cube sits above/below: ortho depths are all ~10 - z_world
+    np.testing.assert_allclose(z_o, 10.0 - xyz[:, 2], atol=1e-4)

@@ -1,0 +1,56 @@
+"""True multi-process JAX tier (VERDICT r1 item 5).
+
+Two coordinated OS processes (``jax.distributed`` over a localhost
+coordinator, 4 virtual CPU devices each = an 8-device global mesh) run
+``tests/mp_worker.py``: DeviceFeeder(multihost=True) global batch
+assembly, a cross-process collective, and the multihost tile-decode
+path — the CPU mirror of a 2-host TPU pod, in the spirit of the
+reference's ``mp.Process`` two-machine tests
+(``tests/test_launcher.py:47-91``).
+"""
+
+import os
+import socket
+import subprocess
+import sys
+
+WORKER = os.path.join(os.path.dirname(__file__), "mp_worker.py")
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def test_two_process_global_batch_assembly_and_tile_decode():
+    port = _free_port()
+    nproc = 2
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    # the parent's pytest conftest forced 8 local devices; children set
+    # their own count BEFORE importing jax, so scrub inherited state
+    env.pop("JAX_NUM_PROCESSES", None)
+    procs = [
+        subprocess.Popen(
+            [sys.executable, WORKER, str(i), str(nproc), str(port)],
+            env=env,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            text=True,
+        )
+        for i in range(nproc)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=180)
+            outs.append(out)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    for i, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"worker {i} failed:\n{out}"
+        assert f"mp_worker {i}/{nproc} ok" in out

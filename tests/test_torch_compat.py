@@ -6,6 +6,7 @@ import threading
 
 import numpy as np
 import pytest
+import zmq
 
 torch = pytest.importorskip("torch")
 
@@ -67,10 +68,12 @@ def test_torch_adapter_decodes_tile_streams_host_side():
         ],
     ) as launcher:
         ds = RemoteIterableDataset(
-            launcher.addresses["DATA"], max_items=3, timeoutms=30_000
+            launcher.addresses["DATA"], max_items=10, timeoutms=30_000
         )
         items = list(ds)
-    assert len(items) == 12  # 3 messages x 4 frames
+    # max_items counts ITEMS (reference ``dataset.py:80-97``), not
+    # producer messages: 10 items = 2.5 producer batches of 4.
+    assert len(items) == 10
     scene = CubeScene(shape=(64, 64), seed=seed)
     local = {}
     for f in range(1, 13):
@@ -79,3 +82,106 @@ def test_torch_adapter_decodes_tile_streams_host_side():
     for it in items:
         assert it["image"].shape == (64, 64, 4)
         np.testing.assert_array_equal(it["image"], local[int(it["frameid"])])
+
+
+def test_max_items_splits_across_workers_with_batched_producer():
+    """max_items splits per-worker (8 each here) and counts items after
+    batch splitting, so two DataLoader workers over a batch-4 producer
+    consume exactly 16 items total (reference 4-worker split,
+    ``dataset.py:80-97`` + ``tests/test_dataset.py:25``)."""
+    from torch.utils.data import DataLoader
+
+    pub = DataPublisherSocket("tcp://127.0.0.1:*", btid=0)
+    ds = RemoteIterableDataset([pub.addr], max_items=16, timeoutms=20_000)
+    stop = threading.Event()
+
+    # Bounded sends: the PUSH socket blocks at HWM once the consumers
+    # stop pulling; a 200ms SNDTIMEO lets the thread notice `stop` and
+    # exit BEFORE pub.close() (closing under a blocked send aborts).
+    pub.sock.setsockopt(zmq.SNDTIMEO, 200)
+
+    def produce():
+        f = 0
+        while not stop.is_set():
+            try:
+                pub.publish(
+                    _batched=True,
+                    image=np.full((4, 8, 8), f % 251, np.uint8),
+                    frameid=np.arange(f, f + 4),
+                )
+            except zmq.Again:
+                continue
+            f += 4
+
+    t = threading.Thread(target=produce, daemon=True)
+    t.start()
+    try:
+        # spawn: forking with a live zmq socket + publisher thread in the
+        # parent aborts; the reference's fork-based workers never carried
+        # parent-side sockets (its launcher owns the producers).
+        batches = list(
+            DataLoader(
+                ds, batch_size=4, num_workers=2,
+                multiprocessing_context="spawn",
+            )
+        )
+    finally:
+        stop.set()
+        t.join(timeout=10)
+        pub.close()
+    assert sum(b["image"].shape[0] for b in batches) == 16
+    assert all(b["image"].shape[1:] == (8, 8) for b in batches)
+
+
+def test_max_items_cap_with_recording(tmp_path):
+    """Recording tees consumed messages while the item cap stops the
+    stream mid-message; the recording replays at least the capped items
+    (reference ``dataset.py:53-58,100-103``)."""
+    from blendjax.data.batcher import HostIngest
+    from blendjax.data.replay import ReplayStream
+
+    pub = DataPublisherSocket("tcp://127.0.0.1:*", btid=0)
+    prefix = str(tmp_path / "rec")
+    ds = RemoteIterableDataset(
+        [pub.addr], max_items=6, timeoutms=20_000,
+        record_path_prefix=prefix,
+    )
+    stop = threading.Event()
+
+    # Bounded sends: the PUSH socket blocks at HWM once the consumers
+    # stop pulling; a 200ms SNDTIMEO lets the thread notice `stop` and
+    # exit BEFORE pub.close() (closing under a blocked send aborts).
+    pub.sock.setsockopt(zmq.SNDTIMEO, 200)
+
+    def produce():
+        f = 0
+        while not stop.is_set():
+            try:
+                pub.publish(
+                    _batched=True,
+                    image=np.full((4, 8, 8), f % 251, np.uint8),
+                    frameid=np.arange(f, f + 4),
+                )
+            except zmq.Again:
+                continue
+            f += 4
+
+    t = threading.Thread(target=produce, daemon=True)
+    t.start()
+    try:
+        items = list(ds)
+    finally:
+        stop.set()
+        t.join(timeout=10)
+        pub.close()
+    assert len(items) == 6
+    assert [int(i["frameid"]) for i in items] == list(range(6))
+    replayed = [
+        item
+        for msg in ReplayStream(prefix + "_00.bjr")
+        if msg.pop("_batched", False) or True
+        for item in HostIngest._batched_views(msg)
+    ]
+    assert len(replayed) >= 6
+    for orig, rep in zip(items, replayed):
+        np.testing.assert_array_equal(orig["image"], rep["image"])
