@@ -92,7 +92,13 @@ void bjx_fill_triangles(const double* px, const double* depth,
                   b = rgba[t * 4 + 2], a = rgba[t * 4 + 3];
 
     // Edge functions at the first pixel center, plus per-x / per-y steps
-    // (each w_i is affine in gx, gy).
+    // (each w_i is affine in gx, gy). Instead of testing every bbox
+    // pixel (~half fail the half-plane tests for a typical face), each
+    // row's covered span [k0, k1) is solved analytically from the three
+    // constraints w_i + k*dw_i >= 0, and the inner loop is one z
+    // compare + one 32-bit store per covered pixel (z is affine in x
+    // too). Edge pixels can shift by an ulp vs per-pixel evaluation —
+    // within the documented rounding tolerance.
     const double sx = (double)xmin + 0.5, sy = (double)ymin + 0.5;
     const double w0_row0 =
         ((x1 - sx) * (y2 - sy) - (x2 - sx) * (y1 - sy)) * inv_area;
@@ -100,25 +106,56 @@ void bjx_fill_triangles(const double* px, const double* depth,
         ((x2 - sx) * (y0 - sy) - (x0 - sx) * (y2 - sy)) * inv_area;
     const double w0dx = (y1 - y2) * inv_area, w0dy = (x2 - x1) * inv_area;
     const double w1dx = (y2 - y0) * inv_area, w1dy = (x0 - x2) * inv_area;
+    const double w2dx = -(w0dx + w1dx);
+    const double zdx = w0dx * z0 + w1dx * z1 + w2dx * z2;
 
-    double w0_row = w0_row0, w1_row = w1_row0;
+    const uint32_t cpat = (uint32_t)r | ((uint32_t)g << 8) |
+                          ((uint32_t)b << 16) | ((uint32_t)a << 24);
+    const int64_t span = xmax - xmin;
     for (int64_t y = ymin; y < ymax; ++y) {
-      float* zrow = zbuf + y * w;
-      uint8_t* crow = color + (y * w) * 4;
-      double w0 = w0_row, w1 = w1_row;
-      for (int64_t x = xmin; x < xmax; ++x) {
-        const double w2 = 1.0 - w0 - w1;
-        if (w0 >= 0.0 && w1 >= 0.0 && w2 >= 0.0) {
-          const float z = (float)(w0 * z0 + w1 * z1 + w2 * z2);
-          if (z < zrow[x]) {
-            zrow[x] = z;
-            uint8_t* p = crow + x * 4;
-            p[0] = r; p[1] = g; p[2] = b; p[3] = a;
-          }
+      const double dy = (double)(y - ymin);
+      const double w0r = w0_row0 + dy * w0dy;
+      const double w1r = w1_row0 + dy * w1dy;
+      const double w2r = 1.0 - w0r - w1r;
+      // real-valued bounds on covered ks: lo <= k <= hi
+      double lo = 0.0, hi = (double)(span - 1);
+      bool empty = false;
+      const double wr[3] = {w0r, w1r, w2r};
+      const double dw[3] = {w0dx, w1dx, w2dx};
+      for (int e = 0; e < 3; ++e) {
+        if (dw[e] > 0.0) {
+          const double k = -wr[e] / dw[e];  // w(k) >= 0 for k >= this
+          if (k > lo) lo = k;
+        } else if (dw[e] < 0.0) {
+          const double k = -wr[e] / dw[e];  // w(k) >= 0 for k <= this
+          if (k < hi) hi = k;
+        } else if (wr[e] < 0.0) {
+          empty = true;
+          break;
         }
-        w0 += w0dx; w1 += w1dx;
       }
-      w0_row += w0dy; w1_row += w1dy;
+      if (empty) continue;
+      // Clamp in double BEFORE the casts: a denormal dw makes the ratio
+      // overflow int64, and that cast is UB (x86 wraps to INT64_MIN,
+      // turning an empty row into a full one).
+      if (lo < 0.0) lo = 0.0;
+      if (hi > (double)(span - 1)) hi = (double)(span - 1);
+      if (lo > hi) continue;
+      int64_t k0 = (int64_t)std::ceil(lo);
+      int64_t k1 = (int64_t)std::floor(hi) + 1;  // exclusive
+      if (k0 >= k1) continue;
+      double z = (w0r + k0 * w0dx) * z0 + (w1r + k0 * w1dx) * z1 +
+                 (w2r + k0 * w2dx) * z2;
+      float* zrow = zbuf + y * w + xmin;
+      uint32_t* crow = reinterpret_cast<uint32_t*>(color) + y * w + xmin;
+      for (int64_t k = k0; k < k1; ++k) {
+        const float zf = (float)z;
+        if (zf < zrow[k]) {
+          zrow[k] = zf;
+          crow[k] = cpat;
+        }
+        z += zdx;
+      }
     }
   }
 }

@@ -32,6 +32,18 @@ from blendjax.utils.logging import get_logger
 
 logger = get_logger("launcher")
 
+# Resolved at import time (see ProcessLauncher._spawn.preexec: the
+# post-fork child may not dlopen/import).
+if sys.platform == "linux":
+    try:
+        import ctypes as _ctypes
+
+        _PRCTL = _ctypes.CDLL(None).prctl
+    except Exception:  # pragma: no cover
+        _PRCTL = None
+else:  # pragma: no cover - non-Linux: context-manager teardown only
+    _PRCTL = None
+
 
 def _free_port(host: str) -> int:
     """Probe a free TCP port by binding port 0 (small race window; fine for
@@ -165,7 +177,19 @@ class ProcessLauncher:
             p for p in env.get("PYTHONPATH", "").split(os.pathsep) if p
         ]
         env["PYTHONPATH"] = os.pathsep.join(dict.fromkeys(parts))
-        return subprocess.Popen(argv, start_new_session=True, env=env)
+
+        def preexec():
+            os.setsid()
+            # Orphan-proofing (Linux): if the launcher dies without its
+            # __exit__ running (SIGKILL, `timeout`), the kernel delivers
+            # SIGTERM to the producer — otherwise a leaked producer loops
+            # forever and starves shared-core hosts. _PRCTL was resolved
+            # at import time: the post-fork child must not dlopen/malloc
+            # (deadlocks if another parent thread held those locks).
+            if _PRCTL is not None:
+                _PRCTL(1, 15)  # PR_SET_PDEATHSIG, SIGTERM
+
+        return subprocess.Popen(argv, preexec_fn=preexec, env=env)
 
     @property
     def addresses(self) -> dict:

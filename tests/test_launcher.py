@@ -172,3 +172,55 @@ def test_cli_app_python_kind(tmp_path):
     finally:
         proc.terminate()
         proc.wait(timeout=10)
+
+
+@pytest.mark.skipif(sys.platform != "linux", reason="PDEATHSIG is Linux-only")
+def test_producers_die_with_killed_launcher(tmp_path):
+    """Orphan-proofing: SIGKILL the launcher process (its __exit__ never
+    runs) and the kernel's parent-death signal must still reap the
+    producer — a leaked producer loops forever and starves shared-core
+    hosts."""
+    import json
+    import signal
+    import subprocess
+    import textwrap
+
+    # The cube producer runs FOREVER without --frames, so the assertion
+    # cannot pass vacuously by the producer exiting on its own (the echo
+    # producer self-exits after ~10s, inside the polling window).
+    forever = os.path.join(
+        os.path.dirname(__file__), "..", "examples", "datagen",
+        "cube_producer.py",
+    )
+    child_src = textwrap.dedent(
+        """
+        import json, os, time
+        from blendjax.launcher import PythonProducerLauncher
+        ln = PythonProducerLauncher(
+            script=%r, num_instances=1, named_sockets=["DATA"], seed=0,
+            instance_args=[["--shape", "32", "32"]],
+        ).__enter__()
+        print(json.dumps(ln.launch_info.processes), flush=True)
+        time.sleep(60)  # parent SIGKILLs us; producer must die anyway
+        """
+        % forever
+    )
+    p = subprocess.Popen(
+        [sys.executable, "-c", child_src], stdout=subprocess.PIPE, text=True
+    )
+    try:
+        pids = json.loads(p.stdout.readline())
+        os.kill(p.pid, signal.SIGKILL)
+        p.wait(timeout=10)
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            try:
+                os.kill(pids[0], 0)
+            except ProcessLookupError:
+                return  # reaped
+            time.sleep(0.2)
+        os.kill(pids[0], signal.SIGKILL)  # clean up before failing
+        pytest.fail("producer outlived its SIGKILLed launcher")
+    finally:
+        if p.poll() is None:
+            p.kill()
