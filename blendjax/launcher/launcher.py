@@ -178,18 +178,31 @@ class ProcessLauncher:
         ]
         env["PYTHONPATH"] = os.pathsep.join(dict.fromkeys(parts))
 
-        def preexec():
-            os.setsid()
-            # Orphan-proofing (Linux): if the launcher dies without its
-            # __exit__ running (SIGKILL, `timeout`), the kernel delivers
-            # SIGTERM to the producer — otherwise a leaked producer loops
-            # forever and starves shared-core hosts. _PRCTL was resolved
-            # at import time: the post-fork child must not dlopen/malloc
-            # (deadlocks if another parent thread held those locks).
-            if _PRCTL is not None:
+        # Orphan-proofing (Linux): if the launcher dies without its
+        # __exit__ running (SIGKILL, `timeout`), the kernel delivers
+        # SIGTERM to the producer — otherwise a leaked producer loops
+        # forever and starves shared-core hosts. _PRCTL was resolved at
+        # import time: the post-fork child must not dlopen/malloc
+        # (deadlocks if another parent thread held those locks). PDEATHSIG
+        # fires on the death of the spawning THREAD (prctl(2)), so it is
+        # set only for main-thread spawns — a producer respawned from a
+        # pipeline's ingest thread must not die with that thread; it
+        # falls back to context-manager teardown. setsid stays C-level
+        # via start_new_session (preexec_fn otherwise disables the
+        # posix_spawn fast path and is fork-unsafe on macOS).
+        import threading
+
+        preexec = None
+        if (
+            _PRCTL is not None
+            and threading.current_thread() is threading.main_thread()
+        ):
+            def preexec():
                 _PRCTL(1, 15)  # PR_SET_PDEATHSIG, SIGTERM
 
-        return subprocess.Popen(argv, preexec_fn=preexec, env=env)
+        return subprocess.Popen(
+            argv, start_new_session=True, preexec_fn=preexec, env=env
+        )
 
     @property
     def addresses(self) -> dict:

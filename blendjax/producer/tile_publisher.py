@@ -111,6 +111,15 @@ class TileBatchPublisher:
             if capacity else None
         )
         self.batches_published = 0
+        # Direct-pack fast path: once the capacity is fixed, frames
+        # encode straight into these (B, cap, ...) batch arrays — one
+        # copy per frame (staging -> row) instead of the buffered path's
+        # two plus two allocations. The arrays never leave the process
+        # (publish ships palette-packed or copied views), so one set is
+        # safe to reuse across batches even with zero-copy sends.
+        self._batch_idx: np.ndarray | None = None
+        self._batch_tiles: np.ndarray | None = None
+        self._row = 0
 
     def add(self, image: np.ndarray, hint=None, **extras) -> None:
         """Add one frame plus its per-frame sidecar fields (annotations,
@@ -124,39 +133,91 @@ class TileBatchPublisher:
             self._alpha_static = np.array_equal(
                 ft[..., 3], self._ref_tile_alpha[fi]
             )
-        self._deltas.append((fi.copy(), ft.copy()))
-        for k, v in extras.items():
-            self._extras.setdefault(k, []).append(v)
-        if len(self._deltas) == self.batch_size:
+        if self._capacity is not None:
+            k = len(fi)
+            if k > self._capacity:
+                self._grow(k)
+            self._ensure_batch_arrays()
+            i = self._row
+            self._batch_idx[i, :k] = fi
+            self._batch_idx[i, k:] = self.encoder.num_tiles  # sentinel
+            self._batch_tiles[i, :k] = ft
+            self._batch_tiles[i, k:] = 0
+            self._row += 1
+        else:
+            # No pinned capacity yet: buffer the first batch's deltas,
+            # _publish fixes the sticky capacity, and every later frame
+            # takes the direct path above.
+            self._deltas.append((fi.copy(), ft.copy()))
+        for key, v in extras.items():
+            self._extras.setdefault(key, []).append(v)
+        if self._row + len(self._deltas) == self.batch_size:
             self._publish()
+
+    def _ensure_batch_arrays(self) -> None:
+        if self._batch_idx is None:
+            t, c = self.tile, self._ref.shape[2]
+            self._batch_idx = np.empty(
+                (self.batch_size, self._capacity), np.int32
+            )
+            self._batch_tiles = np.empty(
+                (self.batch_size, self._capacity, t, t, c), np.uint8
+            )
+
+    def _grow(self, kmax: int) -> None:
+        """Overflow: widen the sticky capacity (32-tile steps) and
+        migrate any rows already packed this batch."""
+        new_cap = min(-(-kmax // 32) * 32, self.encoder.num_tiles)
+        old_idx, old_tiles, n = self._batch_idx, self._batch_tiles, self._row
+        self._capacity = new_cap
+        self._batch_idx = None
+        self._ensure_batch_arrays()
+        if n and old_idx is not None:
+            self._batch_idx[:n, : old_idx.shape[1]] = old_idx[:n]
+            self._batch_idx[:n, old_idx.shape[1]:] = self.encoder.num_tiles
+            self._batch_tiles[:n, : old_tiles.shape[1]] = old_tiles[:n]
+            self._batch_tiles[:n, old_tiles.shape[1]:] = 0
 
     def flush(self) -> None:
         """Publish any buffered partial batch (call when a finite stream
         ends so trailing frames aren't dropped; the consumer's ingest
         passes the ragged batch through)."""
-        if self._deltas:
+        if self._deltas or self._row:
             self._publish()
 
     def _publish(self) -> None:
-        # Fix the sticky capacity BEFORE the first pack so every message
-        # of the stream (first included) shares one shape = one consumer
-        # decode compilation; grow in 32-tile steps only on overflow.
-        kmax = max((len(i) for i, _ in self._deltas), default=0)
-        if self._capacity is None:
-            kmax = max(int(kmax * 1.3), 1)
-        if self._capacity is None or kmax > self._capacity:
-            self._capacity = min(
-                -(-kmax // 32) * 32, self.encoder.num_tiles
+        if self._deltas:
+            # First batch without a pinned capacity: fix the sticky
+            # capacity BEFORE the pack so every message of the stream
+            # (first included) shares one shape = one consumer decode
+            # compilation; grow in 32-tile steps only on overflow.
+            kmax = max((len(i) for i, _ in self._deltas), default=0)
+            if self._capacity is None:
+                kmax = max(int(kmax * 1.3), 1)
+            if self._capacity is None or kmax > self._capacity:
+                self._capacity = min(
+                    -(-kmax // 32) * 32, self.encoder.num_tiles
+                )
+            idx, tiles = pack_batch(
+                self._deltas, self.encoder.num_tiles,
+                capacity=self._capacity,
             )
-        idx, tiles = pack_batch(
-            self._deltas, self.encoder.num_tiles, capacity=self._capacity
-        )
+            fresh = True  # pack_batch allocated these; safe to ship
+        else:
+            n = self._row
+            # idx is tiny (~KB): copy so the reused batch array never
+            # rides a zero-copy send. tiles is copied below only on the
+            # raw-wire path (the palette path ships fresh arrays).
+            idx = self._batch_idx[:n].copy()
+            tiles = self._batch_tiles[:n]
+            fresh = False
         if (
             self.alpha_slice
             and self._alpha_static
             and self._ref_tile_alpha is not None
         ):
             tiles = np.ascontiguousarray(tiles[..., :3])
+            fresh = True
         h, w, c = self._ref.shape
         msg = {
             "_prebatched": True,
@@ -177,7 +238,7 @@ class TileBatchPublisher:
                 self._palette_misses += 1
                 if self._palette_misses >= 8:
                     self.palette = False
-            msg[self.field + TILES_SUFFIX] = tiles
+            msg[self.field + TILES_SUFFIX] = tiles if fresh else tiles.copy()
         for k, vals in self._extras.items():
             msg[k] = np.stack([np.asarray(v) for v in vals])
         keyframe = (
@@ -190,5 +251,6 @@ class TileBatchPublisher:
         self._deltas.clear()
         self._extras = {}
         self._alpha_static = True
+        self._row = 0
         self.publisher.publish(**msg)
         self.batches_published += 1

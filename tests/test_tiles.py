@@ -219,6 +219,60 @@ def test_falling_cubes_tile_stream():
         assert img.any()  # cubes rendered, not just background
 
 
+def test_tile_publisher_direct_pack_overflow_and_flush():
+    """The direct-pack fast path (pinned capacity): frames encode
+    straight into the batch arrays, a frame exceeding the capacity grows
+    it mid-batch (migrating packed rows), a partial flush ships the
+    filled prefix — all bit-exact on host-side decode."""
+    from blendjax.ops.tiles import (
+        TILEIDX_SUFFIX,
+        TILESHAPE_SUFFIX,
+        decode_tile_delta_np,
+        pop_tile_payload,
+        expand_palette_tiles_np,
+    )
+    from blendjax.producer.tile_publisher import TileBatchPublisher
+
+    class Capture:
+        def __init__(self):
+            self.msgs = []
+
+        def publish(self, **kw):
+            self.msgs.append(kw)
+
+    rng = np.random.default_rng(6)
+    ref = rng.integers(0, 255, (64, 64, 4), np.uint8)
+    cap = Capture()
+    pub = TileBatchPublisher(cap, ref, batch_size=3, tile=16,
+                             alpha_slice=False, capacity=2)
+    frames = []
+    # frame edits: 1 tile, then 5 tiles (overflow: 2 -> 32... clamped to
+    # num_tiles=16), then 2, then 1 (partial batch -> flush)
+    for ntiles in (1, 5, 2, 1):
+        img = ref.copy()
+        for j in range(ntiles):
+            ty, tx = divmod(j, 4)
+            img[ty * 16: ty * 16 + 4, tx * 16: tx * 16 + 4] = rng.integers(
+                0, 255, (4, 4, 4), np.uint8
+            )
+        frames.append(img)
+        pub.add(img, frameid=np.int64(len(frames)))
+    pub.flush()
+    assert len(cap.msgs) == 2  # one full batch of 3 + flushed tail of 1
+    for msg, batch in zip(cap.msgs, (frames[:3], frames[3:])):
+        msg = dict(msg)
+        idx = msg.pop("image" + TILEIDX_SUFFIX)
+        geom = msg.pop("image" + TILESHAPE_SUFFIX)
+        tiles = pop_tile_payload(msg, "image", geom, expand_palette_tiles_np)
+        out = decode_tile_delta_np(ref, idx, tiles, tile=16)
+        assert len(out) == len(batch)
+        for got, want in zip(out, batch):
+            np.testing.assert_array_equal(got, want)
+    # capacity grew past the overflow and stayed 32-aligned (clamped to
+    # the 16-tile grid)
+    assert pub._capacity == 16
+
+
 def test_tile_producer_partial_tail_flush():
     """--frames not a multiple of --batch: trailing frames still arrive
     (ragged prebatched passthrough)."""
