@@ -12,6 +12,12 @@ from blendjax._native.build import (
     load_palettize,
     load_rasterizer,
     load_tile_delta,
+    load_tile_delta_palidx,
 )
 
-__all__ = ["load_rasterizer", "load_tile_delta", "load_palettize"]
+__all__ = [
+    "load_rasterizer",
+    "load_tile_delta",
+    "load_palettize",
+    "load_tile_delta_palidx",
+]

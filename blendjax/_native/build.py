@@ -106,6 +106,42 @@ def load_palettize():
         return _CACHE["palettize"]
 
 
+def load_tile_delta_palidx():
+    """Returns the fused changed-tile scan + palettizer or None.
+
+    ``tile_delta_palidx(img, ref, h, w, c, t, ty0, ty1, tx0, tx1,
+    idx_out i32[n_tiles], palidx_out u8[n_tiles*t*t], keys u32[1024],
+    vals i16[1024], palette u8[256*c], pcount i64[1], cap) ->
+    count | -1`` — keys/vals/palette/pcount are caller-owned persistent
+    stream state.
+    """
+    if os.environ.get("BLENDJAX_NO_NATIVE") == "1":
+        return None
+    with _LOCK:
+        if "tiledelta_palidx" not in _CACHE:
+            lib = _build(os.path.join(_HERE, "tiledelta.cpp"), "tiledelta")
+            if lib is None:
+                _CACHE["tiledelta_palidx"] = None
+            else:
+                u8p = ctypes.POINTER(ctypes.c_uint8)
+                fn = lib.bjx_tile_delta_palidx
+                fn.restype = ctypes.c_int64
+                fn.argtypes = [
+                    u8p, u8p,
+                    ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+                    ctypes.c_int64,
+                    ctypes.c_int64, ctypes.c_int64,
+                    ctypes.c_int64, ctypes.c_int64,
+                    ctypes.POINTER(ctypes.c_int32), u8p,
+                    ctypes.POINTER(ctypes.c_uint32),
+                    ctypes.POINTER(ctypes.c_int16), u8p,
+                    ctypes.POINTER(ctypes.c_int64),
+                    ctypes.c_int64,
+                ]
+                _CACHE["tiledelta_palidx"] = fn
+        return _CACHE["tiledelta_palidx"]
+
+
 def load_rasterizer():
     """Returns ``(fill, clear, clear_rect)`` native functions or None.
 

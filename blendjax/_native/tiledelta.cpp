@@ -94,4 +94,79 @@ int64_t bjx_palettize(const uint8_t* px, int64_t n, int64_t c,
   return count;
 }
 
+// Fused changed-tile scan + palettization: one pass over the image that
+// both finds changed tiles AND emits one palette index per pixel of
+// each changed tile, against a caller-owned color table (keys/vals/
+// palette/pcount survive across calls; the CALLER decides the reset
+// policy — blendjax's TileDeltaEncoder resets it at each batch
+// boundary so color-drifting animated scenes never exhaust it). This
+// replaces the separate whole-batch palettize pass — the scan already
+// touches every changed pixel, so indexing during the copy is nearly
+// free while the second 300KB/frame pass disappears.
+//
+// Returns the number of changed tiles, or -1 when a pixel would push
+// the palette past cap_colors (<= 256). On -1 the outputs for this
+// frame are undefined but the table state stays valid (it only grows
+// within a batch), so frames already returned this batch remain
+// decodable against the table.
+int64_t bjx_tile_delta_palidx(const uint8_t* img, const uint8_t* ref,
+                              int64_t h, int64_t w, int64_t c, int64_t t,
+                              int64_t ty0, int64_t ty1,
+                              int64_t tx0, int64_t tx1,
+                              int32_t* idx_out, uint8_t* palidx_out,
+                              uint32_t* keys, int16_t* vals,
+                              uint8_t* palette, int64_t* pcount,
+                              int64_t cap_colors) {
+  if (cap_colors > 256 || c > 4) return -1;
+  const int64_t tw = w / t;
+  const int64_t th = h / t;
+  const int64_t row_bytes = w * c;
+  const int64_t trow_bytes = t * c;
+  const int64_t mask = 1023;  // table is always 1024 slots
+  ty0 = std::max<int64_t>(ty0, 0); ty1 = std::min<int64_t>(ty1, th);
+  tx0 = std::max<int64_t>(tx0, 0); tx1 = std::min<int64_t>(tx1, tw);
+  int64_t count = 0;
+  for (int64_t ty = ty0; ty < ty1; ++ty) {
+    for (int64_t tx = tx0; tx < tx1; ++tx) {
+      const int64_t base = (ty * t) * row_bytes + tx * trow_bytes;
+      bool changed = false;
+      for (int64_t y = 0; y < t; ++y) {
+        if (std::memcmp(img + base + y * row_bytes,
+                        ref + base + y * row_bytes, trow_bytes) != 0) {
+          changed = true;
+          break;
+        }
+      }
+      if (!changed) continue;
+      idx_out[count] = (int32_t)(ty * tw + tx);
+      uint8_t* dst = palidx_out + count * t * t;
+      for (int64_t y = 0; y < t; ++y) {
+        const uint8_t* src = img + base + y * row_bytes;
+        for (int64_t x = 0; x < t; ++x) {
+          uint32_t key = 0;
+          for (int64_t j = 0; j < c; ++j)
+            key |= (uint32_t)src[x * c + j] << (8 * j);
+          int64_t hh = (int64_t)((key * 2654435761u) & mask);
+          for (;;) {
+            if (vals[hh] < 0) {
+              if (*pcount == cap_colors) return -1;
+              keys[hh] = key;
+              vals[hh] = (int16_t)*pcount;
+              for (int64_t j = 0; j < c; ++j)
+                palette[*pcount * c + j] = src[x * c + j];
+              ++*pcount;
+              break;
+            }
+            if (keys[hh] == key) break;
+            hh = (hh + 1) & mask;
+          }
+          dst[y * t + x] = (uint8_t)vals[hh];
+        }
+      }
+      ++count;
+    }
+  }
+  return count;
+}
+
 }  // extern "C"

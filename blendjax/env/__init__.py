@@ -13,14 +13,19 @@ from blendjax.env.vector import BatchedRemoteEnv
 
 try:  # gymnasium is an optional dependency (reference guards gym the
     # same way, ``btt/env.py:191,315``)
-    from blendjax.env.gymnasium_adapter import GymnasiumRemoteEnv
+    from blendjax.env.gymnasium_adapter import (
+        GymnasiumRemoteEnv,
+        OpenAIRemoteEnv,
+    )
 except ImportError:  # pragma: no cover
     GymnasiumRemoteEnv = None
+    OpenAIRemoteEnv = None
 
 __all__ = [
     "RemoteEnv",
     "launch_env",
     "GymnasiumRemoteEnv",
+    "OpenAIRemoteEnv",
     "BatchedRemoteEnv",
     "create_renderer",
     "RENDER_BACKENDS",

@@ -80,3 +80,24 @@ class GymnasiumRemoteEnv(gymnasium.Env):
 
     def close(self):
         self._ctx.__exit__(None, None, None)
+
+
+class OpenAIRemoteEnv(GymnasiumRemoteEnv):
+    """Classic-gym-shaped compatibility shim over the Gymnasium adapter
+    (reference ``OpenAIRemoteEnv``, ``btt/env.py:195-313``).
+
+    The reference wrapped the (now unmaintained) ``gym`` package;
+    blendjax deliberately targets Gymnasium (PARITY.md notes the
+    departure). This shim restores the classic CALL SHAPE for code
+    migrating from the reference — ``reset() -> obs`` and ``step() ->
+    (obs, reward, done, info)`` with ``done = terminated or truncated``
+    — without importing ``gym``.
+    """
+
+    def reset(self, **kwargs):  # type: ignore[override]
+        obs, _info = super().reset(**kwargs)
+        return obs
+
+    def step(self, action):  # type: ignore[override]
+        obs, reward, terminated, truncated, info = super().step(action)
+        return obs, reward, bool(terminated or truncated), info

@@ -90,6 +90,28 @@ class TileDeltaEncoder:
         from blendjax._native import load_tile_delta
 
         self._native = load_tile_delta()
+        self._native_palidx = None  # resolved on first encode_palidx
+        self._pal_state = None
+
+    def _check_frame(self, img: np.ndarray) -> None:
+        if img.shape != self.ref.shape or img.dtype != np.uint8:
+            raise ValueError(
+                f"frame shape {img.shape}/{img.dtype} != ref "
+                f"{self.ref.shape}/uint8"
+            )
+
+    def tile_bounds(self, hint):
+        """Pixel-rect ``hint`` -> tile-grid scan bounds
+        ``(ty0, ty1, tx0, tx1)`` (full grid for ``hint=None``)."""
+        t = self.tile
+        th, tw = self.grid
+        if hint is None:
+            return 0, th, 0, tw
+        y0, y1, x0, x1 = hint
+        return (
+            max(y0 // t, 0), min(-(-y1 // t), th),
+            max(x0 // t, 0), min(-(-x1 // t), tw),
+        )
 
     def encode(self, img: np.ndarray, hint=None):
         """One frame -> ``(idx int32[K], tiles uint8[K, t, t, C])`` views
@@ -103,18 +125,10 @@ class TileDeltaEncoder:
         t = self.tile
         h, w, c = self.ref.shape
         th, tw = self.grid
-        if img.shape != self.ref.shape or img.dtype != np.uint8:
-            raise ValueError(
-                f"frame shape {img.shape}/{img.dtype} != ref {self.ref.shape}/uint8"
-            )
-        if hint is None:
-            ty0, ty1, tx0, tx1 = 0, th, 0, tw
-        else:
-            y0, y1, x0, x1 = hint
-            ty0, ty1 = max(y0 // t, 0), min(-(-y1 // t), th)
-            tx0, tx1 = max(x0 // t, 0), min(-(-x1 // t), tw)
-            if ty0 >= ty1 or tx0 >= tx1:
-                return self._idx[:0], self._tiles[:0]
+        self._check_frame(img)
+        ty0, ty1, tx0, tx1 = self.tile_bounds(hint)
+        if ty0 >= ty1 or tx0 >= tx1:
+            return self._idx[:0], self._tiles[:0]
         if self._native is not None and img.flags.c_contiguous:
             import ctypes
 
@@ -139,6 +153,80 @@ class TileDeltaEncoder:
         # Advanced indexing (rows, :, cols) puts the K axis first -> (K,t,t,C).
         self._tiles[:k] = v[idx // tw, :, idx % tw]
         return self._idx[:k], self._tiles[:k]
+
+    # -- fused scan + palettize (native only) -------------------------------
+
+    def palidx_available(self) -> bool:
+        """True when the fused scan+palettize (``encode_palidx``) can run
+        (native helpers built, <= 4 channels)."""
+        if self.ref.shape[2] > 4:
+            return False
+        if self._native_palidx is None:
+            from blendjax._native import load_tile_delta_palidx
+
+            self._native_palidx = load_tile_delta_palidx()
+        return self._native_palidx is not None
+
+    def reset_palette(self) -> None:
+        """Clear the palette table (call at each batch boundary so
+        color-drifting scenes never exhaust the 256 entries)."""
+        if self._pal_state is not None:
+            self._pal_state["vals"].fill(-1)
+            self._pal_state["count"][0] = 0
+
+    @property
+    def palette(self) -> np.ndarray:
+        """(256, C) uint8 palette filled up to ``palette_count``."""
+        return self._pal_state["table"]
+
+    @property
+    def palette_count(self) -> int:
+        return int(self._pal_state["count"][0]) if self._pal_state else 0
+
+    def encode_palidx(self, img: np.ndarray, hint=None):
+        """One frame -> ``(idx int32[K], palidx uint8[K, t*t])`` views
+        into internal staging — the fused form of :meth:`encode` that
+        emits palette indices against the encoder's palette table
+        instead of raw tiles (one pass; no tile materialization).
+
+        Returns ``None`` when a pixel would push the table past 256
+        colors — the caller falls back to :meth:`encode` (the table
+        state stays valid). Call :meth:`reset_palette` per batch.
+        """
+        import ctypes
+
+        if not self.palidx_available():
+            return None
+        self._check_frame(img)
+        img = np.ascontiguousarray(img)
+        h, w, c = self.ref.shape
+        if self._pal_state is None:
+            self._pal_state = {
+                "keys": np.zeros(1024, np.uint32),
+                "vals": np.full(1024, -1, np.int16),
+                "table": np.zeros((256, c), np.uint8),
+                "count": np.zeros(1, np.int64),
+            }
+            self._palidx_stage = np.empty(
+                (self.num_tiles, self.tile * self.tile), np.uint8
+            )
+        ty0, ty1, tx0, tx1 = self.tile_bounds(hint)
+        s = self._pal_state
+        u8 = ctypes.POINTER(ctypes.c_uint8)
+        k = self._native_palidx(
+            img.ctypes.data_as(u8), self.ref.ctypes.data_as(u8),
+            h, w, c, self.tile, ty0, ty1, tx0, tx1,
+            self._idx.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            self._palidx_stage.ctypes.data_as(u8),
+            s["keys"].ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),
+            s["vals"].ctypes.data_as(ctypes.POINTER(ctypes.c_int16)),
+            s["table"].ctypes.data_as(u8),
+            s["count"].ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            256,
+        )
+        if k < 0:
+            return None
+        return self._idx[:k], self._palidx_stage[:k]
 
 
 def pack_batch(deltas, num_tiles: int, bucket: int = 16, capacity=None):

@@ -63,6 +63,11 @@ class TileBatchPublisher:
     usually do): <=16 colors ship as 4-bit indices (8x fewer bytes),
     <=256 as bytes (4x); more falls back to raw tiles. Lossless either
     way — the consumer's decode gathers through the palette on device.
+    With full-channel tiles (``alpha_slice=False``) and the native
+    helpers available, palettization FUSES into the changed-tile scan
+    (one pass, no raw-tile materialization; the color table resets per
+    batch, matching the two-pass semantics); a >256-color batch falls
+    back to raw tiles transparently.
 
     ``capacity`` pins the per-frame tile capacity from the first batch
     (it still grows on overflow). Every distinct capacity is a distinct
@@ -120,12 +125,64 @@ class TileBatchPublisher:
         self._batch_idx: np.ndarray | None = None
         self._batch_tiles: np.ndarray | None = None
         self._row = 0
+        # Fused scan+palettize (encoder.encode_palidx, native): one pass
+        # both finds changed tiles and emits PER-BATCH palette indices
+        # (the table resets at each batch boundary, so color-drifting
+        # animated scenes never exhaust it) — the separate whole-batch
+        # palettize pass and the raw-tile materialization disappear.
+        # Engages when palettization is on and full-channel tiles stream
+        # (alpha slicing needs raw tiles for its check); a >256-color
+        # batch falls back to raw tiles, repeated fallbacks latch the
+        # path off like the two-pass miss latch.
+        self._fused_ok = (
+            self.palette
+            # alpha slicing is inert without an alpha plane: RGB streams
+            # keep the fused path under the default alpha_slice=True
+            and not (self.alpha_slice and self._ref_tile_alpha is not None)
+            and self.encoder.palidx_available()
+        )
+        self._raw_batch = False  # this batch fell back to raw tiles
+        self._batch_pal: np.ndarray | None = None
 
     def add(self, image: np.ndarray, hint=None, **extras) -> None:
         """Add one frame plus its per-frame sidecar fields (annotations,
         frame ids, ...); publishes automatically when the batch fills.
         ``hint`` optionally bounds the changed-tile scan to a pixel rect
         (see :meth:`TileDeltaEncoder.encode`)."""
+        if (
+            self._fused_ok
+            and not self._raw_batch
+            and self._capacity is not None
+        ):
+            if self._row == 0:
+                self.encoder.reset_palette()  # per-batch palette
+            out = self.encoder.encode_palidx(image, hint=hint)
+            if out is not None:
+                fi, fpal = out
+                k = len(fi)
+                if k > self._capacity:
+                    self._grow(k)
+                self._ensure_batch_arrays()
+                i = self._row
+                self._batch_idx[i, :k] = fi
+                self._batch_idx[i, k:] = self.encoder.num_tiles
+                self._batch_pal[i, :k] = fpal
+                self._batch_pal[i, k:] = 0
+                self._row += 1
+                for key, v in extras.items():
+                    self._extras.setdefault(key, []).append(v)
+                if self._row == self.batch_size:
+                    self._publish()
+                return
+            # >256 colors in this batch: reconstruct raw tiles for the
+            # rows already packed and finish the batch raw (batch-level
+            # palettize may still engage at publish). Repeated overflows
+            # latch the fused path off like the two-pass miss latch.
+            self._raw_batch = True
+            self._palette_misses += 1
+            if self._palette_misses >= 8:
+                self._fused_ok = False
+            self._depalettize_rows()
         fi, ft = self.encoder.encode(image, hint=hint)
         if self._ref_tile_alpha is not None and self._alpha_static:
             # Unchanged tiles are byte-identical to the ref by definition,
@@ -163,20 +220,47 @@ class TileBatchPublisher:
             self._batch_tiles = np.empty(
                 (self.batch_size, self._capacity, t, t, c), np.uint8
             )
+        if self._fused_ok and self._batch_pal is None:
+            self._batch_pal = np.empty(
+                (self.batch_size, self._capacity, self.tile * self.tile),
+                np.uint8,
+            )
 
     def _grow(self, kmax: int) -> None:
         """Overflow: widen the sticky capacity (32-tile steps) and
         migrate any rows already packed this batch."""
         new_cap = min(-(-kmax // 32) * 32, self.encoder.num_tiles)
         old_idx, old_tiles, n = self._batch_idx, self._batch_tiles, self._row
+        old_pal = self._batch_pal
         self._capacity = new_cap
         self._batch_idx = None
+        self._batch_pal = None
         self._ensure_batch_arrays()
         if n and old_idx is not None:
             self._batch_idx[:n, : old_idx.shape[1]] = old_idx[:n]
             self._batch_idx[:n, old_idx.shape[1]:] = self.encoder.num_tiles
             self._batch_tiles[:n, : old_tiles.shape[1]] = old_tiles[:n]
             self._batch_tiles[:n, old_tiles.shape[1]:] = 0
+            if old_pal is not None and self._batch_pal is not None:
+                self._batch_pal[:n, : old_pal.shape[1]] = old_pal[:n]
+                self._batch_pal[:n, old_pal.shape[1]:] = 0
+
+    def _depalettize_rows(self) -> None:
+        """Fused -> raw fallback mid-batch: reconstruct raw tiles for the
+        rows already packed as palette indices (lossless gather)."""
+        n = self._row
+        if not n or self._batch_pal is None:
+            return
+        self._ensure_batch_arrays()
+        t, c = self.tile, self._ref.shape[2]
+        colors = self.encoder.palette  # (256, c); indices < count
+        self._batch_tiles[:n] = colors[self._batch_pal[:n]].reshape(
+            n, self._capacity, t, t, c
+        )
+        # padding slots must ship zeroed tiles (pack contract), not
+        # palette color 0
+        pad = self._batch_idx[:n] == self.encoder.num_tiles
+        self._batch_tiles[:n][pad] = 0
 
     def flush(self) -> None:
         """Publish any buffered partial batch (call when a finite stream
@@ -185,7 +269,65 @@ class TileBatchPublisher:
         if self._deltas or self._row:
             self._publish()
 
+    def _finish_publish(self, msg: dict) -> None:
+        """Shared tail of both publish forms: sidecar extras, keyframe
+        reference attachment, per-batch state reset, publish."""
+        for k, vals in self._extras.items():
+            msg[k] = np.stack([np.asarray(v) for v in vals])
+        keyframe = (
+            self.ref_interval > 0
+            and self.batches_published % self.ref_interval == 0
+        )
+        if not self._ref_sent or keyframe:
+            msg[self.field + TILEREF_SUFFIX] = self._ref
+            self._ref_sent = True
+        self._deltas.clear()
+        self._extras = {}
+        self._alpha_static = True
+        self._row = 0
+        self._raw_batch = False
+        self.publisher.publish(**msg)
+        self.batches_published += 1
+
     def _publish(self) -> None:
+        if (
+            self._fused_ok
+            and not self._raw_batch
+            and self._row
+            and not self._deltas
+        ):
+            # Fused path: rows are already palette indices against the
+            # encoder's per-batch table — no raw tiles ever materialized.
+            n = self._row
+            h, w, c = self._ref.shape
+            idx = self._batch_idx[:n].copy()
+            pal_idx = self._batch_pal[:n]
+            # palette success resets the miss latch (matching the
+            # two-pass path; a per-frame reset would defeat the latch)
+            self._palette_misses = 0
+            count = self.encoder.palette_count
+            if count <= 16 and (self.tile * self.tile) % 2 == 0:
+                packed = (
+                    (pal_idx[..., 0::2] << 4) | pal_idx[..., 1::2]
+                )  # fresh allocation; first pixel in the high nibble
+                suffix = TILEPAL4_SUFFIX
+                cap_colors = 16
+            else:
+                packed = pal_idx.copy()
+                suffix = TILEPAL8_SUFFIX
+                cap_colors = 256
+            # zero-padded past `count` (the wire contract; the table's
+            # rows beyond count may hold a previous batch's colors)
+            pal = np.zeros((cap_colors, c), np.uint8)
+            pal[:count] = self.encoder.palette[:count]
+            self._finish_publish({
+                "_prebatched": True,
+                self.field + TILEIDX_SUFFIX: idx,
+                self.field + TILESHAPE_SUFFIX: [h, w, c, self.tile],
+                self.field + suffix: packed,
+                self.field + PALETTE_SUFFIX: pal,
+            })
+            return
         if self._deltas:
             # First batch without a pinned capacity: fix the sticky
             # capacity BEFORE the pack so every message of the stream
@@ -239,18 +381,4 @@ class TileBatchPublisher:
                 if self._palette_misses >= 8:
                     self.palette = False
             msg[self.field + TILES_SUFFIX] = tiles if fresh else tiles.copy()
-        for k, vals in self._extras.items():
-            msg[k] = np.stack([np.asarray(v) for v in vals])
-        keyframe = (
-            self.ref_interval > 0
-            and self.batches_published % self.ref_interval == 0
-        )
-        if not self._ref_sent or keyframe:
-            msg[self.field + TILEREF_SUFFIX] = self._ref
-            self._ref_sent = True
-        self._deltas.clear()
-        self._extras = {}
-        self._alpha_static = True
-        self._row = 0
-        self.publisher.publish(**msg)
-        self.batches_published += 1
+        self._finish_publish(msg)

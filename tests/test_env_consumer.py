@@ -118,3 +118,39 @@ def test_gymnasium_adapter_api():
         assert truncated or terminated
     finally:
         env.close()
+
+
+@pytest.mark.skipif(
+    pytest.importorskip("gymnasium") is None, reason="gymnasium missing"
+)
+def test_openai_compat_shim_classic_call_shape():
+    """OpenAIRemoteEnv restores the reference's classic-gym call shape
+    (``btt/env.py:195-313``): reset -> obs, step -> (obs, reward, done,
+    info) — for code migrating from blendtorch."""
+    import gymnasium
+
+    from blendjax.env import OpenAIRemoteEnv
+
+    env = OpenAIRemoteEnv(
+        script=CARTPOLE,
+        observation_space=gymnasium.spaces.Box(
+            -np.inf, np.inf, (4,), np.float32
+        ),
+        action_space=gymnasium.spaces.Box(-5, 5, (1,), np.float32),
+        max_episode_steps=5,
+        seed=3,
+    )
+    try:
+        obs = env.reset()
+        assert isinstance(obs, np.ndarray) and obs.shape == (4,)
+        done = False
+        steps = 0
+        while not done:
+            out = env.step(np.zeros(1, np.float32))
+            assert len(out) == 4
+            obs, reward, done, info = out
+            steps += 1
+            assert steps <= 5
+        assert steps >= 1
+    finally:
+        env.close()

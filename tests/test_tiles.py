@@ -273,6 +273,134 @@ def test_tile_publisher_direct_pack_overflow_and_flush():
     assert pub._capacity == 16
 
 
+def test_tile_publisher_fused_engages_for_rgb_default_config():
+    """3-channel streams have no alpha plane, so the default
+    alpha_slice=True is inert and must not disable the fused path; the
+    shipped palette is zero-padded past the used entries."""
+    from blendjax.ops.tiles import PALETTE_SUFFIX
+    from blendjax.producer.tile_publisher import TileBatchPublisher
+
+    class Capture:
+        def __init__(self):
+            self.msgs = []
+
+        def publish(self, **kw):
+            self.msgs.append(kw)
+
+    ref = np.zeros((32, 32, 3), np.uint8)
+    cap = Capture()
+    pub = TileBatchPublisher(cap, ref, batch_size=2, tile=16, capacity=4)
+    assert pub._fused_ok
+    img = ref.copy()
+    img[0:8, 0:8] = (1, 2, 3)
+    pub.add(img)
+    pub.add(img)
+    (msg,) = cap.msgs
+    pal = msg["image" + PALETTE_SUFFIX]
+    used = pub.encoder.palette_count
+    assert used >= 2
+    assert (pal[used:] == 0).all()  # zero-padded wire contract
+
+
+def test_tile_publisher_raw_direct_pack_path():
+    """palette=False: the direct-pack raw path (no fused palettizer)
+    ships copied raw tiles, bit-exact, with reused batch arrays."""
+    from blendjax.ops.tiles import (
+        TILEIDX_SUFFIX,
+        TILES_SUFFIX,
+        decode_tile_delta_np,
+    )
+    from blendjax.producer.tile_publisher import TileBatchPublisher
+
+    class Capture:
+        def __init__(self):
+            self.msgs = []
+
+        def publish(self, **kw):
+            self.msgs.append(kw)
+
+    rng = np.random.default_rng(14)
+    ref = rng.integers(0, 255, (64, 64, 4), np.uint8)
+    cap = Capture()
+    pub = TileBatchPublisher(cap, ref, batch_size=2, tile=16,
+                             alpha_slice=False, palette=False, capacity=4)
+    assert not pub._fused_ok
+    frames = []
+    for n in range(4):
+        img = ref.copy()
+        img[0:8, 0:8] = rng.integers(0, 255, (8, 8, 4), np.uint8)
+        frames.append(img)
+        pub.add(img)
+    assert len(cap.msgs) == 2
+    # reused batch arrays must not alias the shipped tiles
+    assert cap.msgs[0]["image" + TILES_SUFFIX].base is not pub._batch_tiles
+    for msg, batch in zip(cap.msgs, (frames[:2], frames[2:])):
+        out = decode_tile_delta_np(
+            ref, msg["image" + TILEIDX_SUFFIX],
+            msg["image" + TILES_SUFFIX], tile=16,
+        )
+        for got, want in zip(out, batch):
+            np.testing.assert_array_equal(got, want)
+
+
+def test_tile_publisher_fused_palette_overflow_falls_back():
+    """A frame pushing the persistent stream palette past 256 colors
+    latches the fused path off mid-batch; already-packed rows
+    reconstruct from their indices (lossless) and the batch ships raw
+    tiles — everything still decodes bit-exact."""
+    from blendjax.ops.tiles import (
+        TILEIDX_SUFFIX,
+        TILESHAPE_SUFFIX,
+        decode_tile_delta_np,
+        expand_palette_tiles_np,
+        pop_tile_payload,
+    )
+    from blendjax.producer.tile_publisher import TileBatchPublisher
+
+    class Capture:
+        def __init__(self):
+            self.msgs = []
+
+        def publish(self, **kw):
+            self.msgs.append(kw)
+
+    rng = np.random.default_rng(15)
+    ref = np.zeros((64, 64, 4), np.uint8)
+    cap = Capture()
+    pub = TileBatchPublisher(cap, ref, batch_size=2, tile=16,
+                             alpha_slice=False, capacity=8)
+    assert pub._fused_ok
+    flat = ref.copy()
+    flat[0:16, 0:16] = (10, 20, 30, 255)  # few colors: fused packs it
+    rich = ref.copy()
+    rich[0:32, 0:32] = rng.integers(0, 255, (32, 32, 4), np.uint8)  # ~1k
+    pub.add(flat)
+    pub.add(rich)  # overflow mid-batch -> raw fallback for THIS batch
+    assert pub._fused_ok  # one overflow does not latch fused off
+    # one miss from the fused overflow + one from the publish-time
+    # two-pass palettize also failing on the color-rich batch
+    assert pub._palette_misses == 2
+    pub.add(flat)
+    pub.add(flat)  # next batch: fused again (per-batch table reset)
+    assert len(cap.msgs) == 2
+    for msg, batch in zip(cap.msgs, ((flat, rich), (flat, flat))):
+        msg = dict(msg)
+        idx = msg.pop("image" + TILEIDX_SUFFIX)
+        geom = msg.pop("image" + TILESHAPE_SUFFIX)
+        tiles = pop_tile_payload(
+            msg, "image", geom, expand_palette_tiles_np
+        )
+        out = decode_tile_delta_np(ref, idx, tiles, tile=16)
+        for got, want in zip(out, batch):
+            np.testing.assert_array_equal(got, want)
+    # batch 1 shipped raw tiles (overflow), batch 2 palette again
+    from blendjax.ops.tiles import TILEPAL4_SUFFIX, TILES_SUFFIX
+
+    assert "image" + TILES_SUFFIX in cap.msgs[0]
+    assert "image" + TILEPAL4_SUFFIX in cap.msgs[1]
+    assert pub._palette_misses == 0  # success resets the miss latch
+
+
 def test_tile_producer_partial_tail_flush():
     """--frames not a multiple of --batch: trailing frames still arrive
     (ragged prebatched passthrough)."""
