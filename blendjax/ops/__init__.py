@@ -23,8 +23,14 @@ _TILES = {
     "pack_batch",
     "tile_ref",
 }
+_AUGMENT = {
+    "make_augment",
+    "random_crop",
+    "color_jitter",
+    "random_cutout",
+}
 
-__all__ = sorted(_IMAGE | _TILES)
+__all__ = sorted(_IMAGE | _TILES | _AUGMENT)
 
 
 def __getattr__(name):
@@ -36,4 +42,8 @@ def __getattr__(name):
         from blendjax.ops import tiles
 
         return getattr(tiles, name)
+    if name in _AUGMENT:
+        from blendjax.ops import augment
+
+        return getattr(augment, name)
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

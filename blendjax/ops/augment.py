@@ -1,0 +1,120 @@
+"""On-device batched data augmentation.
+
+SURVEY.md §7 build step 8: the reference does all image post-processing
+on CPU numpy (gamma in ``generate.py:10-14``; no augmentation at all) —
+blendjax runs augmentation ON the accelerator, inside the jitted train
+step, where it fuses with the uint8 normalization and the first conv
+and shards along the batch axis like any other op (per-sample
+randomness via ``vmap``'d key splits; no host round trip, no Python RNG
+in the hot loop).
+
+Every op has signature ``op(rng, images) -> images`` over uint8 or
+float NHWC batches and is jit/vmap/shard-safe (static shapes; per-
+sample decisions ride ``jnp.where``/``dynamic_slice``). Compose with
+:func:`make_augment`, or hand the composition to
+``blendjax.train.make_supervised_step(augment=...)`` which folds a
+per-step key from the training step counter (deterministic resume).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+# One source of truth: the flip op predates this module (image.py).
+from blendjax.ops.image import random_flip
+
+
+def random_crop(rng, images, pad: int = 4):
+    """Pad-and-crop (the CIFAR recipe): edge-pad ``pad`` pixels then
+    take a per-sample random HxW crop back to the original size —
+    static output shapes, so jit compiles once."""
+    b, h, w, c = images.shape
+    padded = jnp.pad(
+        images, ((0, 0), (pad, pad), (pad, pad), (0, 0)), mode="edge"
+    )
+    keys = jax.random.split(rng, b)
+
+    def crop_one(key, img):
+        oy = jax.random.randint(key, (), 0, 2 * pad + 1)
+        ox = jax.random.randint(jax.random.fold_in(key, 1), (), 0,
+                                2 * pad + 1)
+        return jax.lax.dynamic_slice(img, (oy, ox, 0), (h, w, c))
+
+    return jax.vmap(crop_one)(keys, padded)
+
+
+def color_jitter(rng, images, brightness: float = 0.2,
+                 contrast: float = 0.2):
+    """Per-sample brightness/contrast jitter, uint8-in/uint8-out; float
+    input must already be normalized to [0, 1] (the package-wide float
+    contract, see ``maybe_normalize_uint8``) and stays float. One fused
+    elementwise expression — XLA folds it into whatever consumes the
+    batch."""
+    b = images.shape[0]
+    is_int = jnp.issubdtype(images.dtype, jnp.integer)
+    x = images.astype(jnp.float32)
+    if is_int:
+        x = x / 255.0
+    kb, kc = jax.random.split(rng)
+    shape = (b,) + (1,) * (images.ndim - 1)
+    bright = jax.random.uniform(
+        kb, shape, minval=-brightness, maxval=brightness
+    )
+    contr = 1.0 + jax.random.uniform(
+        kc, shape, minval=-contrast, maxval=contrast
+    )
+    mean = x.mean(axis=(1, 2), keepdims=True)
+    x = jnp.clip((x - mean) * contr + mean + bright, 0.0, 1.0)
+    if is_int:
+        return jnp.round(x * 255.0).astype(images.dtype)
+    return x.astype(images.dtype)
+
+
+def random_cutout(rng, images, size: int = 16, fill: int = 0):
+    """Per-sample square cutout (random erasing) at a random location.
+    Static shapes: the mask is built from coordinate comparisons."""
+    b, h, w, _ = images.shape
+    keys = jax.random.split(rng, b)
+    ys = jnp.arange(h)[:, None]
+    xs = jnp.arange(w)[None, :]
+
+    def one(key, img):
+        cy = jax.random.randint(key, (), 0, h)
+        cx = jax.random.randint(jax.random.fold_in(key, 1), (), 0, w)
+        mask = (
+            (ys >= cy - size // 2) & (ys < cy + size // 2)
+            & (xs >= cx - size // 2) & (xs < cx + size // 2)
+        )
+        return jnp.where(
+            mask[..., None], jnp.asarray(fill, img.dtype), img
+        )
+
+    return jax.vmap(one)(keys, images)
+
+
+def make_augment(*ops):
+    """Compose augmentation ops into one ``fn(rng, images)``; each op
+    draws from an independent fold of the key.
+
+    >>> import functools
+    >>> aug = make_augment(random_flip,
+    ...                    functools.partial(random_crop, pad=4))
+    >>> batch_out = jax.jit(aug)(key, batch)
+    """
+
+    def augment(rng, images):
+        for i, op in enumerate(ops):
+            images = op(jax.random.fold_in(rng, i), images)
+        return images
+
+    return augment
+
+
+__all__ = [
+    "random_flip",
+    "random_crop",
+    "color_jitter",
+    "random_cutout",
+    "make_augment",
+]

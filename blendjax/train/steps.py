@@ -52,6 +52,8 @@ def make_supervised_step(
     loss_fn=None,
     donate: bool = True,
     accum_steps: int = 1,
+    augment=None,
+    augment_rng=None,
 ):
     """Build ``step(state, batch) -> (state, metrics)``.
 
@@ -66,8 +68,18 @@ def make_supervised_step(
       the single optimizer update — activation memory scales with the
       microbatch while the optimizer sees the full batch (gradients are
       identical to the unaccumulated step up to float associativity).
+    - ``augment`` is an optional ``fn(rng, images) -> images``
+      (:mod:`blendjax.ops.augment`) applied to ``batch['image']`` INSIDE
+      the jitted step — on device, sharded with the batch, fused into
+      the input cast. The per-step key folds ``augment_rng`` (default
+      key 0) with the training step counter, so runs are deterministic
+      and checkpoint-resume replays the same augmentation sequence.
     """
     del mesh, batch_sharding  # layouts ride on the arrays (see above)
+    if augment is not None:
+        base_rng = (
+            augment_rng if augment_rng is not None else jax.random.key(0)
+        )
     loss_fn = loss_fn or (
         lambda state, params, batch: corner_loss(
             state.apply_fn({"params": params}, batch["image"]),
@@ -78,6 +90,10 @@ def make_supervised_step(
     accum_steps = max(1, int(accum_steps))
 
     def step(state, batch):
+        if augment is not None:
+            rng = jax.random.fold_in(base_rng, state.step)
+            batch = {**batch, "image": augment(rng, batch["image"])}
+
         def scalar_loss(params, b):
             return loss_fn(state, params, b)
 
