@@ -227,11 +227,12 @@ def main() -> None:
     except Exception:
         pass  # older jax without these flags: compile per run
 
-    # Two measurement passes, best sustained reported: the device link's
-    # throughput swings several-fold within minutes (tunnel weather), so
-    # a single sample under-reports the pipeline more often than not.
-    # Both passes land in detail.passes for the full picture.
-    n_passes = max(1, int(os.environ.get("BLENDJAX_BENCH_PASSES", "2")))
+    # BLENDJAX_BENCH_PASSES measurement passes (default 3), best
+    # sustained reported: the device link's throughput swings
+    # several-fold within minutes (tunnel weather), so a single sample
+    # under-reports the pipeline more often than not. Every pass lands
+    # in detail.passes for the full picture.
+    n_passes = max(1, int(os.environ.get("BLENDJAX_BENCH_PASSES", "3")))
     passes = [
         measure(ENCODING, CHUNK, MEASURE_ITEMS, TIME_CAP_S)
         for _ in range(n_passes)

@@ -191,6 +191,7 @@ class ProcessLauncher:
         # via start_new_session (preexec_fn otherwise disables the
         # posix_spawn fast path and is fork-unsafe on macOS).
         import threading
+        import warnings
 
         preexec = None
         if (
@@ -200,9 +201,22 @@ class ProcessLauncher:
             def preexec():
                 _PRCTL(1, 15)  # PR_SET_PDEATHSIG, SIGTERM
 
-        return subprocess.Popen(
-            argv, start_new_session=True, preexec_fn=preexec, env=env
-        )
+        if preexec is None:
+            # no preexec (non-main-thread respawn, non-Linux): no
+            # fork-with-threads warning fires, and no global warning-
+            # filter mutation happens off the main thread.
+            return subprocess.Popen(argv, start_new_session=True, env=env)
+        with warnings.catch_warnings():
+            # CPython warns on fork-with-threads when preexec_fn is set
+            # (jax keeps background threads). This preexec calls ONE
+            # pre-resolved libc symbol — no malloc, no imports, no locks
+            # — the fork-safe subset the warning exists to protect;
+            # suppress it for this call only (main thread only: preexec
+            # is None on any other thread, handled above).
+            warnings.simplefilter("ignore", DeprecationWarning)
+            return subprocess.Popen(
+                argv, start_new_session=True, preexec_fn=preexec, env=env
+            )
 
     @property
     def addresses(self) -> dict:

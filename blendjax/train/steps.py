@@ -74,6 +74,10 @@ def make_supervised_step(
       the input cast. The per-step key folds ``augment_rng`` (default
       key 0) with the training step counter, so runs are deterministic
       and checkpoint-resume replays the same augmentation sequence.
+      ONLY ``batch['image']`` is transformed: with spatial labels
+      (pixel coordinates, masks), geometric ops like flip/crop would
+      desynchronize image and label — use photometric ops there, or
+      apply a paired transform in ``loss_fn`` instead.
     """
     del mesh, batch_sharding  # layouts ride on the arrays (see above)
     if augment is not None:

@@ -35,7 +35,17 @@ def main():
         help="coalesce K tile batches into one transfer + one jitted "
         "scan of K updates (needs --encoding tile)",
     )
+    ap.add_argument(
+        "--augment", action="store_true",
+        help="on-device color jitter inside the jitted step "
+        "(blendjax.ops.augment; per-step deterministic keys). Only "
+        "photometric ops: this task supervises pixel-space corner "
+        "coordinates, which geometric ops (flip/crop) would invalidate "
+        "without a matching label transform.",
+    )
     args = ap.parse_args()
+    if args.augment and args.encoding == "tile" and args.chunk > 1:
+        ap.error("--augment currently pairs with chunk=1 steps")
 
     import jax
 
@@ -57,12 +67,21 @@ def main():
     state = make_train_state(
         model, np.zeros((args.batch, h, w, 4), np.uint8), mesh=mesh
     )
+    augment = None
+    if args.augment:
+        # Label-safe augmentation only: the corner labels live in pixel
+        # space, so flips/crops would need the xy labels co-transformed.
+        from blendjax.ops.augment import color_jitter, make_augment
+
+        augment = make_augment(color_jitter)
     chunk = args.chunk if args.encoding == "tile" else 1
     if chunk > 1:
         # K sequential updates per device call (see docs/performance.md)
         step = make_chunked_supervised_step()
     else:
-        step = make_supervised_step(mesh=mesh, batch_sharding=sharding)
+        step = make_supervised_step(
+            mesh=mesh, batch_sharding=sharding, augment=augment
+        )
 
     def run_steps(batches):
         nonlocal state
