@@ -28,6 +28,8 @@ _AUGMENT = {
     "random_crop",
     "color_jitter",
     "random_cutout",
+    "random_flip_with_points",
+    "random_crop_with_points",
 }
 
 __all__ = sorted(_IMAGE | _TILES | _AUGMENT)

@@ -25,6 +25,15 @@ import jax.numpy as jnp
 from blendjax.ops.image import random_flip
 
 
+def _crop_offsets(key, pad: int):
+    """Per-sample (oy, ox) crop offsets — the ONE key-fold scheme shared
+    by the paired and unpaired crop variants (they must stay key-
+    compatible: recorded augmentation sequences depend on it)."""
+    oy = jax.random.randint(key, (), 0, 2 * pad + 1)
+    ox = jax.random.randint(jax.random.fold_in(key, 1), (), 0, 2 * pad + 1)
+    return oy, ox
+
+
 def random_crop(rng, images, pad: int = 4):
     """Pad-and-crop (the CIFAR recipe): edge-pad ``pad`` pixels then
     take a per-sample random HxW crop back to the original size —
@@ -36,9 +45,7 @@ def random_crop(rng, images, pad: int = 4):
     keys = jax.random.split(rng, b)
 
     def crop_one(key, img):
-        oy = jax.random.randint(key, (), 0, 2 * pad + 1)
-        ox = jax.random.randint(jax.random.fold_in(key, 1), (), 0,
-                                2 * pad + 1)
+        oy, ox = _crop_offsets(key, pad)
         return jax.lax.dynamic_slice(img, (oy, ox, 0), (h, w, c))
 
     return jax.vmap(crop_one)(keys, padded)
@@ -93,6 +100,54 @@ def random_cutout(rng, images, size: int = 16, fill: int = 0):
     return jax.vmap(one)(keys, images)
 
 
+def random_flip_with_points(rng, images, points, axis: int = 2):
+    """Per-sample flip of ``images`` WITH the matching mirror of pixel-
+    space ``points`` (B, P, 2) in (x, y) order — the paired form for
+    tasks supervising spatial labels (flipping only the image would
+    train on corrupted supervision). ``axis=2`` flips width (mirrors
+    x); ``axis=1`` flips height (mirrors y). Returns
+    ``(images, points)``."""
+    points = jnp.asarray(points)  # eager numpy callers: .at needs jnp
+    b = images.shape[0]
+    size = images.shape[axis]
+    coord = 0 if axis == 2 else 1
+    # Same bit-draw scheme as image.random_flip (keep key-compatible:
+    # the paired and unpaired variants must flip the same samples for
+    # the same key).
+    bits = jax.random.bernoulli(rng, 0.5, (b,))
+    flipped = jnp.flip(images, axis=axis)
+    ishape = (b,) + (1,) * (images.ndim - 1)
+    out_imgs = jnp.where(bits.reshape(ishape), flipped, images)
+    mirrored = points.at[..., coord].set(
+        (size - 1) - points[..., coord]
+    )
+    out_pts = jnp.where(bits.reshape((b, 1, 1)), mirrored, points)
+    return out_imgs, out_pts
+
+
+def random_crop_with_points(rng, images, points, pad: int = 4):
+    """Paired pad-and-crop: shifts ``points`` (B, P, 2) in (x, y) pixel
+    coords by the same per-sample offset the crop applies. Points can
+    land outside [0, W)x[0, H) when the crop pushes them off-frame —
+    callers that care should mask on the returned coordinates. Returns
+    ``(images, points)``."""
+    b, h, w, c = images.shape
+    padded = jnp.pad(
+        images, ((0, 0), (pad, pad), (pad, pad), (0, 0)), mode="edge"
+    )
+    keys = jax.random.split(rng, b)
+
+    def one(key, img, pts):
+        oy, ox = _crop_offsets(key, pad)
+        img = jax.lax.dynamic_slice(img, (oy, ox, 0), (h, w, c))
+        pts = pts + jnp.stack(
+            [pad - ox, pad - oy]
+        ).astype(pts.dtype)
+        return img, pts
+
+    return jax.vmap(one)(keys, padded, jnp.asarray(points))
+
+
 def make_augment(*ops):
     """Compose augmentation ops into one ``fn(rng, images)``; each op
     draws from an independent fold of the key.
@@ -116,5 +171,7 @@ __all__ = [
     "random_crop",
     "color_jitter",
     "random_cutout",
+    "random_flip_with_points",
+    "random_crop_with_points",
     "make_augment",
 ]

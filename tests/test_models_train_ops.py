@@ -367,3 +367,45 @@ def test_supervised_step_with_on_device_augmentation():
     s0c, stepc = make(123)
     _, mC = stepc(s0c, batch)
     assert np.isfinite(float(mC["loss"]))
+
+
+def test_paired_geometric_augmentation_keeps_labels_synced():
+    """random_flip_with_points / random_crop_with_points transform image
+    and pixel-space labels together: a marker pixel's new location
+    equals the transformed point, exactly."""
+    from blendjax.ops.augment import (
+        random_crop_with_points,
+        random_flip_with_points,
+    )
+
+    b, h, w = 8, 16, 24
+    imgs = np.zeros((b, h, w, 3), np.uint8)
+    pts = np.empty((b, 1, 2), np.float32)  # (x, y)
+    rng = np.random.default_rng(3)
+    for i in range(b):
+        y, x = int(rng.integers(0, h)), int(rng.integers(0, w))
+        imgs[i, y, x] = 255
+        pts[i, 0] = (x, y)
+
+    key = jax.random.key(11)
+    fi, fp = jax.jit(random_flip_with_points)(key, imgs, pts)
+    fi, fp = np.asarray(fi), np.asarray(fp)
+    flipped_any = False
+    for i in range(b):
+        ys, xs, _ = np.nonzero(fi[i])
+        assert (xs[0], ys[0]) == (int(fp[i, 0, 0]), int(fp[i, 0, 1]))
+        flipped_any |= (fi[i] != imgs[i]).any()
+    assert flipped_any
+
+    ci, cp = jax.jit(random_crop_with_points)(key, imgs, pts)
+    ci, cp = np.asarray(ci), np.asarray(cp)
+    assert ci.shape == imgs.shape
+    moved_any = False
+    for i in range(b):
+        x2, y2 = cp[i, 0]
+        if 0 <= x2 < w and 0 <= y2 < h:
+            # marker may be duplicated by edge padding; the labeled
+            # location must hold the marker value
+            assert (ci[i, int(y2), int(x2)] == 255).all()
+        moved_any |= (cp[i] != pts[i]).any()
+    assert moved_any

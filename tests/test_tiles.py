@@ -520,6 +520,10 @@ def test_record_then_replay_tile_stream_bit_exact(tmp_path):
         with StreamDataPipeline(
             launcher.addresses["DATA"], batch_size=8, timeoutms=30_000,
             max_items=2, record_path_prefix=prefix,
+            # a dead producer then raises with its exit code instead of
+            # an opaque 30s timeout (this test flaked under heavy
+            # machine load; make the failure mode diagnosable)
+            launcher=launcher,
         ) as pipe:
             live = list(pipe)
     assert len(live) == 2
