@@ -123,19 +123,20 @@ def load_tile_delta_palidx():
             if lib is None:
                 _CACHE["tiledelta_palidx"] = None
             else:
-                u8p = ctypes.POINTER(ctypes.c_uint8)
                 fn = lib.bjx_tile_delta_palidx
                 fn.restype = ctypes.c_int64
+                # void* buffer args: callers pass cached raw addresses
+                # (ints) instead of re-marshalling POINTER objects per
+                # frame — this is the producer's per-frame hot call.
                 fn.argtypes = [
-                    u8p, u8p,
+                    ctypes.c_void_p, ctypes.c_void_p,
                     ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
                     ctypes.c_int64,
                     ctypes.c_int64, ctypes.c_int64,
                     ctypes.c_int64, ctypes.c_int64,
-                    ctypes.POINTER(ctypes.c_int32), u8p,
-                    ctypes.POINTER(ctypes.c_uint32),
-                    ctypes.POINTER(ctypes.c_int16), u8p,
-                    ctypes.POINTER(ctypes.c_int64),
+                    ctypes.c_void_p, ctypes.c_void_p,
+                    ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+                    ctypes.c_void_p,
                     ctypes.c_int64,
                 ]
                 _CACHE["tiledelta_palidx"] = fn

@@ -21,8 +21,10 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-# One source of truth: the flip op predates this module (image.py).
-from blendjax.ops.image import random_flip
+# One source of truth: the flip op predates this module (image.py);
+# _flip_bits is the shared per-sample decision draw that keeps the
+# paired variant below key-compatible with it.
+from blendjax.ops.image import _flip_bits, random_flip
 
 
 def _crop_offsets(key, pad: int):
@@ -111,10 +113,7 @@ def random_flip_with_points(rng, images, points, axis: int = 2):
     b = images.shape[0]
     size = images.shape[axis]
     coord = 0 if axis == 2 else 1
-    # Same bit-draw scheme as image.random_flip (keep key-compatible:
-    # the paired and unpaired variants must flip the same samples for
-    # the same key).
-    bits = jax.random.bernoulli(rng, 0.5, (b,))
+    bits = _flip_bits(rng, b)  # shared draw: key-compatible with random_flip
     flipped = jnp.flip(images, axis=axis)
     ishape = (b,) + (1,) * (images.ndim - 1)
     out_imgs = jnp.where(bits.reshape(ishape), flipped, images)

@@ -41,10 +41,18 @@ def maybe_normalize_uint8(x, dtype=jnp.bfloat16):
     return x.astype(dtype)
 
 
+def _flip_bits(rng, b: int):
+    """Per-sample flip decisions — the ONE bit-draw scheme shared by the
+    paired (`augment.random_flip_with_points`) and unpaired flips; they
+    must stay key-compatible (recorded augmentation sequences depend on
+    flipping the same samples for the same key)."""
+    return jax.random.bernoulli(rng, 0.5, (b,))
+
+
 def random_flip(rng, x, axis: int = 2):
     """Batched random horizontal flip (augmentation; per-sample bit)."""
     b = x.shape[0]
-    bits = jax.random.bernoulli(rng, 0.5, (b,))
+    bits = _flip_bits(rng, b)
     flipped = jnp.flip(x, axis=axis)
     shape = (b,) + (1,) * (x.ndim - 1)
     return jnp.where(bits.reshape(shape), flipped, x)

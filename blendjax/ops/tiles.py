@@ -100,6 +100,25 @@ class TileDeltaEncoder:
                 f"{self.ref.shape}/uint8"
             )
 
+    def __getstate__(self):
+        """Copy/pickle safety: drop the native handles (ctypes functions
+        don't pickle) and the palette state — its cached raw buffer
+        addresses would alias the ORIGINAL encoder's buffers in a
+        deepcopy, or point at garbage in a spawned process. Both rebuild
+        lazily."""
+        state = dict(self.__dict__)
+        state["_native"] = None
+        state["_native_palidx"] = None
+        state["_pal_state"] = None
+        state.pop("_palidx_stage", None)
+        return state
+
+    def __setstate__(self, state):
+        self.__dict__.update(state)
+        from blendjax._native import load_tile_delta
+
+        self._native = load_tile_delta()
+
     def tile_bounds(self, hint):
         """Pixel-rect ``hint`` -> tile-grid scan bounds
         ``(ty0, ty1, tx0, tx1)`` (full grid for ``hint=None``)."""
@@ -193,15 +212,13 @@ class TileDeltaEncoder:
         colors — the caller falls back to :meth:`encode` (the table
         state stays valid). Call :meth:`reset_palette` per batch.
         """
-        import ctypes
-
         if not self.palidx_available():
             return None
         self._check_frame(img)
         img = np.ascontiguousarray(img)
         h, w, c = self.ref.shape
         if self._pal_state is None:
-            self._pal_state = {
+            s = {
                 "keys": np.zeros(1024, np.uint32),
                 "vals": np.full(1024, -1, np.int16),
                 "table": np.zeros((256, c), np.uint8),
@@ -210,18 +227,27 @@ class TileDeltaEncoder:
             self._palidx_stage = np.empty(
                 (self.num_tiles, self.tile * self.tile), np.uint8
             )
+            # Pointers to the persistent buffers are cached as plain
+            # ints (the native argtypes are void*): re-marshalling 8
+            # ctypes pointer objects per frame costs ~0.05ms — real
+            # money in a ~1ms/frame producer loop.
+            s["ptrs"] = (
+                self.ref.ctypes.data,
+                self._idx.ctypes.data,
+                self._palidx_stage.ctypes.data,
+                s["keys"].ctypes.data,
+                s["vals"].ctypes.data,
+                s["table"].ctypes.data,
+                s["count"].ctypes.data,
+            )
+            self._pal_state = s
         ty0, ty1, tx0, tx1 = self.tile_bounds(hint)
-        s = self._pal_state
-        u8 = ctypes.POINTER(ctypes.c_uint8)
+        (p_ref, p_idx, p_stage, p_keys, p_vals, p_table, p_count
+         ) = self._pal_state["ptrs"]
         k = self._native_palidx(
-            img.ctypes.data_as(u8), self.ref.ctypes.data_as(u8),
+            img.ctypes.data, p_ref,
             h, w, c, self.tile, ty0, ty1, tx0, tx1,
-            self._idx.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
-            self._palidx_stage.ctypes.data_as(u8),
-            s["keys"].ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),
-            s["vals"].ctypes.data_as(ctypes.POINTER(ctypes.c_int16)),
-            s["table"].ctypes.data_as(u8),
-            s["count"].ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            p_idx, p_stage, p_keys, p_vals, p_table, p_count,
             256,
         )
         if k < 0:

@@ -45,6 +45,20 @@ _CUBE_FACES = np.array(
 )
 
 
+# Each face quad (a, b, c, d) splits into triangles (a, b, c), (a, c, d);
+# precomputed as one (12, 3) vertex-index table so cube_triangles is a
+# single fancy-index instead of a Python loop building nested lists
+# (this runs per frame in the producer hot loop).
+_CUBE_TRI_IDX = np.array(
+    [
+        idx
+        for quad in _CUBE_FACES
+        for idx in ([quad[0], quad[1], quad[2]], [quad[0], quad[2], quad[3]])
+    ]
+)
+_CUBE_TRI_FACE = np.repeat(np.arange(len(_CUBE_FACES)), 2)
+
+
 def cube_triangles(center, half_extent: float, rotation=None):
     """World-space triangles (12,3,3) + face index per triangle (12,)."""
     from blendjax.producer.utils import cube_vertices
@@ -53,13 +67,7 @@ def cube_triangles(center, half_extent: float, rotation=None):
     if rotation is not None:
         verts = verts @ np.asarray(rotation, np.float64).T
     verts = verts + np.asarray(center, np.float64)
-    tris, faces = [], []
-    for f, quad in enumerate(_CUBE_FACES):
-        a, b, c, d = verts[quad]
-        tris.append([a, b, c])
-        tris.append([a, c, d])
-        faces.extend([f, f])
-    return np.array(tris), np.array(faces)
+    return verts[_CUBE_TRI_IDX], _CUBE_TRI_FACE.copy()
 
 
 def rotation_xyz(rx: float, ry: float, rz: float) -> np.ndarray:
