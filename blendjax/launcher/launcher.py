@@ -207,13 +207,21 @@ class ProcessLauncher:
             # filter mutation happens off the main thread.
             return subprocess.Popen(argv, start_new_session=True, env=env)
         with warnings.catch_warnings():
-            # CPython warns on fork-with-threads when preexec_fn is set
-            # (jax keeps background threads). This preexec calls ONE
-            # pre-resolved libc symbol — no malloc, no imports, no locks
-            # — the fork-safe subset the warning exists to protect;
-            # suppress it for this call only (main thread only: preexec
-            # is None on any other thread, handled above).
+            # Two fork-with-threads warnings fire on this spawn (CPython
+            # DeprecationWarning for preexec_fn; jax's register_at_fork
+            # RuntimeWarning "JAX is multithreaded ... deadlock"). Both
+            # guard against running nontrivial code between fork and
+            # exec — this child execs immediately and the preexec calls
+            # ONE pre-resolved libc symbol (no malloc, no imports, no
+            # locks), the fork-safe subset. Suppressed for this call
+            # only, and only on the main thread (other threads take the
+            # no-preexec branch above and never mutate global filters).
             warnings.simplefilter("ignore", DeprecationWarning)
+            warnings.filterwarnings(
+                # matched from the start of the message
+                "ignore", message=r"os\.fork\(\) was called",
+                category=RuntimeWarning,
+            )
             return subprocess.Popen(
                 argv, start_new_session=True, preexec_fn=preexec, env=env
             )

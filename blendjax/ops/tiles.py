@@ -110,7 +110,11 @@ class TileDeltaEncoder:
         state["_native"] = None
         state["_native_palidx"] = None
         state["_pal_state"] = None
+        # staging buffers are uninitialized scratch (MBs for large
+        # streams) — drop them too; shapes re-derive from ref/tile
         state.pop("_palidx_stage", None)
+        state.pop("_idx", None)
+        state.pop("_tiles", None)
         return state
 
     def __setstate__(self, state):
@@ -118,6 +122,11 @@ class TileDeltaEncoder:
         from blendjax._native import load_tile_delta
 
         self._native = load_tile_delta()
+        c = self.ref.shape[2]
+        self._idx = np.empty((self.num_tiles,), np.int32)
+        self._tiles = np.empty(
+            (self.num_tiles, self.tile, self.tile, c), np.uint8
+        )
 
     def tile_bounds(self, hint):
         """Pixel-rect ``hint`` -> tile-grid scan bounds
