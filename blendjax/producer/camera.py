@@ -113,13 +113,37 @@ class Camera:
 
     # -- projections --------------------------------------------------------
 
+    def _matrices(self):
+        """(view, proj) with transparent caching: parameters are plain
+        mutable attributes, so the cache keys on their VALUES (a dozen
+        doubles — the key build is ~1us where the property rebuilds cost
+        ~50us and run twice per rendered frame)."""
+        key = (
+            # coerce: users may assign plain sequences to these attrs
+            np.asarray(self.position, np.float64).tobytes(),
+            np.asarray(self.rotation, np.float64).tobytes(),
+            self.shape,
+            self.focal_mm, self.sensor_mm, self.ortho_scale,
+            self.clip_near, self.clip_far,
+        )
+        if getattr(self, "_mat_key", None) != key:
+            view = self.view_matrix
+            proj = self.proj_matrix
+            # key assigned LAST: an exception above must not poison the
+            # cache with a key whose matrices were never stored
+            self._view_cached = view
+            self._proj_cached = proj
+            self._mat_key = key
+        return self._view_cached, self._proj_cached
+
     def world_to_ndc(self, xyz_world) -> tuple[np.ndarray, np.ndarray]:
         """Project world points to NDC; also return linear depth (positive
         distance along the view direction; reference ``camera.py:84-112``)."""
         xyz_world = np.atleast_2d(np.asarray(xyz_world, np.float64))
-        cam = hom(xyz_world) @ self.view_matrix.T
+        view, proj = self._matrices()
+        cam = hom(xyz_world) @ view.T
         depth = -cam[:, 2]
-        ndc = dehom(cam @ self.proj_matrix.T)
+        ndc = dehom(cam @ proj.T)
         return ndc, depth
 
     def ndc_to_pixel(self, ndc, origin: str = "upper-left") -> np.ndarray:

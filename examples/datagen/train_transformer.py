@@ -68,13 +68,15 @@ def main():
         # tests/conftest.py)
         jax.config.update("jax_platforms", "cpu")
 
-    import jax.numpy as jnp
-
     from blendjax.data import StreamDataPipeline
     from blendjax.launcher import PythonProducerLauncher
     from blendjax.models import StreamFormer
     from blendjax.parallel import batch_sharding, create_mesh
-    from blendjax.train import make_supervised_step, make_train_state
+    from blendjax.train import (
+        corner_loss,
+        make_supervised_step,
+        make_train_state,
+    )
 
     axes = parse_mesh(args.mesh)
     mesh = create_mesh(axes)
@@ -92,9 +94,7 @@ def main():
 
     def loss_fn(state, params, b):
         pred = state.apply_fn({"params": params}, b["image"])
-        pred = pred.reshape(-1, 8, 2)
-        scale = jnp.asarray([w, h], jnp.float32)
-        return jnp.mean((pred / scale - b["xy"] / scale) ** 2)
+        return corner_loss(pred.reshape(-1, 8, 2), b["xy"], image_shape=(h, w))
 
     step = make_supervised_step(
         mesh=mesh, batch_sharding=sharding, loss_fn=loss_fn
