@@ -62,11 +62,17 @@ def test_stream_pipeline_end_to_end_with_producers():
             sharding=sharding,
             timeoutms=20000,
         ) as pipe:
+            import time as _time
+
             it = iter(pipe)
             seen_btids = set()
-            # Producers start at different times on a loaded host; keep
-            # pulling (bounded) until fan-in from both instances is seen.
-            for i in range(24):
+            # Producers start at different times on a loaded host (a
+            # fast first producer can feed MANY batches before the
+            # second finishes importing), so the fan-in wait is TIME
+            # bounded, not batch-count bounded.
+            deadline = _time.time() + 30
+            i = 0
+            while _time.time() < deadline:
                 batch = next(it)
                 assert batch["image"].shape == (8, 32, 32, 4)
                 assert batch["image"].sharding == sharding
@@ -74,6 +80,7 @@ def test_stream_pipeline_end_to_end_with_producers():
                 seen_btids |= {m.get("btid") for m in batch["_meta"]}
                 if i >= 3 and seen_btids == {0, 1}:
                     break
+                i += 1
             assert pipe.queue_depth() >= 0
     assert seen_btids == {0, 1}
 
