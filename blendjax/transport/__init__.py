@@ -20,6 +20,7 @@ from blendjax.transport.channels import (
     RpcClient,
     RpcServer,
     ReceiveTimeoutError,
+    term_context,
 )
 
 __all__ = [
@@ -34,4 +35,5 @@ __all__ = [
     "RpcClient",
     "RpcServer",
     "ReceiveTimeoutError",
+    "term_context",
 ]

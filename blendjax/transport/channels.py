@@ -49,6 +49,29 @@ def zmq_context() -> zmq.Context:
         return _context
 
 
+def term_context() -> None:
+    """Terminate the process-wide context, BLOCKING until every closed
+    socket's pending messages are flushed or its LINGER expires.
+
+    This is the only operation that actually guarantees delivery of a
+    finite stream's tail: ``socket.close()`` returns immediately and
+    leaves flushing to the IO thread, which dies with the interpreter —
+    a producer that publishes its last message and exits loses it
+    sporadically unless something waits, and pyzmq deliberately skips
+    context termination during interpreter shutdown. Call it at the END
+    of a producer process, after closing all sockets (a fresh context
+    is created transparently if sockets are opened afterwards).
+    """
+    global _context
+    with _context_lock:
+        ctx = _context
+        _context = None
+    if ctx is not None and _context_pid == os.getpid():
+        # (a context inherited across fork is never terminated here —
+        # its IO thread did not survive the fork)
+        ctx.term()
+
+
 def _as_frames(raw) -> list:
     return raw if isinstance(raw, list) else [raw]
 
@@ -80,7 +103,14 @@ class _Channel:
         )
 
     def close(self):
-        self.sock.close(0)
+        # No linger override: close() keeps queued messages alive for
+        # the IO thread to flush, bounded by the socket's configured
+        # LINGER (``close(0)`` here silently DISCARDED them). Note the
+        # flush is only GUARANTEED if the process lives long enough —
+        # finite-stream producers must call
+        # :func:`blendjax.transport.term_context` before exiting, which
+        # blocks until the flush completes or LINGER expires.
+        self.sock.close()
 
     def __enter__(self):
         return self

@@ -17,6 +17,7 @@ import sys
 
 import numpy as np
 
+from blendjax.transport import term_context
 from blendjax.producer import BaseEnv, RemoteControlledAgent, parse_launch_args
 from blendjax.producer.sim import CartpoleScene, SimEngine
 
@@ -64,6 +65,7 @@ def main() -> None:
         env.run(SimEngine(scene))
     finally:
         agent.close()
+        term_context()
 
 
 if __name__ == "__main__":

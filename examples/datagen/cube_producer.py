@@ -27,6 +27,7 @@ import sys
 
 import numpy as np
 
+from blendjax.transport import term_context
 from blendjax.producer import AnimationController, DataPublisher, parse_launch_args
 from blendjax.producer.sim import CubeScene, SimEngine
 
@@ -73,7 +74,7 @@ def main() -> None:
             parser.error("--encoding tile requires --batch > 1")
         h, w = opts.shape
         pub = DataPublisher(
-            args.btsockets["DATA"], btid=args.btid, lingerms=2000, send_hwm=2
+            args.btsockets["DATA"], btid=args.btid, lingerms=10000, send_hwm=2
         )
         tiles = TileBatchPublisher(
             pub, scene.background_image(), opts.batch, tile=opts.tile,
@@ -109,7 +110,7 @@ def main() -> None:
         # batch 8); pool size HWM+2 = queued + in flight + being rendered.
         send_hwm = 2
         pub = DataPublisher(
-            args.btsockets["DATA"], btid=args.btid, lingerms=2000,
+            args.btsockets["DATA"], btid=args.btid, lingerms=10000,
             send_hwm=send_hwm,
         )
         b, (h, w) = opts.batch, opts.shape
@@ -149,7 +150,7 @@ def main() -> None:
                 pub.publish(_batched=True, **{k: v[:i] for k, v in buf.items()})
 
     else:
-        pub = DataPublisher(args.btsockets["DATA"], btid=args.btid, lingerms=2000)
+        pub = DataPublisher(args.btsockets["DATA"], btid=args.btid, lingerms=10000)
 
         def publish(frame: int) -> None:
             pub.publish(**scene.observation(frame))
@@ -164,6 +165,7 @@ def main() -> None:
             flush()
     finally:
         pub.close()
+        term_context()  # block until the tail is flushed (bounded by linger)
 
 
 if __name__ == "__main__":

@@ -11,6 +11,7 @@ from __future__ import annotations
 import argparse
 import sys
 
+from blendjax.transport import term_context
 from blendjax.producer import AnimationController, DataPublisher, parse_launch_args
 from blendjax.producer.sim import FallingCubesScene, SimEngine
 
@@ -25,7 +26,7 @@ def main() -> None:
     parser.add_argument("--batch", type=int, default=8)
     opts = parser.parse_args(remainder)
 
-    pub = DataPublisher(args.btsockets["DATA"], btid=args.btid, lingerms=2000)
+    pub = DataPublisher(args.btsockets["DATA"], btid=args.btid, lingerms=10000)
     scene = FallingCubesScene(
         shape=tuple(opts.shape), seed=args.btseed, num_cubes=opts.num_cubes
     )
@@ -51,6 +52,7 @@ def main() -> None:
         ctrl.play(frame_range=(1, opts.episode_frames), num_episodes=-1)
     finally:
         pub.close()
+        term_context()  # block until the tail is flushed (bounded by linger)
 
 
 if __name__ == "__main__":

@@ -16,6 +16,7 @@ from collections import deque
 
 import numpy as np
 
+from blendjax.transport import term_context
 from blendjax.producer import (
     AnimationController,
     DataPublisher,
@@ -27,7 +28,7 @@ from blendjax.producer.sim import SimEngine, SupershapeScene
 
 def main() -> None:
     args, _ = parse_launch_args(sys.argv)
-    pub = DataPublisher(args.btsockets["DATA"], btid=args.btid, lingerms=2000)
+    pub = DataPublisher(args.btsockets["DATA"], btid=args.btid, lingerms=10000)
     ctrl = DuplexChannel(args.btsockets["CTRL"], btid=args.btid)
     scene = SupershapeScene(seed=args.btseed)
     pending: deque = deque()
@@ -66,6 +67,7 @@ def main() -> None:
     finally:
         pub.close()
         ctrl.close()
+        term_context()  # block until the tail is flushed (bounded by linger)
 
 
 if __name__ == "__main__":

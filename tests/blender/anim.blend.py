@@ -9,6 +9,7 @@ post_animation] x 2 -> post_play).
 
 import sys
 
+from blendjax.transport import term_context
 from blendjax.producer import AnimationController, DataPublisher, parse_launch_args
 from blendjax.producer.bpy_engine import BpyEngine
 
@@ -32,6 +33,7 @@ def main():
     ctrl.post_play.add(post_play)
     ctrl.play(frame_range=(1, 3), num_episodes=2)
     pub.close()
+    term_context()  # flush the tail before Blender exits
 
 
 main()

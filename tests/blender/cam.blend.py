@@ -17,6 +17,7 @@ import sys
 
 import bpy
 
+from blendjax.transport import term_context
 from blendjax.producer import DataPublisher, parse_launch_args
 from blendjax.producer.bpy_engine import (
     camera_from_bpy,
@@ -77,6 +78,7 @@ def main():
         ortho_pose=[list(r) for r in ortho.matrix_world],
     )
     pub.close()
+    term_context()  # flush the tail before Blender exits
 
 
 main()

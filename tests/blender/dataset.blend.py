@@ -10,6 +10,7 @@ import sys
 
 import numpy as np
 
+from blendjax.transport import term_context
 from blendjax.producer import AnimationController, DataPublisher, parse_launch_args
 from blendjax.producer.bpy_engine import BpyEngine
 
@@ -29,6 +30,7 @@ def main():
     # 4 episodes x frames 1..4 = 16 messages, then exit.
     ctrl.play(frame_range=(1, 4), num_episodes=4)
     pub.close()
+    term_context()  # flush the tail before Blender exits
 
 
 main()

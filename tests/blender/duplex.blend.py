@@ -7,6 +7,7 @@ pairing: ``tests/test_duplex.py:9-47`` with
 
 import sys
 
+from blendjax.transport import term_context
 from blendjax.producer import DuplexChannel, parse_launch_args
 
 
@@ -19,6 +20,7 @@ def main():
     duplex.send(echo=msg)
     duplex.send(msg="end")
     duplex.close()
+    term_context()  # flush the tail before Blender exits
 
 
 main()

@@ -8,6 +8,7 @@ the torch side can assert btid/seed/socket plumbing).
 
 import sys
 
+from blendjax.transport import term_context
 from blendjax.producer import DataPublisher, parse_launch_args
 
 
@@ -24,6 +25,7 @@ def main():
         remainder=list(remainder),
     )
     pub.close()
+    term_context()  # flush the tail before Blender exits
 
 
 main()

@@ -9,7 +9,7 @@ import sys
 import time
 
 from blendjax.launcher import parse_launch_args
-from blendjax.transport import DataPublisherSocket
+from blendjax.transport import DataPublisherSocket, term_context
 
 
 def main():
@@ -25,6 +25,7 @@ def main():
     # Stay alive briefly so the consumer can connect and drain.
     time.sleep(10)
     pub.close()
+    term_context()  # guarantee the flush before exiting
 
 
 if __name__ == "__main__":

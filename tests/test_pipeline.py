@@ -3,6 +3,7 @@ ingest path (producers -> sockets -> batches -> sharded global arrays),
 i.e. the blendjax replacement for DataLoader+collate+.cuda()."""
 
 import os
+import time
 
 import numpy as np
 import pytest
@@ -62,17 +63,15 @@ def test_stream_pipeline_end_to_end_with_producers():
             sharding=sharding,
             timeoutms=20000,
         ) as pipe:
-            import time as _time
-
             it = iter(pipe)
             seen_btids = set()
             # Producers start at different times on a loaded host (a
             # fast first producer can feed MANY batches before the
             # second finishes importing), so the fan-in wait is TIME
             # bounded, not batch-count bounded.
-            deadline = _time.time() + 30
+            deadline = time.time() + 30
             i = 0
-            while _time.time() < deadline:
+            while time.time() < deadline:
                 batch = next(it)
                 assert batch["image"].shape == (8, 32, 32, 4)
                 assert batch["image"].sharding == sharding
