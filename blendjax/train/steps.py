@@ -80,10 +80,7 @@ def make_supervised_step(
       apply a paired transform in ``loss_fn`` instead.
     """
     del mesh, batch_sharding  # layouts ride on the arrays (see above)
-    if augment is not None:
-        base_rng = (
-            augment_rng if augment_rng is not None else jax.random.key(0)
-        )
+    base_rng = _resolve_augment_rng(augment, augment_rng)
     loss_fn = loss_fn or (
         lambda state, params, batch: corner_loss(
             state.apply_fn({"params": params}, batch["image"]),
@@ -160,9 +157,41 @@ def make_supervised_step(
     return jax.jit(step, donate_argnums=(0,) if donate else ())
 
 
+def _resolve_augment_rng(augment, augment_rng):
+    """ONE default-key rule for all three step builders: per-batch,
+    chunked, and fused runs must resolve the same base key or their
+    augmentation sequences silently diverge."""
+    if augment is None:
+        return None
+    return augment_rng if augment_rng is not None else jax.random.key(0)
+
+
+def _chunk_scan_body(loss_fn, augment, base_rng):
+    """Shared scan body for the chunked/fused steps: one optimizer
+    update per slice, with the optional augment keyed by ``st.step`` —
+    the SAME fold the per-batch step uses (``make_supervised_step``),
+    so K scanned updates replay the exact augmentation sequence K
+    sequential per-batch calls would."""
+
+    def body(st, batch):
+        if augment is not None:
+            rng = jax.random.fold_in(base_rng, st.step)
+            batch = {**batch, "image": augment(rng, batch["image"])}
+
+        def scalar_loss(params):
+            return loss_fn(st, params, batch)
+
+        loss, grads = jax.value_and_grad(scalar_loss)(st.params)
+        return st.apply_gradients(grads=grads), loss
+
+    return body
+
+
 def make_chunked_supervised_step(
     loss_fn=None,
     donate: bool = True,
+    augment=None,
+    augment_rng=None,
 ):
     """Build ``step(state, superbatch) -> (state, metrics)`` where
     ``superbatch`` fields carry a leading chunk axis: (K, B, ...).
@@ -174,6 +203,12 @@ def make_chunked_supervised_step(
     high-latency device links (see docs/performance.md). Pairs with
     ``StreamDataPipeline(chunk=K)``. ``metrics['loss']`` is the K-vector
     of per-update losses.
+
+    ``augment``/``augment_rng`` mirror :func:`make_supervised_step`:
+    the per-update key folds ``augment_rng`` with the state's step
+    counter INSIDE the scan, so a chunked run augments identically to
+    the same stream trained one batch at a time (and to a
+    checkpoint-resumed run).
     """
     loss_fn = loss_fn or (
         lambda state, params, batch: corner_loss(
@@ -182,16 +217,12 @@ def make_chunked_supervised_step(
             image_shape=batch["image"].shape[1:3],
         )
     )
+    base_rng = _resolve_augment_rng(augment, augment_rng)
 
     def step(state, superbatch):
-        def body(st, batch):
-            def scalar_loss(params):
-                return loss_fn(st, params, batch)
-
-            loss, grads = jax.value_and_grad(scalar_loss)(st.params)
-            return st.apply_gradients(grads=grads), loss
-
-        state, losses = jax.lax.scan(body, state, superbatch)
+        state, losses = jax.lax.scan(
+            _chunk_scan_body(loss_fn, augment, base_rng), state, superbatch
+        )
         return state, {"loss": losses}
 
     return jax.jit(step, donate_argnums=(0,) if donate else ())
@@ -200,6 +231,8 @@ def make_chunked_supervised_step(
 def make_fused_tile_step(
     loss_fn=None,
     donate: bool = True,
+    augment=None,
+    augment_rng=None,
 ):
     """Build ``step(state, packed_batch) -> (state, metrics)`` where
     ``packed_batch`` is what ``StreamDataPipeline(emit_packed=True)``
@@ -224,21 +257,19 @@ def make_fused_tile_step(
             image_shape=batch["image"].shape[1:3],
         )
     )
-    chunked = make_chunked_supervised_step(loss_fn=loss_fn, donate=donate)
+    chunked = make_chunked_supervised_step(
+        loss_fn=loss_fn, donate=donate,
+        augment=augment, augment_rng=augment_rng,
+    )
+    base_rng = _resolve_augment_rng(augment, augment_rng)
 
     def _fused(state, packed, refs, spec, names, geoms):
         from blendjax.ops.tiles import decode_packed_superbatch
 
         superbatch = decode_packed_superbatch(packed, refs, spec, names, geoms)
-
-        def body(st, batch):
-            def scalar_loss(params):
-                return loss_fn(st, params, batch)
-
-            loss, grads = jax.value_and_grad(scalar_loss)(st.params)
-            return st.apply_gradients(grads=grads), loss
-
-        state, losses = jax.lax.scan(body, state, superbatch)
+        state, losses = jax.lax.scan(
+            _chunk_scan_body(loss_fn, augment, base_rng), state, superbatch
+        )
         return state, {"loss": losses}
 
     fused = jax.jit(

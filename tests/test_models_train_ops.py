@@ -369,6 +369,61 @@ def test_supervised_step_with_on_device_augmentation():
     assert np.isfinite(float(mC["loss"]))
 
 
+def test_chunked_step_with_augment_matches_sequential():
+    """make_chunked_supervised_step(augment=...) folds the in-scan step
+    counter, so one scanned superbatch trains identically to K
+    sequential per-batch augmented steps (same keys, same trajectory)."""
+    import optax
+
+    from blendjax.models import CubeRegressor
+    from blendjax.ops.augment import make_augment, random_flip
+    from blendjax.parallel import batch_sharding, create_mesh
+    from blendjax.train import (
+        make_chunked_supervised_step,
+        make_supervised_step,
+        make_train_state,
+    )
+
+    mesh = create_mesh({"data": -1})
+    sh = batch_sharding(mesh)
+    rng = np.random.default_rng(7)
+    K, B = 3, 4
+    images = rng.integers(0, 255, (K, B, 32, 32, 4), np.uint8)
+    xys = (rng.random((K, B, 8, 2)) * 32).astype(np.float32)
+    aug = make_augment(random_flip)
+    key = jax.random.key(42)
+    s0 = make_train_state(
+        CubeRegressor(features=(8,)), images[0], mesh=mesh,
+        optimizer=optax.sgd(0.01),
+    )
+
+    seq = make_supervised_step(
+        mesh=mesh, batch_sharding=sh, donate=False,
+        augment=aug, augment_rng=key,
+    )
+    s_seq, seq_losses = s0, []
+    for k in range(K):
+        s_seq, m = seq(s_seq, {"image": images[k], "xy": xys[k]})
+        seq_losses.append(float(m["loss"]))
+
+    chunked = make_chunked_supervised_step(
+        donate=False, augment=aug, augment_rng=key
+    )
+    s_chk, mc = chunked(s0, {"image": images, "xy": xys})
+
+    np.testing.assert_allclose(np.asarray(mc["loss"]), seq_losses, rtol=1e-5)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-7
+        ),
+        s_seq.params, s_chk.params,
+    )
+    # sanity: the augment actually changed the trajectory vs no-augment
+    plain = make_chunked_supervised_step(donate=False)
+    _, mp = plain(s0, {"image": images, "xy": xys})
+    assert not np.allclose(np.asarray(mp["loss"]), np.asarray(mc["loss"]))
+
+
 def test_paired_geometric_augmentation_keeps_labels_synced():
     """random_flip_with_points / random_crop_with_points transform image
     and pixel-space labels together: a marker pixel's new location
