@@ -18,8 +18,20 @@
 
 #include <cmath>
 #include <cstdint>
+#include <cstring>
 #include <algorithm>
 #include <limits>
+
+// The color buffer contract is BYTE-ordered RGBA. A uint32 store writes
+// its bytes in native order, so the packed fill pattern must be built by
+// memcpy from the byte quad — identical bytes land on either endianness
+// (and on little-endian this compiles to the same single 32-bit load a
+// shift-or would).
+static inline uint32_t rgba_pattern(const uint8_t* rgba) {
+  uint32_t pat;
+  std::memcpy(&pat, rgba, 4);
+  return pat;
+}
 
 extern "C" {
 
@@ -29,8 +41,7 @@ extern "C" {
 void bjx_clear(uint8_t* color, float* zbuf, int64_t h, int64_t w,
                const uint8_t* rgba) {
   const int64_t n = h * w;
-  const uint32_t pat = (uint32_t)rgba[0] | ((uint32_t)rgba[1] << 8) |
-                       ((uint32_t)rgba[2] << 16) | ((uint32_t)rgba[3] << 24);
+  const uint32_t pat = rgba_pattern(rgba);
   uint32_t* c32 = reinterpret_cast<uint32_t*>(color);
   std::fill(c32, c32 + n, pat);
   const float inf = std::numeric_limits<float>::infinity();
@@ -47,8 +58,7 @@ void bjx_clear_rect(uint8_t* color, float* zbuf, int64_t h, int64_t w,
   y0 = std::max<int64_t>(y0, 0); y1 = std::min<int64_t>(y1, h);
   x0 = std::max<int64_t>(x0, 0); x1 = std::min<int64_t>(x1, w);
   if (y0 >= y1 || x0 >= x1) return;
-  const uint32_t pat = (uint32_t)rgba[0] | ((uint32_t)rgba[1] << 8) |
-                       ((uint32_t)rgba[2] << 16) | ((uint32_t)rgba[3] << 24);
+  const uint32_t pat = rgba_pattern(rgba);
   const float inf = std::numeric_limits<float>::infinity();
   const int64_t span = x1 - x0;
   for (int64_t y = y0; y < y1; ++y) {
@@ -88,9 +98,6 @@ void bjx_fill_triangles(const double* px, const double* depth,
     ymin = std::max<int64_t>(ymin, 0); ymax = std::min<int64_t>(ymax, h);
     if (xmin >= xmax || ymin >= ymax) continue;
 
-    const uint8_t r = rgba[t * 4 + 0], g = rgba[t * 4 + 1],
-                  b = rgba[t * 4 + 2], a = rgba[t * 4 + 3];
-
     // Edge functions at the first pixel center, plus per-x / per-y steps
     // (each w_i is affine in gx, gy). Instead of testing every bbox
     // pixel (~half fail the half-plane tests for a typical face), each
@@ -109,8 +116,7 @@ void bjx_fill_triangles(const double* px, const double* depth,
     const double w2dx = -(w0dx + w1dx);
     const double zdx = w0dx * z0 + w1dx * z1 + w2dx * z2;
 
-    const uint32_t cpat = (uint32_t)r | ((uint32_t)g << 8) |
-                          ((uint32_t)b << 16) | ((uint32_t)a << 24);
+    const uint32_t cpat = rgba_pattern(rgba + t * 4);
     const int64_t span = xmax - xmin;
     for (int64_t y = ymin; y < ymax; ++y) {
       const double dy = (double)(y - ymin);

@@ -355,6 +355,10 @@ class TileStreamDecoder:
                             self.chunk,
                         )
                     yield from self._flush_group(group)
+                    # Surfaced in the bench/metrics report: a fleet whose
+                    # chunk groups silently degrade to K'=1 loses ~10x
+                    # throughput, and one log line is easy to miss.
+                    metrics.count("tiles.degraded_groups")
                     self._plans.append(("raw1",))
                     yield hb
                     continue

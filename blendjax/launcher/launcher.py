@@ -32,17 +32,30 @@ from blendjax.utils.logging import get_logger
 
 logger = get_logger("launcher")
 
-# Resolved at import time (see ProcessLauncher._spawn.preexec: the
-# post-fork child may not dlopen/import).
-if sys.platform == "linux":
-    try:
-        import ctypes as _ctypes
-
-        _PRCTL = _ctypes.CDLL(None).prctl
-    except Exception:  # pragma: no cover
-        _PRCTL = None
-else:  # pragma: no cover - non-Linux: context-manager teardown only
-    _PRCTL = None
+# PDEATHSIG orphan-proofing is Linux-only (prctl(2)). It is applied via
+# an exec-shim — a fresh single-threaded python that sets the flag on
+# ITSELF then execs the producer in place (same PID) — never via
+# preexec_fn: a Python-level hook between fork and exec is documented
+# fork-unsafe in threaded parents (jax/zmq threads are typically live)
+# and disables subprocess's posix_spawn fast path.
+# Interpreter startup is tens of ms — a launcher killed in that window
+# died BEFORE the prctl armed. Re-checking the parent after arming
+# closes the race: either the launcher is still our parent (and its
+# death now signals us), or it already died (we were reparented) and we
+# exit instead of exec'ing an orphan. A failing prctl (non-glibc libc,
+# missing symbol) degrades to launching without orphan-proofing, same
+# as the non-Linux path (SystemExit passes through the except).
+_PDEATHSIG_SHIM = """\
+import os, sys
+try:
+    import ctypes
+    ctypes.CDLL(None).prctl(1, 15)  # PR_SET_PDEATHSIG, SIGTERM
+    if os.getppid() != int(sys.argv[1]):
+        sys.exit(143)
+except Exception:
+    pass
+os.execvp(sys.argv[2], sys.argv[2:])
+"""
 
 
 def _free_port(host: str) -> int:
@@ -181,50 +194,27 @@ class ProcessLauncher:
         # Orphan-proofing (Linux): if the launcher dies without its
         # __exit__ running (SIGKILL, `timeout`), the kernel delivers
         # SIGTERM to the producer — otherwise a leaked producer loops
-        # forever and starves shared-core hosts. _PRCTL was resolved at
-        # import time: the post-fork child must not dlopen/malloc
-        # (deadlocks if another parent thread held those locks). PDEATHSIG
-        # fires on the death of the spawning THREAD (prctl(2)), so it is
-        # set only for main-thread spawns — a producer respawned from a
-        # pipeline's ingest thread must not die with that thread; it
-        # falls back to context-manager teardown. setsid stays C-level
-        # via start_new_session (preexec_fn otherwise disables the
-        # posix_spawn fast path and is fork-unsafe on macOS).
+        # forever and starves shared-core hosts. The _PDEATHSIG_SHIM
+        # exec's the real argv in place, so Popen's pid IS the
+        # producer's and poll/terminate semantics are unchanged; the
+        # microsecond pre-prctl window is the only coverage lost vs a
+        # preexec hook, traded for a fork that runs no Python at all.
+        # PDEATHSIG fires on the death of the spawning THREAD
+        # (prctl(2)), so the shim wraps only main-thread spawns — a
+        # producer respawned from a pipeline's ingest thread must not
+        # die with that thread; it falls back to context-manager
+        # teardown. setsid stays C-level via start_new_session.
         import threading
-        import warnings
 
-        preexec = None
         if (
-            _PRCTL is not None
+            sys.platform == "linux"
             and threading.current_thread() is threading.main_thread()
         ):
-            def preexec():
-                _PRCTL(1, 15)  # PR_SET_PDEATHSIG, SIGTERM
-
-        if preexec is None:
-            # no preexec (non-main-thread respawn, non-Linux): no
-            # fork-with-threads warning fires, and no global warning-
-            # filter mutation happens off the main thread.
-            return subprocess.Popen(argv, start_new_session=True, env=env)
-        with warnings.catch_warnings():
-            # Two fork-with-threads warnings fire on this spawn (CPython
-            # DeprecationWarning for preexec_fn; jax's register_at_fork
-            # RuntimeWarning "JAX is multithreaded ... deadlock"). Both
-            # guard against running nontrivial code between fork and
-            # exec — this child execs immediately and the preexec calls
-            # ONE pre-resolved libc symbol (no malloc, no imports, no
-            # locks), the fork-safe subset. Suppressed for this call
-            # only, and only on the main thread (other threads take the
-            # no-preexec branch above and never mutate global filters).
-            warnings.simplefilter("ignore", DeprecationWarning)
-            warnings.filterwarnings(
-                # matched from the start of the message
-                "ignore", message=r"os\.fork\(\) was called",
-                category=RuntimeWarning,
-            )
-            return subprocess.Popen(
-                argv, start_new_session=True, preexec_fn=preexec, env=env
-            )
+            argv = [
+                sys.executable, "-c", _PDEATHSIG_SHIM,
+                str(os.getpid()), *map(str, argv),
+            ]
+        return subprocess.Popen(argv, start_new_session=True, env=env)
 
     @property
     def addresses(self) -> dict:

@@ -1242,6 +1242,9 @@ def test_chunk_mode_degrades_on_mixed_stream(caplog):
         yield tile_msg(frames[2:4], False)  # group member after flush
         yield tile_msg(frames[6:8], False)
 
+    from blendjax.utils.metrics import metrics
+
+    degraded0 = metrics.counters.get("tiles.degraded_groups", 0)
     with caplog.at_level(logging.WARNING, logger="blendjax.data"):
         pipe = StreamDataPipeline(messages(), batch_size=2, chunk=2)
         got = list(pipe)
@@ -1258,6 +1261,10 @@ def test_chunk_mode_degrades_on_mixed_stream(caplog):
     np.testing.assert_array_equal(np.asarray(got[2]["image"])[1, 1], frames[7])
     warns = [r for r in caplog.records if "non-tile message" in r.message]
     assert len(warns) == 1
+    # the degradation is countable, not just logged (fleet visibility)
+    assert (
+        metrics.counters.get("tiles.degraded_groups", 0) - degraded0 == 1
+    )
 
 
 def test_prebatched_size_mismatch_warns_once(caplog):
