@@ -210,8 +210,22 @@ class ProcessLauncher:
             sys.platform == "linux"
             and threading.current_thread() is threading.main_thread()
         ):
+            import shutil
+
+            # The shim's Popen always succeeds (it execs python), which
+            # would swallow the FileNotFoundError a bad producer command
+            # raises on the direct path — keep that contract by checking
+            # the real target up front.
+            exe = str(argv[0])
+            if shutil.which(exe) is None:
+                raise FileNotFoundError(
+                    f"producer command not found or not executable: {exe!r}"
+                )
+            # -S -E: the shim imports only os/sys/ctypes, and skipping
+            # site/user-site startup shrinks the pre-prctl orphan window
+            # (the env dict still reaches the exec'd producer untouched).
             argv = [
-                sys.executable, "-c", _PDEATHSIG_SHIM,
+                sys.executable, "-S", "-E", "-c", _PDEATHSIG_SHIM,
                 str(os.getpid()), *map(str, argv),
             ]
         return subprocess.Popen(argv, start_new_session=True, env=env)

@@ -1,0 +1,29 @@
+"""Hermetic test doubles for the Blender-facing surface.
+
+The reference's entire test suite needs a real Blender install
+(``/root/reference/.travis.yml:15-24``, ``scripts/install_blender.sh``);
+blendjax additionally ships a faithful in-process stand-in so the
+``bpy``/``gpu``-dependent half of the producer package — and any user's
+``*.blend.py`` producer script — executes in plain CPython:
+
+- :func:`install_fake_bpy` registers stub ``bpy``/``gpu`` modules
+  (``fake_bpy``/``fake_gpu``) implementing exactly the API surface
+  blendjax's Blender integration uses: scene/object/camera graph,
+  evaluated-depsgraph queries, frame-change + draw handlers, offscreen
+  render readback, AABB ray casts.
+- ``python -m blendjax.testing.fake_blender`` emulates the Blender CLI
+  (``--version``, ``--background``, ``--python``, ``--python-expr``) on
+  top of those stubs, and :func:`write_fake_blender` drops a ``blender``
+  wrapper onto a directory so ``discover_blender`` and the production
+  :class:`~blendjax.launcher.launcher.BlenderLauncher` drive it through
+  the exact real-Blender code path.
+
+The real-Blender tier (``pytest -m blender``) remains the ground truth;
+this tier is what keeps those code paths executed in every CI run.
+"""
+
+from blendjax.testing.fake_blender import write_fake_blender
+from blendjax.testing.fake_bpy import install as install_fake_bpy
+from blendjax.testing.fake_bpy import reset as reset_fake_bpy
+
+__all__ = ["install_fake_bpy", "reset_fake_bpy", "write_fake_blender"]

@@ -1,0 +1,247 @@
+"""Hermetic execution of the Blender-facing producer surface (VERDICT r2
+item 2): ``bpy_engine.py`` and ``offscreen.py`` run in-process against
+the fake ``bpy``/``gpu`` runtime (``blendjax.testing``). The opt-in
+real-Blender tier (``test_blender.py``) remains the convention ground
+truth; this tier keeps the code executed in every CI run."""
+
+import math
+
+import numpy as np
+import pytest
+
+from blendjax.testing import install_fake_bpy, reset_fake_bpy
+
+
+@pytest.fixture()
+def bpy():
+    mod = install_fake_bpy(background=False)
+    reset_fake_bpy()
+    return mod
+
+
+def _add_cube(bpy, size=2.0, location=(0, 0, 0), name=None):
+    bpy.ops.mesh.primitive_cube_add(size=size, location=location)
+    obj = bpy.context.active_object
+    if name:
+        obj.name = name
+    return obj
+
+
+def _add_camera(bpy, name="Cam", location=(0, 0, 10), rotation=(0, 0, 0),
+                **props):
+    data = bpy.data.cameras.new(name)
+    for k, v in props.items():
+        setattr(data, k, v)
+    obj = bpy.data.objects.new(name, data)
+    bpy.context.collection.objects.link(obj)
+    obj.location = location
+    obj.rotation_euler = rotation
+    return obj
+
+
+def test_world_and_bbox_coordinates(bpy):
+    """world_coordinates/bbox_world_coordinates resolve the evaluated
+    depsgraph path: local verts x matrix_world (reference
+    ``utils.py:30-109``)."""
+    from blendjax.producer.bpy_engine import (
+        bbox_world_coordinates,
+        world_coordinates,
+    )
+
+    cube = _add_cube(bpy, size=2.0, location=(0.5, -0.25, 0.75))
+    xyz = world_coordinates(cube)
+    assert xyz.shape == (8, 3)
+    lo, hi = xyz.min(0), xyz.max(0)
+    np.testing.assert_allclose(lo, [-0.5, -1.25, -0.25], atol=1e-12)
+    np.testing.assert_allclose(hi, [1.5, 0.75, 1.75], atol=1e-12)
+    # bbox corners are the same 8 points for an axis-aligned cube
+    bb = bbox_world_coordinates(cube)
+    assert bb.shape == (8, 3)
+    np.testing.assert_allclose(
+        np.sort(bb, axis=0), np.sort(xyz, axis=0), atol=1e-12
+    )
+    # rotation flows through matrix_world
+    cube.rotation_euler[2] = math.pi / 2
+    rot = world_coordinates(cube)
+    np.testing.assert_allclose(
+        np.sort(rot[:, 2]), np.sort(xyz[:, 2]), atol=1e-12
+    )
+    assert not np.allclose(rot[:, :2], xyz[:, :2])
+
+
+def test_scene_stats_and_collections(bpy):
+    from blendjax.producer.bpy_engine import scene_stats
+
+    base = scene_stats()
+    _add_cube(bpy)
+    _add_camera(bpy)
+    stats = scene_stats()
+    assert stats["num_objects"] == base["num_objects"] + 2
+    assert stats["num_meshes"] == base["num_meshes"] + 1
+    assert "Cube" in bpy.data.objects
+
+
+def test_visibility_montecarlo_with_occluder(bpy):
+    """compute_object_visibility: unobstructed -> 1.0; a blocker between
+    object and camera drops it to 0 (reference ``utils.py:158-179``)."""
+    from blendjax.producer.bpy_engine import compute_object_visibility
+
+    target = _add_cube(bpy, size=1.0, location=(0, 0, 0), name="Target")
+    cam = _add_camera(bpy, location=(0, 0, 10))
+    rng = np.random.default_rng(0)
+    vis = compute_object_visibility(target, cam, n_samples=16, rng=rng)
+    assert vis == pytest.approx(1.0)
+    # a cube between target and camera blocks every corner's ray (all
+    # rays converge toward the camera axis by z=5)
+    _add_cube(bpy, size=1.0, location=(0, 0, 5), name="Blocker")
+    vis = compute_object_visibility(target, cam, n_samples=16, rng=rng)
+    assert vis == pytest.approx(0.0)
+
+
+def test_camera_from_bpy_matches_analytic(bpy):
+    """camera_from_bpy pulls pose/intrinsics from bpy and projects like a
+    directly-constructed Camera (reference ``camera.py:8-82``)."""
+    from blendjax.producer.bpy_engine import camera_from_bpy
+    from blendjax.producer.camera import Camera
+
+    bpy.context.scene.render.resolution_x = 640
+    bpy.context.scene.render.resolution_y = 480
+    cam_obj = _add_camera(
+        bpy, location=(8.0, -8.0, 6.0),
+        rotation=(math.radians(60), 0.0, math.radians(45)),
+        lens=50.0, sensor_width=36.0, clip_start=0.1, clip_end=100.0,
+    )
+    cam = camera_from_bpy(Camera, cam_obj)
+    assert cam.shape == (480, 640)
+    pose = np.asarray(cam_obj.matrix_world)
+    direct = Camera(
+        position=pose[:3, 3], rotation=pose[:3, :3], shape=(480, 640),
+        focal_mm=50.0, sensor_mm=36.0, clip_near=0.1, clip_far=100.0,
+    )
+    pts = np.array([[0.5, -0.25, 0.75], [0, 0, 0], [1, 1, 1.0]])
+    np.testing.assert_allclose(
+        cam.world_to_pixel(pts), direct.world_to_pixel(pts), atol=1e-12
+    )
+    # resolution_percentage scales the derived shape (camera.py:57-66)
+    bpy.context.scene.render.resolution_percentage = 50
+    half = camera_from_bpy(Camera, cam_obj)
+    assert half.shape == (240, 320)
+    # ortho branch
+    cam_obj.data.type = "ORTHO"
+    cam_obj.data.ortho_scale = 12.0
+    bpy.context.scene.render.resolution_percentage = 100
+    ortho = camera_from_bpy(Camera, cam_obj)
+    assert ortho.ortho_scale == pytest.approx(12.0)
+
+
+def test_bpy_engine_reset_syncs_point_cache(bpy):
+    """BpyEngine.reset rewinds to frame_start and keeps rigid-body point
+    caches in range (reference ``animation.py:108-134``)."""
+    from types import SimpleNamespace
+
+    from blendjax.producer.bpy_engine import BpyEngine
+
+    scene = bpy.context.scene
+    scene.frame_start, scene.frame_end = 3, 9
+    scene.rigidbody_world = SimpleNamespace(
+        point_cache=SimpleNamespace(frame_start=1, frame_end=250)
+    )
+    eng = BpyEngine()
+    eng.frame_set(7)
+    assert scene.frame_current == 7
+    eng.reset()
+    assert scene.frame_current == 3
+    assert scene.rigidbody_world.point_cache.frame_start == 3
+    assert scene.rigidbody_world.point_cache.frame_end == 9
+
+
+def test_find_first_view3d_background_raises():
+    from blendjax.producer.bpy_engine import find_first_view3d
+
+    install_fake_bpy(background=False)
+    reset_fake_bpy(background=True)  # --background: no windows
+    with pytest.raises(RuntimeError, match="VIEW_3D"):
+        find_first_view3d()
+    reset_fake_bpy(background=False)
+    assert find_first_view3d().type == "VIEW_3D"
+
+
+def test_animation_driver_ui_lifecycle(bpy):
+    """BpyAnimationDriver replays the controller lifecycle from Blender's
+    own clock (frame_change_pre + POST_PIXEL draw handler, reference
+    ``animation.py:136-151``): two 3-frame episodes, then cancel."""
+    from blendjax.producer import AnimationController
+    from blendjax.producer.bpy_engine import BpyAnimationDriver, BpyEngine
+
+    ctrl = AnimationController(BpyEngine())
+    driver = BpyAnimationDriver(ctrl)
+    seq = []
+    ctrl.pre_play.add(lambda: seq.append("pre_play"))
+    ctrl.pre_animation.add(lambda: seq.append("pre_animation"))
+    ctrl.pre_frame.add(lambda f: seq.append(f"pre:{f}"))
+    ctrl.post_frame.add(lambda f: seq.append(f"post:{f}"))
+
+    def on_episode_end():
+        seq.append("post_animation")
+        if ctrl.episode >= 1:  # episode increments after this signal
+            driver.cancel()
+
+    ctrl.post_animation.add(on_episode_end)
+    ctrl.post_play.add(lambda: seq.append("post_play"))
+    driver.play(frame_range=(1, 3))  # synchronous under the fake clock
+
+    frames = [s for f in (1, 2, 3) for s in (f"pre:{f}", f"post:{f}")]
+    assert seq == (
+        ["pre_play", "pre_animation"]
+        + frames + ["post_animation"]
+        + frames + ["post_animation", "post_play"]
+    )
+    assert ctrl.episode == 2
+    # handlers were unhooked by cancel
+    assert not bpy.app.handlers.frame_change_pre
+
+
+def test_offscreen_renderer_reads_back_and_flips(bpy):
+    """OffScreenRenderer: GPUOffScreen draw + texture readback lands cube
+    splats where the analytic Camera projects them, and 'upper-left'
+    origin is the vertical flip of GL's lower-left scanlines (reference
+    ``offscreen.py:68-99``)."""
+    from blendjax.producer.bpy_engine import camera_from_bpy
+    from blendjax.producer.camera import Camera
+    from blendjax.producer.offscreen import OffScreenRenderer
+    from blendjax.testing.fake_gpu import BACKGROUND
+
+    render = bpy.context.scene.render
+    render.resolution_x, render.resolution_y = 160, 120
+    cube = _add_cube(bpy, size=2.0, location=(0, 0, 0))
+    cam_obj = _add_camera(
+        bpy, location=(0, -8, 0), rotation=(math.pi / 2, 0, 0),
+        lens=35.0, clip_start=0.1, clip_end=100.0,
+    )
+    bpy.context.scene.camera = cam_obj
+
+    r = OffScreenRenderer(mode="rgba", origin="upper-left")
+    img = r.render()
+    assert img.shape == (120, 160, 4) and img.dtype == np.uint8
+    splats = np.argwhere((img != np.array(BACKGROUND)).any(-1))
+    assert 1 <= len(splats) <= 8  # 8 cube corners, some may overlap
+
+    # cross-check against the analytic camera (upper-left pixel origin)
+    from blendjax.producer.bpy_engine import world_coordinates
+
+    cam = camera_from_bpy(Camera, cam_obj)
+    expected = cam.world_to_pixel(world_coordinates(cube))
+    exp_yx = np.stack([expected[:, 1], expected[:, 0]], -1)
+    for y, x in splats:
+        d = np.linalg.norm(exp_yx - np.array([y, x]), axis=1)
+        assert d.min() < 2.0, f"splat ({y},{x}) far from projections"
+
+    r_ll = OffScreenRenderer(mode="rgba", origin="lower-left")
+    np.testing.assert_array_equal(np.flipud(r_ll.render()), img)
+
+    # rgb mode drops alpha
+    r_rgb = OffScreenRenderer(mode="rgb")
+    assert r_rgb.render().shape == (120, 160, 3)
+    r_rgb.set_render_style(shading="RENDERED", overlays=False)
+    assert r_rgb.space.shading.type == "RENDERED"
+    assert r_rgb.space.overlay.show_overlays is False
