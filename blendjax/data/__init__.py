@@ -15,7 +15,14 @@ attach at the same boundaries the reference used (the raw-bytes tee sits
 between receive and decode, ``dataset.py:100-103``).
 """
 
-from blendjax.data.replay import FileDataset, FileReader, FileRecorder, ReplayStream, SingleFileDataset
+from blendjax.data.replay import (
+    FileDataset,
+    FileReader,
+    FileRecorder,
+    LegacyBtrReader,
+    ReplayStream,
+    SingleFileDataset,
+)
 from blendjax.data.schema import StreamSchema
 from blendjax.data.stream import RemoteStream
 from blendjax.data.batcher import BatchAssembler, HostIngest
@@ -35,6 +42,7 @@ __all__ = [
     "TileStreamDecoder",
     "FileRecorder",
     "FileReader",
+    "LegacyBtrReader",
     "FileDataset",
     "SingleFileDataset",
     "ReplayStream",

@@ -176,6 +176,116 @@ class FileReader:
             self._file = None
 
 
+class LegacyBtrReader:
+    """Read the reference blendtorch's ``.btr`` recordings so a migrating
+    user's existing data replays through the TPU pipeline.
+
+    Format (reference ``file.py:56-132``): ONE pickle stream per file —
+    a pre-allocated int64 offset header (rewritten on close, ``-1`` marks
+    unused slots) followed by the pickled message dicts, all written by a
+    single ``Pickler``. That single pickler MEMOIZES across documents
+    (repeated dict keys etc. become memo refs into earlier messages), so
+    a fresh unpickler seeking straight to message ``k`` can hit
+    ``Memo value not found``; the reference only ever reads forward
+    through one ``Unpickler``. This reader keeps that unpickler but
+    makes random access safe by warming the memo sequentially up to the
+    highest index requested.
+
+    Pickle-gated: the format IS pickle, so constructing with
+    ``allow_pickle=False`` raises — recordings from untrusted sources
+    should be re-recorded to ``.bjr`` (tensor codec, pickle-free).
+    """
+
+    def __init__(self, path: str, allow_pickle: bool = True):
+        if not allow_pickle:
+            raise ValueError(
+                f"{path}: legacy .btr recordings are pickle streams; "
+                "pass allow_pickle=True (trusted source) or convert to "
+                ".bjr"
+            )
+        self.path = path
+        self._file = None
+        self._pid = None
+        f, unpickler = self._open()
+        try:
+            self._offsets = self._header(unpickler)
+        finally:
+            f.close()
+
+    @staticmethod
+    def _header(unpickler):
+        import numpy as np
+
+        offsets = np.asarray(unpickler.load())
+        unused = np.flatnonzero(offsets == -1)
+        n = int(unused[0]) if len(unused) else len(offsets)
+        return [int(o) for o in offsets[:n]]
+
+    def _open(self):
+        import io
+        import pickle
+
+        # buffering=0 is load-bearing (and what the reference uses,
+        # ``file.py:104``): the C unpickler's read-ahead over a BUFFERED
+        # file ignores seeks between load() calls and silently decodes
+        # the wrong message.
+        f = io.open(self.path, "rb", buffering=0)
+        return f, pickle.Unpickler(f)
+
+    def _handle(self):
+        if self._file is None or self._pid != os.getpid():
+            # Reopen per process (torch-worker compat, reference
+            # ``file.py:102-108``); the header load primes the memo the
+            # same way the writer's single pickler built it.
+            self._file, self._unpickler = self._open()
+            self._header(self._unpickler)
+            self._pid = os.getpid()
+            self._warm = 0
+        return self._file
+
+    def __len__(self) -> int:
+        return len(self._offsets)
+
+    def _load_at(self, idx: int):
+        f = self._handle()
+        f.seek(self._offsets[idx])
+        return self._unpickler.load()
+
+    def __getitem__(self, idx: int) -> dict:
+        if idx < 0:
+            idx += len(self)
+        if not 0 <= idx < len(self):
+            raise IndexError(idx)
+        self._handle()
+        if idx >= self._warm:
+            # Populate memo entries messages [warm, idx) contributed —
+            # required before any later message's memo refs resolve.
+            for j in range(self._warm, idx):
+                self._load_at(j)
+            self._warm = idx + 1
+        return self._load_at(idx)
+
+    def close(self):
+        if self._file is not None:
+            self._file.close()
+            self._file = None
+
+
+def open_reader(path: str, allow_pickle: bool = True):
+    """Reader for one recording: ``.bjr`` (blendjax wire container) or a
+    reference ``.btr`` (legacy pickle, see :class:`LegacyBtrReader`)."""
+    if str(path).endswith(".btr"):
+        return LegacyBtrReader(path, allow_pickle=allow_pickle)
+    return FileReader(path, allow_pickle=allow_pickle)
+
+
+def _glob_recordings(prefix: str) -> list[str]:
+    """Per-worker recordings for a prefix, either container format."""
+    return sorted(
+        globmod.glob(f"{prefix}_*.bjr") + globmod.glob(f"{prefix}_*.btr")
+    )
+
+
 class ReplayStream:
     """Iterate recorded messages as a live-stream stand-in.
 
@@ -186,8 +296,10 @@ class ReplayStream:
     traffic — a recorded sparse stream replays to bit-exact device
     frames with no producers running.
 
-    ``source`` may be one ``.bjr`` path, a list of paths, or a recording
-    prefix (globs ``{prefix}_*.bjr`` like :class:`FileDataset`).
+    ``source`` may be one recording path (``.bjr``, or a reference
+    ``.btr`` — legacy pickle recordings replay through the same
+    pipeline), a list of paths, or a recording prefix (globs
+    ``{prefix}_*.bjr`` + ``{prefix}_*.btr`` like :class:`FileDataset`).
     """
 
     def __init__(self, source, allow_pickle: bool = True, loop: bool = False):
@@ -195,14 +307,16 @@ class ReplayStream:
             if os.path.exists(source):
                 paths = [source]
             else:
-                paths = sorted(globmod.glob(f"{source}_*.bjr"))
+                paths = _glob_recordings(source)
                 if not paths:
                     raise FileNotFoundError(
-                        f"no recording at {source} or {source}_*.bjr"
+                        f"no recording at {source} or {source}_*.bjr/.btr"
                     )
         else:
             paths = list(source)
-        self.readers = [FileReader(p, allow_pickle=allow_pickle) for p in paths]
+        self.readers = [
+            open_reader(p, allow_pickle=allow_pickle) for p in paths
+        ]
         self.loop = loop
 
     def __iter__(self):
@@ -222,7 +336,7 @@ class SingleFileDataset:
     """Map-style dataset over one recording (reference ``dataset.py:119-132``)."""
 
     def __init__(self, path: str, item_transform=None, allow_pickle: bool = True):
-        self.reader = FileReader(path, allow_pickle=allow_pickle)
+        self.reader = open_reader(path, allow_pickle=allow_pickle)
         self.item_transform = item_transform or (lambda x: x)
 
     def __len__(self):
@@ -239,12 +353,14 @@ class FileDataset:
 
     def __init__(self, record_path_prefix: str, item_transform=None,
                  allow_pickle: bool = True):
-        paths = sorted(globmod.glob(f"{record_path_prefix}_*.bjr"))
+        paths = _glob_recordings(record_path_prefix)
         if not paths:
             raise FileNotFoundError(
-                f"no recordings matching {record_path_prefix}_*.bjr"
+                f"no recordings matching {record_path_prefix}_*.bjr/.btr"
             )
-        self.readers = [FileReader(p, allow_pickle=allow_pickle) for p in paths]
+        self.readers = [
+            open_reader(p, allow_pickle=allow_pickle) for p in paths
+        ]
         self._cum = []
         total = 0
         for r in self.readers:

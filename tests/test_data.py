@@ -99,6 +99,78 @@ def test_recover_truncated_recording(tmp_path):
     assert 1 <= len(offsets) <= 4
 
 
+def _write_reference_btr(path, messages, capacity=16):
+    """Write a recording in the reference blendtorch's EXACT ``.btr``
+    format (``pkg_pytorch/blendtorch/btt/file.py:56-79``): ONE pickler
+    (protocol 3, shared memo across documents) dumps a pre-allocated
+    int64 offset header then each message; the header is rewritten with
+    real offsets on close, -1 marking unused slots."""
+    import pickle
+
+    with open(path, "wb") as f:
+        pickler = pickle.Pickler(f, protocol=3)
+        offsets = np.full(capacity, -1, dtype=np.int64)
+        pickler.dump(offsets)
+        for i, msg in enumerate(messages):
+            offsets[i] = f.tell()
+            pickler.dump(msg)
+        f.seek(0)
+        pickle.Pickler(f, protocol=3).dump(offsets)
+
+
+def test_legacy_btr_reader_roundtrip(tmp_path):
+    """A reference-format .btr replays message-exactly, including RANDOM
+    access (the single-pickler format embeds cross-message memo refs —
+    repeated dict keys — that a naive seek-and-unpickle breaks on)."""
+    from blendjax.data.replay import LegacyBtrReader
+
+    path = str(tmp_path / "legacy_00.btr")
+    msgs = [_item(i) for i in range(6)]
+    _write_reference_btr(path, msgs)
+
+    r = LegacyBtrReader(path)
+    assert len(r) == 6
+    for i in (4, 0, 5, 2, 2, 1):  # out-of-order on purpose
+        got = r[i]
+        assert got["frameid"] == i
+        np.testing.assert_array_equal(got["image"], msgs[i]["image"])
+        np.testing.assert_array_equal(got["xy"], msgs[i]["xy"])
+    r.close()
+    # pickle gate: the format IS pickle, refuse allow_pickle=False
+    with pytest.raises(ValueError, match="pickle"):
+        LegacyBtrReader(path, allow_pickle=False)
+
+
+def test_legacy_btr_through_pipeline_and_datasets(tmp_path):
+    """Reference recordings replay through StreamDataPipeline (VERDICT r2
+    item 5) and glob side-by-side with .bjr in FileDataset."""
+    from blendjax.data import StreamDataPipeline
+
+    prefix = str(tmp_path / "mixed")
+    _write_reference_btr(
+        f"{prefix}_00.btr", [_item(i) for i in range(4)]
+    )
+    with FileRecorder(f"{prefix}_01.bjr") as rec:
+        for i in range(2):
+            rec.save(encode_message(_item(10 + i)))
+
+    with StreamDataPipeline.from_recording(
+        f"{prefix}_00.btr", batch_size=2
+    ) as pipe:
+        batches = list(pipe)
+    assert len(batches) == 2
+    got = np.concatenate([np.asarray(b["frameid"]) for b in batches])
+    np.testing.assert_array_equal(np.sort(got), np.arange(4))
+    np.testing.assert_array_equal(
+        np.asarray(batches[0]["image"][0]),
+        _item(int(np.asarray(batches[0]["frameid"])[0]))["image"],
+    )
+
+    ds = FileDataset(prefix)  # globs *.bjr AND *.btr
+    assert len(ds) == 6
+    assert SingleFileDataset(f"{prefix}_00.btr")[3]["frameid"] == 3
+
+
 def test_file_dataset_glob_concat(tmp_path):
     prefix = str(tmp_path / "run")
     n_per = [3, 2]
