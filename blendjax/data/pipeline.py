@@ -91,6 +91,12 @@ class DeviceFeeder:
             if k == "_meta":
                 out[k] = v
                 continue
+            if isinstance(v, jax.Array):
+                # Already a placed (possibly multi-process global) array
+                # — an upstream stage assembled it with the layout it
+                # needs; re-placing could force a reshard.
+                out[k] = v
+                continue
             if k == "__packed__":
                 # Reserved key: a whole batch flattened to one uint8
                 # buffer (TileStreamDecoder). It must never take the
@@ -220,13 +226,15 @@ class TileStreamDecoder:
         self._warned_mixed = False
         self._refs: dict = {}       # (name, btid) -> device ref_tiles
         self._host_refs: dict = {}  # (name, btid) -> host copy (dedup)
-        self._ref_digest: dict = {}  # (name, btid) -> bytes digest
+        self._ref_digest: dict = {}  # (name, btid) -> stable content hash
         self._shapes: dict = {}  # name -> (h, w, c, tile)
         self._skipped: set = set()  # warned-once missing-ref keys
+        self._mh_checked: dict = {}  # field -> fleet-verified digest
         self._plans: collections.deque = collections.deque()
         self._decode = None
         self._decode_chunk = None
         self._decode_mh = None
+        self._decode_mh_chunk = None
 
     def reset(self) -> None:
         """Drop queued per-batch decode plans (call when re-iterating a
@@ -244,6 +252,35 @@ class TileStreamDecoder:
 
             return NamedSharding(s.mesh, PartitionSpec())
         return None
+
+    def _field_sharding(self, key):
+        """Configured batch sharding for one field (dict- or single-)."""
+        return (
+            self.sharding.get(key)
+            if isinstance(self.sharding, dict)
+            else self.sharding
+        )
+
+    def _pin_superbatch(self, fields: dict) -> None:
+        """Move decoded (K, B, ...) superbatch fields to the configured
+        batch sharding with the chunk axis replicated, in place (async
+        reshard; no-op on one device). ONE copy of this logic — the
+        chunk and mhchunk branches must never diverge on output
+        layout."""
+        jax = _require_jax()
+        for k, v in fields.items():
+            s = self._field_sharding(k)
+            spec = getattr(s, "spec", None)
+            if (
+                s is not None
+                and spec is not None
+                and getattr(v, "ndim", 0) >= len(spec) + 1
+            ):
+                from jax.sharding import NamedSharding, PartitionSpec
+
+                fields[k] = jax.device_put(
+                    v, NamedSharding(s.mesh, PartitionSpec(None, *spec))
+                )
 
     def _decode_mesh(self):
         """(mesh, data_axis) for the sharded Pallas decode — taken from
@@ -265,6 +302,7 @@ class TileStreamDecoder:
 
         jax = _require_jax()
         group: dict = {}
+        mh_group: dict = {}  # multihost chunk>1 buffering (lockstep flush)
         for hb in host_batches:
             btid = hb.get("btid")
             new_refs: dict = {}
@@ -280,7 +318,16 @@ class TileStreamDecoder:
                 if cached is not None and np.array_equal(cached, ref):
                     continue
                 self._host_refs[key] = np.asarray(ref).copy()
-                self._ref_digest[key] = hash(self._host_refs[key].tobytes())
+                # Stable digest (NOT Python hash(): per-process salted),
+                # so chunk-group keys and the multihost fleet check
+                # compare identically across processes.
+                import hashlib
+
+                self._ref_digest[key] = int.from_bytes(
+                    hashlib.blake2b(
+                        self._host_refs[key].tobytes(), digest_size=8
+                    ).digest(), "little",
+                )
                 tile = int(
                     hb.get(key[0] + T.TILESHAPE_SUFFIX, [0, 0, 0, T.TILE])[3]
                 )
@@ -322,13 +369,9 @@ class TileStreamDecoder:
                 continue  # drop the whole batch, keep plans aligned
             if names and self.multihost:
                 if self.chunk > 1:
-                    # Chunk groups would need lockstep flush boundaries
-                    # across processes; run multihost tiles with chunk=1.
-                    raise NotImplementedError(
-                        "chunk>1 is not supported with multihost tile "
-                        "streams yet — use chunk=1 (per-batch decode)"
-                    )
-                yield from self._host_stage_multihost(hb, names, btid)
+                    yield from self._mh_group_add(mh_group, hb, names, btid)
+                else:
+                    yield from self._host_stage_multihost(hb, names, btid)
                 continue
             if not names:
                 if self.chunk > 1 or self.emit_packed:
@@ -355,6 +398,7 @@ class TileStreamDecoder:
                             self.chunk,
                         )
                     yield from self._flush_group(group)
+                    yield from self._flush_mh_group(mh_group)
                     # Surfaced in the bench/metrics report: a fleet whose
                     # chunk groups silently degrade to K'=1 loses ~10x
                     # throughput, and one log line is easy to miss.
@@ -421,24 +465,33 @@ class TileStreamDecoder:
             if len(group["bufs"]) == self.chunk:
                 yield from self._flush_group(group)
         yield from self._flush_group(group)
+        yield from self._flush_mh_group(mh_group)
 
-    def _host_stage_multihost(self, hb, names, btid):
-        """Tile batch -> per-field global assembly plan (multihost).
-
-        The packed single-buffer transfer cannot shard (bytes, not
-        batch), so each batch-leading tile field rides the feeder's
-        ``make_array_from_process_local_data`` path individually and the
-        DECODE runs on the assembled global batch — GSPMD partitions the
-        scatter shard-locally per device (or the shard_map Pallas kernel
-        takes over when eligible), which is exactly "decode
-        shard-locally, assemble globally".
+    def _mh_fields(self, hb, names, btid):
+        """Shared multihost prep: split ndarray fields from sidecars,
+        resolve the fleet-shared reference per field (with divergence
+        enforcement), and broadcast per-stream palettes per row.
 
         SPMD contract: every process must stream identical wire shapes
         (pin ``TileBatchPublisher(capacity=...)`` across the fleet) and
         fleet-shared reference content — the global batch decodes
-        against ONE replicated reference per field; a producer whose ref
-        digest differs from the one this process holds would reconstruct
-        wrong rows (warned once per field below).
+        against ONE replicated reference per field. Divergence is an
+        ERROR, not a warning: rows decoded against the wrong reference
+        are silent training-data corruption. Enforcement is two-level:
+
+        - cross-process: on the FIRST ref selection for a field, the
+          chosen digest is all-gathered over ``jax.distributed`` and any
+          mismatch raises on every process (catches per-host scene-
+          version skew at startup). Checked once per field. Liveness
+          caveat (inherent to SPMD collectives): if one process dies
+          BEFORE reaching a field's gather (e.g. a local divergence
+          raise on another field), peers block in the collective until
+          the distributed runtime's failure detection kicks in — the
+          run still fails, but via the coordinator timeout rather than
+          this error message.
+        - within-process: any producer whose ref digest differs from the
+          fleet-shared one raises immediately (replaces the old
+          warn-and-corrupt path; ADVICE r2 medium).
         """
         from blendjax.ops import tiles as T
 
@@ -455,18 +508,18 @@ class TileStreamDecoder:
             # order), so every process resolves the same content when
             # the fleet shares one scene background.
             first_key = next(k for k in self._refs if k[0] == name)
-            if (
-                self._ref_digest.get((name, btid))
-                != self._ref_digest.get(first_key)
-                and (name, "mh") not in self._skipped
-            ):
-                self._skipped.add((name, "mh"))
-                logger.warning(
-                    "multihost tile stream %r: producer %r sent a "
-                    "reference differing from the fleet's — its rows "
-                    "will decode against the shared reference (pin one "
-                    "scene background across the fleet)", name, btid,
+            shared = self._ref_digest.get(first_key)
+            mine = self._ref_digest.get((name, btid))
+            if mine != shared:
+                raise RuntimeError(
+                    f"multihost tile stream {name!r}: producer {btid!r} "
+                    "sent a reference image differing from the fleet-"
+                    "shared one — its rows would silently decode against "
+                    "the wrong reference. Pin one scene background "
+                    "across the fleet (same seed/scene), or run "
+                    "single-host pipelines per producer group."
                 )
+            self._assert_fleet_digest(name, shared)
             refs[name] = self._refs[first_key]
             pal_key = name + T.PALETTE_SUFFIX
             if pal_key in fields:
@@ -484,11 +537,115 @@ class TileStreamDecoder:
                 fields[pal_key] = np.ascontiguousarray(
                     np.broadcast_to(pal[None], (b, *pal.shape))
                 )
+        return fields, rest, refs
+
+    def _assert_fleet_digest(self, name, digest) -> None:
+        """One-time cross-process agreement check on a field's selected
+        reference digest (no-op single-process and on re-checks)."""
+        if name in self._mh_checked:
+            return
+        self._mh_checked[name] = digest
+        jax = _require_jax()
+        if jax.process_count() <= 1:
+            return
+        from jax.experimental import multihost_utils
+
+        everyone = np.asarray(
+            multihost_utils.process_allgather(
+                np.asarray(digest, dtype=np.uint64)
+            )
+        ).reshape(-1)
+        if not (everyone == everyone[0]).all():
+            raise RuntimeError(
+                f"multihost tile stream {name!r}: processes selected "
+                f"DIFFERENT fleet references (digests {set(everyone.tolist())}) "
+                "— the assembled global batch would decode some rows "
+                "against the wrong content. Pin one scene background "
+                "across all hosts."
+            )
+
+    def _host_stage_multihost(self, hb, names, btid):
+        """Tile batch -> per-field global assembly plan (multihost,
+        per-batch decode).
+
+        The packed single-buffer transfer cannot shard (bytes, not
+        batch), so each batch-leading tile field rides the feeder's
+        ``make_array_from_process_local_data`` path individually and the
+        DECODE runs on the assembled global batch — GSPMD partitions the
+        scatter shard-locally per device (or the shard_map Pallas kernel
+        takes over when eligible), which is exactly "decode
+        shard-locally, assemble globally".
+        """
+        fields, rest, refs = self._mh_fields(hb, names, btid)
         self._plans.append(
             ("mh", tuple(names), tuple(self._shapes[n] for n in names),
              rest, refs)
         )
         yield fields
+
+    def _mh_group_add(self, mh_group, hb, names, btid):
+        """Multihost chunk>1: buffer compatible tile batches and flush
+        count-based — the SPMD contract (identical wire shapes + shared
+        refs on every process, ``_mh_fields``) makes the flush boundary
+        deterministic across processes, so each process contributes the
+        same group shape to the global assembly (lockstep flush,
+        VERDICT r2 item 4)."""
+        fields, rest, refs = self._mh_fields(hb, names, btid)
+        gkey = (
+            tuple(names),
+            tuple(sorted(
+                (k, v.dtype.str, v.shape) for k, v in fields.items()
+            )),
+            tuple(self._ref_digest.get((n, btid)) for n in names),
+        )
+        if mh_group and mh_group["key"] != gkey:
+            yield from self._flush_mh_group(mh_group)
+        if not mh_group:
+            mh_group.update(
+                key=gkey, fields=[], rests=[], refs=refs,
+                names=tuple(names),
+                geoms=tuple(self._shapes[n] for n in names),
+            )
+        mh_group["fields"].append(fields)
+        mh_group["rests"].append(rest)
+        if len(mh_group["fields"]) == self.chunk:
+            yield from self._flush_mh_group(mh_group)
+
+    def _flush_mh_group(self, mh_group):
+        """Assemble a buffered multihost chunk group into ONE global
+        array per field — local (K', B_local, ...) stacks become global
+        (K', B_global, ...) arrays sharded ``P(None, data)`` via
+        ``make_array_from_process_local_data`` (one placement call per
+        field for the whole group), decoded in one call downstream."""
+        if not mh_group:
+            return
+        jax = _require_jax()
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        stacked = {
+            k: np.stack([f[k] for f in mh_group["fields"]])
+            for k in mh_group["fields"][0]
+        }
+        out = {}
+        for k, v in stacked.items():
+            s = self._field_sharding(k)
+            spec = getattr(s, "spec", None)
+            if s is None or spec is None:
+                # Unsharded multihost pipelines don't exist (the feeder
+                # needs a mesh to assemble), but keep a sane fallback.
+                out[k] = jax.device_put(v)
+                continue
+            if v.ndim >= len(spec) + 1:
+                gs = NamedSharding(s.mesh, PartitionSpec(None, *spec))
+            else:  # low-rank sidecar: replicate
+                gs = NamedSharding(s.mesh, PartitionSpec())
+            out[k] = jax.make_array_from_process_local_data(gs, v)
+        self._plans.append((
+            "mhchunk", mh_group["names"], mh_group["geoms"],
+            mh_group["rests"], mh_group["refs"],
+        ))
+        mh_group.clear()
+        yield out
 
     def _flush_group(self, group):
         """Emit a buffered chunk group (possibly shorter than ``chunk``)
@@ -555,6 +712,39 @@ class TileStreamDecoder:
             self._decode_mh = jax.jit(
                 _decode_fields, static_argnames=("names", "geoms")
             )
+        if self._decode_mh_chunk is None:
+            mesh, axis = self._decode_mesh()
+
+            def _decode_fields_chunk(fields, refs, names, geoms):
+                # fields are assembled global (K, B, ...) arrays; each
+                # name's payload decodes flattened over (K*B) in one
+                # scatter call (mirrors decode_packed_superbatch).
+                for name, geom in zip(names, geoms):
+                    idx = fields.pop(name + T.TILEIDX_SUFFIX)
+                    k, b = idx.shape[:2]
+
+                    def flat(v):
+                        return v.reshape((k * b,) + tuple(v.shape[2:]))
+
+                    for suf in (
+                        T.TILES_SUFFIX, T.TILEPAL4_SUFFIX,
+                        T.TILEPAL8_SUFFIX, T.PALETTE_SUFFIX,
+                    ):
+                        if name + suf in fields:
+                            fields[name + suf] = flat(fields[name + suf])
+                    tiles = T.pop_tile_payload(
+                        fields, name, geom, T.expand_palette_tiles
+                    )
+                    img = T.decode_tile_delta(
+                        refs[name], flat(idx), tiles, geom[:3],
+                        mesh=mesh, data_axis=axis,
+                    )
+                    fields[name] = img.reshape(k, b, *img.shape[1:])
+                return fields
+
+            self._decode_mh_chunk = jax.jit(
+                _decode_fields_chunk, static_argnames=("names", "geoms")
+            )
         for db in device_batches:
             plan = self._plans.popleft()
             if plan is not None and plan[0] == "mh":
@@ -567,6 +757,17 @@ class TileStreamDecoder:
                 fields.update(rest)
                 if meta is not None:
                     fields["_meta"] = meta
+                yield fields
+                continue
+            if plan is not None and plan[0] == "mhchunk":
+                _, names, geoms, rests, refs = plan
+                db.pop("_meta", None)
+                with metrics.span("decode.dispatch"):
+                    fields = self._decode_mh_chunk(
+                        db, refs, names=names, geoms=geoms
+                    )
+                self._pin_superbatch(fields)
+                fields["_meta"] = rests
                 yield fields
                 continue
             if plan is not None and plan[0] == "raw1":
@@ -598,32 +799,7 @@ class TileStreamDecoder:
                         names=tuple(names),
                         geoms=geoms,
                     )
-                # Superbatch fields are (K, B, ...): move them to the
-                # configured batch sharding with the chunk axis
-                # replicated (async reshard; no-op on one device).
-                for k, v in fields.items():
-                    s = (
-                        self.sharding.get(k)
-                        if isinstance(self.sharding, dict)
-                        else self.sharding
-                    )
-                    spec_ = getattr(s, "spec", None)
-                    if (
-                        s is not None
-                        and spec_ is not None
-                        and getattr(v, "ndim", 0) >= len(spec_) + 1
-                    ):
-                        from jax.sharding import (
-                            NamedSharding,
-                            PartitionSpec,
-                        )
-
-                        fields[k] = jax.device_put(
-                            v,
-                            NamedSharding(
-                                s.mesh, PartitionSpec(None, *spec_)
-                            ),
-                        )
+                self._pin_superbatch(fields)
                 db["_meta"] = rests
                 db.update(fields)
                 yield db
@@ -643,11 +819,7 @@ class TileStreamDecoder:
                 # configured shardings (async reshard; a no-op when the
                 # pipeline simplified the sharding away on one device).
                 for k, v in fields.items():
-                    s = (
-                        self.sharding.get(k)
-                        if isinstance(self.sharding, dict)
-                        else self.sharding
-                    )
+                    s = self._field_sharding(k)
                     if s is not None and getattr(v, "ndim", 0) >= len(
                         getattr(s, "spec", ()) or ()
                     ):

@@ -712,9 +712,14 @@ def test_multihost_tile_stream_assembles_and_decodes_globally():
             np.testing.assert_array_equal(img[i], frames[start + i])
 
 
-def test_multihost_tiles_chunked_still_rejected():
-    """chunk>1 x multihost needs lockstep flush boundaries across
-    processes; until then it stays a loud error (not a silent hang)."""
+def test_multihost_tiles_chunked_superbatch():
+    """chunk>1 x multihost (single-process SPMD stand-in on the virtual
+    8-device mesh): K compatible tile batches assemble into ONE global
+    (K, B, ...) superbatch, chunk axis replicated / batch axis sharded,
+    decoded bit-exactly in one call (VERDICT r2 item 4; the true
+    2-process case is tests/test_multiprocess.py)."""
+    from jax.sharding import PartitionSpec as P
+
     from blendjax.data import StreamDataPipeline
     from blendjax.ops.tiles import (
         TILEIDX_SUFFIX,
@@ -725,26 +730,47 @@ def test_multihost_tiles_chunked_still_rejected():
     from blendjax.parallel import batch_sharding, create_mesh
 
     mesh = create_mesh({"data": -1})
-    ref, frames = _frames(n=8, shape=(32, 32), seed=12)
+    ref, frames = _frames(n=32, shape=(32, 32), seed=12)
     enc = TileDeltaEncoder(ref, tile=16)
+    B = 8  # divisible by the virtual 8-device mesh
 
     def messages():
-        deltas = [tuple(a.copy() for a in enc.encode(f)) for f in frames]
-        idx, tiles = pack_batch(deltas, enc.num_tiles, capacity=4)
-        yield {
-            "_prebatched": True, "btid": 0,
-            "image" + TILEIDX_SUFFIX: idx,
-            "image" + TILES_SUFFIX: tiles,
-            "image" + TILESHAPE_SUFFIX: [32, 32, 4, 16],
-            "image" + TILEREF_SUFFIX: ref,
-        }
+        for g in range(2):  # 2 groups of K=2 batches of 8 frames
+            for k in range(2):
+                lo = 16 * g + B * k
+                batch = frames[lo: lo + B]
+                deltas = [
+                    tuple(a.copy() for a in enc.encode(f)) for f in batch
+                ]
+                idx, tiles = pack_batch(deltas, enc.num_tiles, capacity=4)
+                msg = {
+                    "_prebatched": True, "btid": 0,
+                    "image" + TILEIDX_SUFFIX: idx,
+                    "image" + TILES_SUFFIX: tiles,
+                    "image" + TILESHAPE_SUFFIX: [32, 32, 4, 16],
+                    "frameid": np.arange(B) + lo,
+                }
+                if g == 0 and k == 0:
+                    msg["image" + TILEREF_SUFFIX] = ref
+                yield msg
 
-    pipe = StreamDataPipeline(
-        messages(), batch_size=8, sharding=batch_sharding(mesh),
+    with StreamDataPipeline(
+        messages(), batch_size=B, sharding=batch_sharding(mesh),
         multihost=True, chunk=2,
-    )
-    with pytest.raises(NotImplementedError, match="chunk"):
-        list(pipe)
+    ) as pipe:
+        got = list(pipe)
+    assert [np.asarray(b["image"]).shape for b in got] == [
+        (2, B, 32, 32, 4)
+    ] * 2
+    for b in got:
+        assert b["image"].sharding.spec == P(None, "data")
+        img = np.asarray(b["image"])
+        fid = np.asarray(b["frameid"])
+        for k in range(2):
+            for i in range(B):
+                np.testing.assert_array_equal(
+                    img[k, i], frames[int(fid[k, i])]
+                )
 
 
 @pytest.mark.tpu
