@@ -181,3 +181,35 @@ def load_rasterizer():
                 ]
                 _CACHE["rasterizer"] = (fill, clear, clear_rect)
         return _CACHE["rasterizer"]
+
+
+def load_render_frame():
+    """Returns the one-call frame renderer or None.
+
+    ``render_frame(verts f64[n,3,3], rgba u8[n,4], n, light f64[3],
+    view f64[4,4], proj f64[4,4], clip_near, color u8[h,w,4],
+    zbuf f32[h,w], h, w, bg u8[4], prev_rect i64[4], out_rect i64[4])``
+    — projection + flat shading + near cull + dirty-rect clear + fill in
+    one FFI crossing (the producer's per-frame hot call; buffer args are
+    ``c_void_p`` so callers can pass cached raw addresses).
+    """
+    if os.environ.get("BLENDJAX_NO_NATIVE") == "1":
+        return None
+    with _LOCK:
+        if "render_frame" not in _CACHE:
+            lib = _build(os.path.join(_HERE, "rasterizer.cpp"), "rasterizer")
+            if lib is None:
+                _CACHE["render_frame"] = None
+            else:
+                fn = lib.bjx_render_frame
+                fn.restype = None
+                fn.argtypes = [
+                    ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
+                    ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+                    ctypes.c_double,
+                    ctypes.c_void_p, ctypes.c_void_p,
+                    ctypes.c_int64, ctypes.c_int64,
+                    ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+                ]
+                _CACHE["render_frame"] = fn
+        return _CACHE["render_frame"]

@@ -21,6 +21,7 @@
 #include <cstring>
 #include <algorithm>
 #include <limits>
+#include <vector>
 
 // The color buffer contract is BYTE-ordered RGBA. A uint32 store writes
 // its bytes in native order, so the packed fill pattern must be built by
@@ -69,25 +70,19 @@ void bjx_clear_rect(uint8_t* color, float* zbuf, int64_t h, int64_t w,
   }
 }
 
-// px:    n*3*2 float64 screen coordinates (x, y per vertex)
-// depth: n*3   float64 view depths per vertex
-// rgba:  n*4   uint8 shaded fill colors per triangle
-// n:     triangle count
-// color: h*w*4 uint8 framebuffer (pre-filled with background)
-// zbuf:  h*w   float32 (pre-filled with +inf)
-void bjx_fill_triangles(const double* px, const double* depth,
-                        const uint8_t* rgba, int64_t n,
-                        uint8_t* color, float* zbuf,
-                        int64_t h, int64_t w) {
-  for (int64_t t = 0; t < n; ++t) {
-    const double x0 = px[t * 6 + 0], y0 = px[t * 6 + 1];
-    const double x1 = px[t * 6 + 2], y1 = px[t * 6 + 3];
-    const double x2 = px[t * 6 + 4], y2 = px[t * 6 + 5];
-    const double z0 = depth[t * 3 + 0], z1 = depth[t * 3 + 1],
-                 z2 = depth[t * 3 + 2];
+// One triangle's span-solved scanline fill (shared by the array entry
+// point below and the full-frame renderer). px6 = (x0,y0,x1,y1,x2,y2)
+// pixel coords, z3 = per-vertex view depths, cpat = packed RGBA fill.
+static void fill_one(const double* px6, const double* z3, uint32_t cpat,
+                     uint8_t* color, float* zbuf, int64_t h, int64_t w) {
+  {
+    const double x0 = px6[0], y0 = px6[1];
+    const double x1 = px6[2], y1 = px6[3];
+    const double x2 = px6[4], y2 = px6[5];
+    const double z0 = z3[0], z1 = z3[1], z2 = z3[2];
 
     const double area = (x1 - x0) * (y2 - y0) - (x2 - x0) * (y1 - y0);
-    if (std::fabs(area) < 1e-12) continue;
+    if (std::fabs(area) < 1e-12) return;
     const double inv_area = 1.0 / area;
 
     int64_t xmin = (int64_t)std::floor(std::min({x0, x1, x2}));
@@ -96,7 +91,7 @@ void bjx_fill_triangles(const double* px, const double* depth,
     int64_t ymax = (int64_t)std::ceil(std::max({y0, y1, y2})) + 1;
     xmin = std::max<int64_t>(xmin, 0); xmax = std::min<int64_t>(xmax, w);
     ymin = std::max<int64_t>(ymin, 0); ymax = std::min<int64_t>(ymax, h);
-    if (xmin >= xmax || ymin >= ymax) continue;
+    if (xmin >= xmax || ymin >= ymax) return;
 
     // Edge functions at the first pixel center, plus per-x / per-y steps
     // (each w_i is affine in gx, gy). Instead of testing every bbox
@@ -116,7 +111,6 @@ void bjx_fill_triangles(const double* px, const double* depth,
     const double w2dx = -(w0dx + w1dx);
     const double zdx = w0dx * z0 + w1dx * z1 + w2dx * z2;
 
-    const uint32_t cpat = rgba_pattern(rgba + t * 4);
     const int64_t span = xmax - xmin;
     for (int64_t y = ymin; y < ymax; ++y) {
       const double dy = (double)(y - ymin);
@@ -164,6 +158,155 @@ void bjx_fill_triangles(const double* px, const double* depth,
       }
     }
   }
+}
+
+// px:    n*3*2 float64 screen coordinates (x, y per vertex)
+// depth: n*3   float64 view depths per vertex
+// rgba:  n*4   uint8 shaded fill colors per triangle
+// n:     triangle count
+// color: h*w*4 uint8 framebuffer (pre-filled with background)
+// zbuf:  h*w   float32 (pre-filled with +inf)
+void bjx_fill_triangles(const double* px, const double* depth,
+                        const uint8_t* rgba, int64_t n,
+                        uint8_t* color, float* zbuf,
+                        int64_t h, int64_t w) {
+  for (int64_t t = 0; t < n; ++t) {
+    fill_one(px + t * 6, depth + t * 3, rgba_pattern(rgba + t * 4),
+             color, zbuf, h, w);
+  }
+}
+
+// Full-frame render: projection, flat shading, near-plane cull, clear
+// (dirty-rect aware) and fill, all in one call — the producer's per-
+// frame Python cost collapses to a single FFI crossing (the numpy glue
+// for 12 triangles measurably rivals the fill itself on 1-core hosts).
+//
+// verts:  n*3*3 float64 world-space triangle vertices
+// rgba:   n*4   uint8 UNSHADED fill colors
+// light:  3     float64 unit light direction (shade = .35+.65|n.l|)
+// view:   16    float64 row-major world->camera matrix
+// proj:   16    float64 row-major camera->clip (GL-style) matrix
+// clip_near:    cull triangles with any vertex depth <= this
+// color/zbuf/h/w/bg: as bjx_clear
+// prev_rect: i64[4] (y0,y1,x0,x1) previously drawn rect for a same-
+//   buffer re-render; prev_rect[0] == -2 forces a FULL clear (fresh
+//   buffer), -1 means "nothing drawn last time" (clear new bbox only)
+// out_rect: i64[4] receives the drawn bbox, [0] = -1 when nothing drew
+void bjx_render_frame(const double* verts, const uint8_t* rgba, int64_t n,
+                      const double* light, const double* view,
+                      const double* proj, double clip_near,
+                      uint8_t* color, float* zbuf, int64_t h, int64_t w,
+                      const uint8_t* bg, const int64_t* prev_rect,
+                      int64_t* out_rect) {
+  // Project + shade into stack/heap scratch (n is small: one cube = 12).
+  std::vector<double> px(n * 6);
+  std::vector<double> dz(n * 3);
+  std::vector<uint32_t> cpat(n);
+  std::vector<uint8_t> vis(n);
+  const double pv_w = 0.5 * (double)w;
+  int64_t ymin = h, ymax = 0, xmin = w, xmax = 0;
+  bool any = false;
+  for (int64_t t = 0; t < n; ++t) {
+    // flat shade from the world-space normal
+    const double* a = verts + t * 9;
+    const double e1x = a[3] - a[0], e1y = a[4] - a[1], e1z = a[5] - a[2];
+    const double e2x = a[6] - a[0], e2y = a[7] - a[1], e2z = a[8] - a[2];
+    double nx = e1y * e2z - e1z * e2y;
+    double ny = e1z * e2x - e1x * e2z;
+    double nz = e1x * e2y - e1y * e2x;
+    const double nn = std::sqrt(nx * nx + ny * ny + nz * nz);
+    double shade = 0.35;
+    if (nn > 1e-12) {
+      const double d =
+          (nx * light[0] + ny * light[1] + nz * light[2]) / nn;
+      shade = 0.35 + 0.65 * std::fabs(d);
+    }
+    uint8_t sc[4];
+    for (int c = 0; c < 3; ++c) {
+      const double v = (double)rgba[t * 4 + c] * shade;
+      sc[c] = (uint8_t)(v < 0.0 ? 0.0 : (v > 255.0 ? 255.0 : v));
+    }
+    sc[3] = rgba[t * 4 + 3];
+    cpat[t] = rgba_pattern(sc);
+
+    bool ok = true;
+    for (int v3 = 0; v3 < 3; ++v3) {
+      const double* p = verts + t * 9 + v3 * 3;
+      // camera space (row-major 4x4 times column vector)
+      const double cx =
+          view[0] * p[0] + view[1] * p[1] + view[2] * p[2] + view[3];
+      const double cy =
+          view[4] * p[0] + view[5] * p[1] + view[6] * p[2] + view[7];
+      const double cz =
+          view[8] * p[0] + view[9] * p[1] + view[10] * p[2] + view[11];
+      const double depth = -cz;
+      if (depth <= clip_near) { ok = false; break; }
+      // clip space
+      const double qx = proj[0] * cx + proj[1] * cy + proj[2] * cz + proj[3];
+      const double qy = proj[4] * cx + proj[5] * cy + proj[6] * cz + proj[7];
+      const double qw =
+          proj[12] * cx + proj[13] * cy + proj[14] * cz + proj[15];
+      const double inv_w = 1.0 / qw;
+      // NDC -> pixels, upper-left origin (camera.py ndc_to_pixel)
+      const double sx = (qx * inv_w + 1.0) * pv_w;
+      const double sy = (1.0 - (qy * inv_w + 1.0) * 0.5) * (double)h;
+      px[t * 6 + v3 * 2 + 0] = sx;
+      px[t * 6 + v3 * 2 + 1] = sy;
+      dz[t * 3 + v3] = depth;
+    }
+    vis[t] = ok ? 1 : 0;
+    if (!ok) continue;
+    any = true;
+    for (int v3 = 0; v3 < 3; ++v3) {
+      const double sx = px[t * 6 + v3 * 2 + 0];
+      const double sy = px[t * 6 + v3 * 2 + 1];
+      const int64_t fy0 = (int64_t)std::floor(sy);
+      const int64_t fx0 = (int64_t)std::floor(sx);
+      if (fy0 < ymin) ymin = fy0;
+      if (fy0 + 1 > ymax) ymax = fy0 + 2;  // ceil+1 bound, clamped below
+      if (fx0 < xmin) xmin = fx0;
+      if (fx0 + 1 > xmax) xmax = fx0 + 2;
+    }
+  }
+  int64_t bbox[4] = {-1, -1, -1, -1};
+  if (any) {
+    if (ymin < 0) ymin = 0;
+    if (xmin < 0) xmin = 0;
+    if (ymax > h) ymax = h;
+    if (xmax > w) xmax = w;
+    if (ymin < ymax && xmin < xmax) {
+      bbox[0] = ymin; bbox[1] = ymax; bbox[2] = xmin; bbox[3] = xmax;
+    }
+  }
+
+  // Clear: full for a fresh buffer; union(prev drawn, new bbox) when
+  // re-rendering the same target (same induction as Rasterizer._clear).
+  if (prev_rect[0] == -2) {
+    bjx_clear(color, zbuf, h, w, bg);
+  } else {
+    int64_t y0 = -1, y1 = -1, x0 = -1, x1 = -1;
+    if (prev_rect[0] >= 0) {
+      y0 = prev_rect[0]; y1 = prev_rect[1];
+      x0 = prev_rect[2]; x1 = prev_rect[3];
+    }
+    if (bbox[0] >= 0) {
+      if (y0 < 0) { y0 = bbox[0]; y1 = bbox[1]; x0 = bbox[2]; x1 = bbox[3]; }
+      else {
+        y0 = std::min(y0, bbox[0]); y1 = std::max(y1, bbox[1]);
+        x0 = std::min(x0, bbox[2]); x1 = std::max(x1, bbox[3]);
+      }
+    }
+    if (y0 >= 0) bjx_clear_rect(color, zbuf, h, w, bg, y0, y1, x0, x1);
+  }
+
+  for (int64_t t = 0; t < n; ++t) {
+    if (vis[t]) {
+      fill_one(px.data() + t * 6, dz.data() + t * 3, cpat[t],
+               color, zbuf, h, w);
+    }
+  }
+  out_rect[0] = bbox[0]; out_rect[1] = bbox[1];
+  out_rect[2] = bbox[2]; out_rect[3] = bbox[3];
 }
 
 }  // extern "C"

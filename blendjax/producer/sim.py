@@ -106,12 +106,18 @@ class Rasterizer:
         # address got reused, skipping a needed full clear.
         self._prev_target: np.ndarray | None = None
         self.last_drawn: tuple | None = None
-        from blendjax._native import load_rasterizer
+        from blendjax._native import load_rasterizer, load_render_frame
 
         native = load_rasterizer()
         self._native_fill, self._native_clear, self._native_clear_rect = (
             native or (None, None, None)
         )
+        # One-call frame path: projection + shading + cull + clear + fill
+        # in a single FFI crossing (the numpy glue for a 12-triangle
+        # scene costs as much as the fill itself on 1-core hosts).
+        self._native_frame = load_render_frame()
+        self._rect_prev = np.empty(4, np.int64)
+        self._rect_out = np.empty(4, np.int64)
 
     def render(self, camera: Camera, triangles, colors, out=None) -> np.ndarray:
         """Render world-space ``triangles`` (N,3,3) filled with ``colors``
@@ -144,6 +150,10 @@ class Rasterizer:
                     f"contiguous={target.flags.c_contiguous}"
                 )
         triangles = np.asarray(triangles, np.float64)
+        if self._native_frame is not None:
+            return self._render_frame_native(
+                camera, triangles, colors, target, out
+            )
         if triangles.size == 0:
             px = depth = colors_v = shade_v = None
             bbox = None
@@ -192,6 +202,46 @@ class Rasterizer:
                                shade_v[i])
         self._prev_target = target
         self.last_drawn = bbox
+        return target.copy() if out is None else target
+
+    def _render_frame_native(self, camera, triangles, colors, target, out):
+        """One-FFI-call render: the C++ side projects, shades, culls,
+        clears (dirty-rect) and fills — identical output to the numpy
+        orchestration below (same math, same rounding contract)."""
+        h, w = self.shape
+        n = len(triangles)
+        colors = np.asarray(colors)
+        if colors.ndim == 2 and colors.shape[-1] == 3:
+            colors = np.concatenate(
+                [colors, np.full((n, 1), 255, colors.dtype)], axis=1
+            )
+        colors = np.ascontiguousarray(colors, dtype=np.uint8)
+        tri = np.ascontiguousarray(triangles)
+        view, proj = camera._matrices()
+        if self._prev_target is target:
+            if self.last_drawn is None:
+                self._rect_prev[0] = -1
+            else:
+                self._rect_prev[:] = self.last_drawn
+        else:
+            self._rect_prev[0] = -2
+        # Addresses read per call: `background` is a public attribute a
+        # caller may reassign, and a cached pointer would dangle on the
+        # freed old array (the .ctypes.data reads are noise next to the
+        # FFI call itself).
+        self._native_frame(
+            tri.ctypes.data, colors.ctypes.data, n,
+            self._light.ctypes.data, view.ctypes.data, proj.ctypes.data,
+            float(camera.clip_near),
+            target.ctypes.data, self._depth.ctypes.data, h, w,
+            self.background.ctypes.data, self._rect_prev.ctypes.data,
+            self._rect_out.ctypes.data,
+        )
+        self._prev_target = target
+        self.last_drawn = (
+            None if self._rect_out[0] < 0
+            else tuple(int(v) for v in self._rect_out)
+        )
         return target.copy() if out is None else target
 
     def invalidate(self) -> None:
