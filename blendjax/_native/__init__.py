@@ -10,14 +10,12 @@ absent.
 
 from blendjax._native.build import (
     load_palettize,
-    load_rasterizer,
     load_render_frame,
     load_tile_delta,
     load_tile_delta_palidx,
 )
 
 __all__ = [
-    "load_rasterizer",
     "load_render_frame",
     "load_tile_delta",
     "load_palettize",

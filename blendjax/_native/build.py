@@ -143,46 +143,6 @@ def load_tile_delta_palidx():
         return _CACHE["tiledelta_palidx"]
 
 
-def load_rasterizer():
-    """Returns ``(fill, clear, clear_rect)`` native functions or None.
-
-    ``fill(px f64[n,3,2], depth f64[n,3], rgba u8[n,4], n, color u8[h,w,4],
-    zbuf f32[h,w], h, w)``; ``clear(color, zbuf, h, w, rgba u8[4])``;
-    ``clear_rect(color, zbuf, h, w, rgba u8[4], y0, y1, x0, x1)``.
-    """
-    if os.environ.get("BLENDJAX_NO_NATIVE") == "1":
-        return None
-    with _LOCK:
-        if "rasterizer" not in _CACHE:
-            lib = _build(os.path.join(_HERE, "rasterizer.cpp"), "rasterizer")
-            if lib is None:
-                _CACHE["rasterizer"] = None
-            else:
-                u8p = ctypes.POINTER(ctypes.c_uint8)
-                f32p = ctypes.POINTER(ctypes.c_float)
-                f64p = ctypes.POINTER(ctypes.c_double)
-                fill = lib.bjx_fill_triangles
-                fill.restype = None
-                fill.argtypes = [
-                    f64p, f64p, u8p, ctypes.c_int64,
-                    u8p, f32p, ctypes.c_int64, ctypes.c_int64,
-                ]
-                clear = lib.bjx_clear
-                clear.restype = None
-                clear.argtypes = [
-                    u8p, f32p, ctypes.c_int64, ctypes.c_int64, u8p,
-                ]
-                clear_rect = lib.bjx_clear_rect
-                clear_rect.restype = None
-                clear_rect.argtypes = [
-                    u8p, f32p, ctypes.c_int64, ctypes.c_int64, u8p,
-                    ctypes.c_int64, ctypes.c_int64,
-                    ctypes.c_int64, ctypes.c_int64,
-                ]
-                _CACHE["rasterizer"] = (fill, clear, clear_rect)
-        return _CACHE["rasterizer"]
-
-
 def load_render_frame():
     """Returns the one-call frame renderer or None.
 

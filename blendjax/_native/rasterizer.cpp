@@ -160,22 +160,6 @@ static void fill_one(const double* px6, const double* z3, uint32_t cpat,
   }
 }
 
-// px:    n*3*2 float64 screen coordinates (x, y per vertex)
-// depth: n*3   float64 view depths per vertex
-// rgba:  n*4   uint8 shaded fill colors per triangle
-// n:     triangle count
-// color: h*w*4 uint8 framebuffer (pre-filled with background)
-// zbuf:  h*w   float32 (pre-filled with +inf)
-void bjx_fill_triangles(const double* px, const double* depth,
-                        const uint8_t* rgba, int64_t n,
-                        uint8_t* color, float* zbuf,
-                        int64_t h, int64_t w) {
-  for (int64_t t = 0; t < n; ++t) {
-    fill_one(px + t * 6, depth + t * 3, rgba_pattern(rgba + t * 4),
-             color, zbuf, h, w);
-  }
-}
-
 // Full-frame render: projection, flat shading, near-plane cull, clear
 // (dirty-rect aware) and fill, all in one call — the producer's per-
 // frame Python cost collapses to a single FFI crossing (the numpy glue

@@ -83,10 +83,12 @@ def rotation_xyz(rx: float, ry: float, rz: float) -> np.ndarray:
 class Rasterizer:
     """Tiny z-buffered flat-shaded triangle rasterizer.
 
-    The per-triangle fill runs in C++ when the native accelerator builds
-    (``blendjax/_native/rasterizer.cpp``, ~20x faster at 640x480 — the
-    producer-side hot loop); the numpy fill is the always-available
-    fallback with identical output.
+    The whole frame renders in ONE C++ call when the native accelerator
+    builds (``blendjax/_native/rasterizer.cpp`` ``bjx_render_frame``:
+    projection, flat shading, near culling, dirty-rect clear, span-
+    solved fill — the producer-side hot loop); the numpy/Python
+    orchestration below is the always-available fallback with identical
+    output.
     """
 
     def __init__(self, shape=(480, 640), background=(0, 0, 0, 255)):
@@ -106,15 +108,13 @@ class Rasterizer:
         # address got reused, skipping a needed full clear.
         self._prev_target: np.ndarray | None = None
         self.last_drawn: tuple | None = None
-        from blendjax._native import load_rasterizer, load_render_frame
+        from blendjax._native import load_render_frame
 
-        native = load_rasterizer()
-        self._native_fill, self._native_clear, self._native_clear_rect = (
-            native or (None, None, None)
-        )
         # One-call frame path: projection + shading + cull + clear + fill
         # in a single FFI crossing (the numpy glue for a 12-triangle
-        # scene costs as much as the fill itself on 1-core hosts).
+        # scene costs as much as the fill itself on 1-core hosts). The
+        # fallback when the toolchain is absent is the pure numpy/Python
+        # orchestration below — same math, identical output.
         self._native_frame = load_render_frame()
         self._rect_prev = np.empty(4, np.int64)
         self._rect_out = np.empty(4, np.int64)
@@ -194,12 +194,8 @@ class Rasterizer:
         self._clear(target, bbox)
 
         if px is not None and len(px):
-            if self._native_fill is not None:
-                self._render_native(target, px, depth, colors_v, shade_v)
-            else:
-                for i in range(len(px)):
-                    self._fill(target, px[i], depth[i], colors_v[i],
-                               shade_v[i])
+            for i in range(len(px)):
+                self._fill(target, px[i], depth[i], colors_v[i], shade_v[i])
         self._prev_target = target
         self.last_drawn = bbox
         return target.copy() if out is None else target
@@ -257,7 +253,6 @@ class Rasterizer:
         incoming geometry bbox) — the rest of the frame is untouched
         background by induction. Any other buffer gets the full clear.
         """
-        h, w = self.shape
         rect = None
         if self._prev_target is target:
             rects = [r for r in (self.last_drawn, new_bbox) if r]
@@ -267,51 +262,13 @@ class Rasterizer:
                 min(r[0] for r in rects), max(r[1] for r in rects),
                 min(r[2] for r in rects), max(r[3] for r in rects),
             )
-        import ctypes
-
-        u8 = ctypes.POINTER(ctypes.c_uint8)
-        f32 = ctypes.POINTER(ctypes.c_float)
-        if rect is not None and self._native_clear_rect is not None:
-            self._native_clear_rect(
-                target.ctypes.data_as(u8),
-                self._depth.ctypes.data_as(f32),
-                h, w, self.background.ctypes.data_as(u8), *rect,
-            )
-        elif rect is not None:
+        if rect is not None:
             y0, y1, x0, x1 = rect
             target[y0:y1, x0:x1] = self.background
             self._depth[y0:y1, x0:x1] = np.inf
-        elif self._native_clear is not None:
-            self._native_clear(
-                target.ctypes.data_as(u8),
-                self._depth.ctypes.data_as(f32),
-                h, w, self.background.ctypes.data_as(u8),
-            )
         else:
             target[:] = self.background
             self._depth[:] = np.inf
-
-    def _render_native(self, target, px, depth, colors, shade):
-        import ctypes
-
-        n = len(px)
-        if n == 0:
-            return
-        shaded = colors.astype(np.float64)
-        shaded[:, :3] *= shade[:, None]
-        rgba = np.clip(shaded, 0, 255).astype(np.uint8)
-        px = np.ascontiguousarray(px)
-        depth = np.ascontiguousarray(depth)
-        h, w = self.shape
-        self._native_fill(
-            px.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
-            depth.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
-            rgba.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
-            n,
-            target.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
-            self._depth.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
-            h, w,
-        )
 
     def _fill(self, target, tri_px, tri_depth, color, shade):
         h, w = self.shape

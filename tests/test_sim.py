@@ -119,22 +119,23 @@ def test_observation_into_matches_observation():
 
 
 def test_native_and_python_rasterizers_agree(monkeypatch):
-    """The C++ fill and the numpy fallback draw the same cube (up to
-    rounding at triangle-edge pixels: <1% of covered pixels may differ)."""
+    """The one-call C++ frame renderer and the numpy fallback draw the
+    same cube (up to rounding at triangle-edge pixels: <1% of covered
+    pixels may differ)."""
     import blendjax._native.build as build
 
     native = CubeScene(shape=(120, 160), seed=11)
     native.step(1)
-    if native.raster._native_fill is None:
+    if native.raster._native_frame is None:
         import pytest
 
         pytest.skip("native rasterizer unavailable")
     img_native = native.observation(1)["image"]
 
     monkeypatch.setenv("BLENDJAX_NO_NATIVE", "1")
-    monkeypatch.setitem(build._CACHE, "rasterizer", None)
+    monkeypatch.setitem(build._CACHE, "render_frame", None)
     fallback = CubeScene(shape=(120, 160), seed=11)
-    assert fallback.raster._native_fill is None
+    assert fallback.raster._native_frame is None
     fallback.step(1)
     img_py = fallback.observation(1)["image"]
 
