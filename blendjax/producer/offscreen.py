@@ -83,11 +83,44 @@ class OffScreenRenderer:
                 proj_m,
                 do_color_management=True,
             )
-            buf = self.offscreen.texture_color.read()
-            buf.dimensions = self.shape[0] * self.shape[1] * 4
-        arr = np.asarray(buf, dtype=np.uint8).reshape(
-            self.shape[0], self.shape[1], 4
-        )
+            tex = getattr(self.offscreen, "texture_color", None)
+            if tex is not None:
+                buf = tex.read()
+                buf.dimensions = self.shape[0] * self.shape[1] * 4
+                arr = np.asarray(buf, dtype=np.uint8)
+            else:
+                # Blender 2.8x/2.9x: no texture_color — read the bound
+                # color attachment through GL like the reference does
+                # (``offscreen.py:68-99``: ``bgl.Buffer`` lacks the
+                # buffer protocol, hence PyOpenGL's glGetTexImage there;
+                # glReadPixels on the bound FBO needs neither).
+                arr = self._read_pixels_gl()
+        arr = arr.reshape(self.shape[0], self.shape[1], 4)
         if self.origin == "upper-left":
             arr = np.flipud(arr)
         return arr[..., : self.channels]
+
+    def _read_pixels_gl(self) -> np.ndarray:
+        """Legacy readback for Blender builds predating
+        ``GPUOffScreen.texture_color`` (reference counterpart:
+        ``btb/offscreen.py:68-99``). ``glReadPixels`` into a numpy
+        buffer while the offscreen FBO is bound — PyOpenGL accepts any
+        writable buffer-protocol object, sidestepping the bgl.Buffer
+        limitation the reference works around via glGetTexImage."""
+        try:
+            from OpenGL import GL
+        except ImportError as e:  # pragma: no cover - legacy-Blender only
+            raise RuntimeError(
+                "this Blender's GPUOffScreen has no texture_color and "
+                "PyOpenGL is not importable; pip-install PyOpenGL into "
+                "Blender's Python (scripts/install_producer.py does)"
+            ) from e
+        h, w = self.shape
+        GL.glReadPixels(
+            0, 0, w, h, GL.GL_RGBA, GL.GL_UNSIGNED_BYTE, self.buffer
+        )
+        # Copy: render() must return memory the next render won't
+        # overwrite — the zero-copy publish path (DataPublisher
+        # copy=False) queues frames by reference, and the modern
+        # texture_color path returns fresh memory per call.
+        return self.buffer.reshape(-1).copy()

@@ -44,7 +44,9 @@ def main(argv=None) -> int:
 
     from blendjax.testing import fake_bpy
 
-    fake_bpy.install(background=background)
+    # A real `blender` launch without a .blend opens the stock startup
+    # scene (Cube/Camera/Light) — scene scripts rely on it.
+    fake_bpy.install(background=background, default_scene=True)
     if expr is not None:
         exec(compile(expr, "<python-expr>", "exec"), {"__name__": "__main__"})
     if script is not None:

@@ -475,12 +475,32 @@ class _ScreenOps:
 # -- module assembly --------------------------------------------------------
 
 
-def _build_bpy(background: bool) -> types.ModuleType:
+def _default_startup_scene(bpy) -> None:
+    """Blender's stock startup scene: Cube at the origin, Camera at its
+    default pose, a (mesh-less) Light — what a real ``blender`` launch
+    opens when no ``.blend`` is given, and what reference-style scene
+    scripts assume (e.g. ``bpy.data.objects["Cube"]``,
+    ``examples/datagen/cube.blend.py``)."""
+    bpy.ops.mesh.primitive_cube_add(size=2.0, location=(0.0, 0.0, 0.0))
+    cam = bpy.data.objects.new("Camera", bpy.data.cameras.new("Camera"))
+    bpy.context.collection.objects.link(cam)
+    cam.location = (7.3589, -6.9258, 4.9583)  # Blender's default pose
+    cam.rotation_euler = (1.1093, 0.0, 0.8149)
+    bpy.context.scene.camera = cam
+    light = bpy.data.objects.new("Light")
+    light.location = (4.0762, 1.0055, 5.9039)
+    bpy.context.collection.objects.link(light)
+    # like real Blender's startup file, the Cube is the active object
+    bpy.context.active_object = bpy.data.objects["Cube"]
+
+
+def _build_bpy(background: bool, default_scene: bool) -> types.ModuleType:
     bpy = types.ModuleType("bpy")
     bpy.__doc__ = "blendjax fake bpy (see blendjax.testing.fake_bpy)"
 
     app = types.SimpleNamespace(
         version=(4, 2, 0),
+        background=background,
         handlers=types.SimpleNamespace(
             frame_change_pre=[], frame_change_post=[]
         ),
@@ -504,12 +524,19 @@ def _build_bpy(background: bool) -> types.ModuleType:
     )
     bpy._is_fake = True
     bpy._background = background
+    bpy._default_scene = default_scene
+    if default_scene:
+        _default_startup_scene(bpy)
     return bpy
 
 
-def install(background: bool = False) -> types.ModuleType:
+def install(background: bool = False,
+            default_scene: bool = False) -> types.ModuleType:
     """Register fake ``bpy``/``gpu`` modules into ``sys.modules``
-    (idempotent; refuses to shadow a real Blender runtime)."""
+    (idempotent; refuses to shadow a real Blender runtime).
+    ``default_scene=True`` opens Blender's stock startup scene the way a
+    real launch without a ``.blend`` does (the fake ``blender`` CLI
+    passes it); the in-process default stays an empty graph."""
     existing = sys.modules.get("bpy")
     if existing is not None and not getattr(existing, "_is_fake", False):
         raise RuntimeError(
@@ -517,19 +544,23 @@ def install(background: bool = False) -> types.ModuleType:
             "shadow it"
         )
     if existing is None:
-        sys.modules["bpy"] = _build_bpy(background)
+        sys.modules["bpy"] = _build_bpy(background, default_scene)
         from blendjax.testing import fake_gpu
 
         sys.modules["gpu"] = fake_gpu.build(sys.modules["bpy"])
-    elif existing._background != background:
+    elif (
+        existing._background != background
+        or existing._default_scene != default_scene
+    ):
         # Mutate the installed module in place (like reset): modules that
         # did ``import bpy`` hold a reference to the OBJECT, so rebinding
         # sys.modules would leave them on a stale scene graph.
-        reset(background=background)
+        reset(background=background, default_scene=default_scene)
     return sys.modules["bpy"]
 
 
-def reset(background: bool | None = None) -> types.ModuleType:
+def reset(background: bool | None = None,
+          default_scene: bool | None = None) -> types.ModuleType:
     """Fresh scene graph (new ``bpy.context``/``bpy.data``), keeping the
     installed module identity so prior ``import bpy`` references update."""
     bpy = sys.modules.get("bpy")
@@ -538,8 +569,11 @@ def reset(background: bool | None = None) -> types.ModuleType:
     )
     if background is None:
         background = bpy._background
-    fresh = _build_bpy(background)
-    for attr in ("app", "data", "context", "ops", "types", "_background"):
+    if default_scene is None:
+        default_scene = bpy._default_scene
+    fresh = _build_bpy(background, default_scene)
+    for attr in ("app", "data", "context", "ops", "types",
+                 "_background", "_default_scene"):
         setattr(bpy, attr, getattr(fresh, attr))
     # ops/context captured the fresh module; point them back at the live one
     bpy.ops.mesh._bpy = bpy

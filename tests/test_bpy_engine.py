@@ -239,6 +239,37 @@ def test_offscreen_renderer_reads_back_and_flips(bpy):
     r_ll = OffScreenRenderer(mode="rgba", origin="lower-left")
     np.testing.assert_array_equal(np.flipud(r_ll.render()), img)
 
+    # Legacy-Blender path (no GPUOffScreen.texture_color): the GL
+    # readback fallback produces the same frame (reference counterpart:
+    # the glGetTexImage dance, ``btb/offscreen.py:68-99``).
+    import sys as _sys
+    import types as _types
+
+    r_old = OffScreenRenderer(mode="rgba", origin="upper-left")
+    pixels = r_old.offscreen._pixels  # the fake GPU's GL-ordered store
+
+    def fake_read_pixels(x, y, w_, h_, fmt, dtype, buf):
+        np.asarray(buf).reshape(h_, w_, 4)[:] = pixels
+
+    gl_mod = _types.SimpleNamespace(
+        GL=_types.SimpleNamespace(
+            GL_RGBA=0x1908, GL_UNSIGNED_BYTE=0x1401,
+            glReadPixels=fake_read_pixels,
+        )
+    )
+    del r_old.offscreen.texture_color
+    saved = _sys.modules.get("OpenGL")
+    _sys.modules["OpenGL"] = gl_mod
+    _sys.modules["OpenGL.GL"] = gl_mod.GL
+    try:
+        np.testing.assert_array_equal(r_old.render(), img)
+    finally:
+        _sys.modules.pop("OpenGL.GL", None)
+        if saved is None:
+            _sys.modules.pop("OpenGL", None)
+        else:  # pragma: no cover
+            _sys.modules["OpenGL"] = saved
+
     # rgb mode drops alpha
     r_rgb = OffScreenRenderer(mode="rgb")
     assert r_rgb.render().shape == (120, 160, 3)
