@@ -734,25 +734,26 @@ def test_multihost_tiles_chunked_superbatch():
     enc = TileDeltaEncoder(ref, tile=16)
     B = 8  # divisible by the virtual 8-device mesh
 
+    def batch_msg(lo, with_ref):
+        deltas = [
+            tuple(a.copy() for a in enc.encode(f))
+            for f in frames[lo: lo + B]
+        ]
+        idx, tiles = pack_batch(deltas, enc.num_tiles, capacity=4)
+        msg = {
+            "_prebatched": True, "btid": 0,
+            "image" + TILEIDX_SUFFIX: idx,
+            "image" + TILES_SUFFIX: tiles,
+            "image" + TILESHAPE_SUFFIX: [32, 32, 4, 16],
+            "frameid": np.arange(B) + lo,
+        }
+        if with_ref:
+            msg["image" + TILEREF_SUFFIX] = ref
+        return msg
+
     def messages():
-        for g in range(2):  # 2 groups of K=2 batches of 8 frames
-            for k in range(2):
-                lo = 16 * g + B * k
-                batch = frames[lo: lo + B]
-                deltas = [
-                    tuple(a.copy() for a in enc.encode(f)) for f in batch
-                ]
-                idx, tiles = pack_batch(deltas, enc.num_tiles, capacity=4)
-                msg = {
-                    "_prebatched": True, "btid": 0,
-                    "image" + TILEIDX_SUFFIX: idx,
-                    "image" + TILES_SUFFIX: tiles,
-                    "image" + TILESHAPE_SUFFIX: [32, 32, 4, 16],
-                    "frameid": np.arange(B) + lo,
-                }
-                if g == 0 and k == 0:
-                    msg["image" + TILEREF_SUFFIX] = ref
-                yield msg
+        for n in range(4):  # 2 groups of K=2 batches of 8 frames
+            yield batch_msg(B * n, with_ref=n == 0)
 
     with StreamDataPipeline(
         messages(), batch_size=B, sharding=batch_sharding(mesh),
@@ -771,6 +772,26 @@ def test_multihost_tiles_chunked_superbatch():
                 np.testing.assert_array_equal(
                     img[k, i], frames[int(fid[k, i])]
                 )
+
+    # Stream end mid-group: the trailing short group flushes as K'=1
+    # (the same lockstep rule — every process ends together under SPMD).
+    def three_batches():
+        for n in range(3):
+            yield batch_msg(B * n, with_ref=n == 0)
+
+    with StreamDataPipeline(
+        three_batches(), batch_size=B, sharding=batch_sharding(mesh),
+        multihost=True, chunk=2,
+    ) as pipe:
+        tail = list(pipe)
+    assert [np.asarray(b["image"]).shape for b in tail] == [
+        (2, B, 32, 32, 4), (1, B, 32, 32, 4)
+    ]
+    short = np.asarray(tail[1]["image"])
+    for i in range(B):
+        np.testing.assert_array_equal(
+            short[0, i], frames[int(np.asarray(tail[1]["frameid"])[0, i])]
+        )
 
 
 @pytest.mark.tpu
