@@ -15,6 +15,7 @@ v4-32-style mesh sees one logical batch (SURVEY.md §2.4 implication (b)).
 from __future__ import annotations
 
 import collections
+import hashlib
 
 import numpy as np
 
@@ -321,8 +322,6 @@ class TileStreamDecoder:
                 # Stable digest (NOT Python hash(): per-process salted),
                 # so chunk-group keys and the multihost fleet check
                 # compare identically across processes.
-                import hashlib
-
                 self._ref_digest[key] = int.from_bytes(
                     hashlib.blake2b(
                         self._host_refs[key].tobytes(), digest_size=8
