@@ -263,33 +263,44 @@ def measure_step_alone(chunk: int, calls: int = 8) -> dict:
     import jax
 
     from blendjax.models import CubeRegressor
-    from blendjax.parallel import create_mesh
-    from blendjax.train import make_chunked_supervised_step, make_train_state
+    from blendjax.parallel import batch_sharding, create_mesh
+    from blendjax.train import (
+        make_chunked_supervised_step,
+        make_supervised_step,
+        make_train_state,
+    )
 
     mesh = create_mesh({"data": -1})
+    sharding = batch_sharding(mesh)
     rng = np.random.default_rng(0)
-    # Same mesh/sharding setup as measure(): the utilization ratio must
-    # compare identically-sharded programs.
+    # Same mesh/sharding setup AND step builder as measure(): the
+    # utilization ratio must compare identical programs.
     state = make_train_state(
         CubeRegressor(), np.zeros((BATCH, *SHAPE, 4), np.uint8), mesh=mesh
     )
-    step = make_chunked_supervised_step()
+    if chunk > 1:
+        step = make_chunked_supervised_step()
+        lead = (chunk, BATCH)
+    else:
+        step = make_supervised_step(mesh=mesh, batch_sharding=sharding)
+        lead = (BATCH,)
     sb = {
         "image": jax.device_put(
-            rng.integers(0, 255, (chunk, BATCH, *SHAPE, 4), np.uint8)
+            rng.integers(0, 255, (*lead, *SHAPE, 4), np.uint8)
         ),
         "xy": jax.device_put(
-            (rng.random((chunk, BATCH, 8, 2)) * 64).astype(np.float32)
+            (rng.random((*lead, 8, 2)) * 64).astype(np.float32)
         ),
     }
     state, m = step(state, sb)  # compile + warm
-    float(np.asarray(m["loss"])[-1])
+    float(np.asarray(m["loss"]).reshape(-1)[-1])
+    calls = calls if chunk > 1 else calls * 8  # comparable image counts
     best = 0.0
     for _ in range(2):
         t0 = time.perf_counter()
         for _ in range(calls):
             state, m = step(state, sb)
-        float(np.asarray(m["loss"])[-1])  # honest d2h sync
+        float(np.asarray(m["loss"]).reshape(-1)[-1])  # honest d2h sync
         dt = time.perf_counter() - t0
         best = max(best, calls * chunk * BATCH / dt)
     return {"img_s": round(best, 1), "chunk": chunk, "calls": calls}
@@ -368,8 +379,9 @@ def main() -> None:
     try:
         # Chip-utilization estimate: achieved throughput over the
         # step-alone ceiling measured in the same process/weather
-        # window, at the SAME chunk configuration the passes ran.
-        alone = measure_step_alone(CHUNK if ENCODING == "tile" else 1)
+        # window, at the chunk configuration the passes ACTUALLY ran
+        # (recorded in the pass result, not re-derived here).
+        alone = measure_step_alone(primary["chunk"])
         detail["step_alone"] = alone
         detail["utilization"] = round(ips / alone["img_s"], 3)
     except Exception as e:  # pragma: no cover - device flake path

@@ -94,11 +94,29 @@ class FakeVertices(list):
         out = np.asarray(flat)
         out[:] = np.concatenate([v.co for v in self]) if self else out[:0]
 
+    def foreach_set(self, attr: str, flat) -> None:
+        assert attr == "co", f"unsupported vertex attr {attr!r}"
+        co = np.asarray(flat, dtype=np.float64).reshape(len(self), 3)
+        for v, c in zip(self, co):
+            v.co = c.copy()
+
 
 class FakeMesh:
     def __init__(self, name: str, verts=()):
         self.name = name
         self.vertices = FakeVertices(FakeVertex(v) for v in verts)
+        self.polygons: list = []
+
+    def from_pydata(self, verts, edges, faces) -> None:
+        """Geometry-from-arrays (used by procedural scene scripts, e.g.
+        the supershape example)."""
+        del edges
+        # slice-assign: self.vertices' identity carries foreach_* support
+        self.vertices[:] = (FakeVertex(v) for v in verts)
+        self.polygons = [tuple(f) for f in faces]
+
+    def update(self) -> None:  # recalc normals etc. — nothing cached here
+        pass
 
 
 class FakeCameraData:

@@ -46,7 +46,10 @@ def supercoords(params, shape=UV):
     r2 = sf(*p[1], phi)[None, :]
     x = r1 * np.cos(theta)[:, None] * r2 * np.cos(phi)[None, :]
     y = r1 * np.sin(theta)[:, None] * r2 * np.cos(phi)[None, :]
-    z = r2 * np.sin(phi)[None, :]
+    # z varies only with phi; broadcast to the full grid so the three
+    # coordinate arrays stack (first caught by the fake-Blender tier:
+    # this script had never executed before it).
+    z = np.broadcast_to(r2 * np.sin(phi)[None, :], x.shape)
     return x, y, z
 
 
