@@ -284,12 +284,21 @@ def measure_step_alone(chunk: int, calls: int = 8) -> dict:
     else:
         step = make_supervised_step(mesh=mesh, batch_sharding=sharding)
         lead = (BATCH,)
+    # Chunked fields carry the chunk axis replicated; per-batch fields
+    # take the batch sharding directly — matching what the pipeline
+    # feeds measure() (layouts ride the arrays; the step jit infers).
+    if chunk > 1:
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        sharding = NamedSharding(
+            sharding.mesh, PartitionSpec(None, *sharding.spec)
+        )
     sb = {
         "image": jax.device_put(
-            rng.integers(0, 255, (*lead, *SHAPE, 4), np.uint8)
+            rng.integers(0, 255, (*lead, *SHAPE, 4), np.uint8), sharding
         ),
         "xy": jax.device_put(
-            (rng.random((*lead, 8, 2)) * 64).astype(np.float32)
+            (rng.random((*lead, 8, 2)) * 64).astype(np.float32), sharding
         ),
     }
     state, m = step(state, sb)  # compile + warm
@@ -395,7 +404,12 @@ def main() -> None:
     if ENCODING == "tile" and RAW_ROW:
         # Shorter raw-frame row: tracks the non-sparse path (full 1.2MB
         # frames over wire + host->device) without doubling bench time.
-        raw = measure("raw", 1, 128, 45.0, with_stages=False)
+        # Stage breakdown included so the row's bound is evidenced, not
+        # guessed: at 640x480x4 every image is ~1.23MB of wire + PCIe
+        # traffic, so MB_s says whether the link or the consumer binds.
+        raw = measure("raw", 1, 128, 45.0, with_stages=True)
+        raw["MB_per_image"] = round(SHAPE[0] * SHAPE[1] * 4 / 1e6, 3)
+        raw["MB_s"] = round(raw["value"] * raw["MB_per_image"], 1)
         detail["raw_row"] = raw
     print(
         json.dumps(
