@@ -173,6 +173,7 @@ def measure(encoding: str, chunk: int, items: int, time_cap: float,
             reg.reset()  # stage spans cover the measured window only
             images = 0
             t_next = t_step = 0.0
+            pool = fut = None
             if OVERLAP:
                 # Dispatch step k from a worker thread while the main
                 # thread waits on group k+1: on serialized tunnel
@@ -183,37 +184,30 @@ def measure(encoding: str, chunk: int, items: int, time_cap: float,
                 from concurrent.futures import ThreadPoolExecutor
 
                 pool = ThreadPoolExecutor(1)
-                fut = None
-                t0 = time.perf_counter()
-                while images < items:
-                    ta = time.perf_counter()
-                    sb = next(it)
-                    tb = time.perf_counter()
+
+            # ONE measured loop for both modes (the two must stay
+            # strictly comparable); only the dispatch differs.
+            t0 = time.perf_counter()
+            while images < items:
+                ta = time.perf_counter()
+                sb = next(it)
+                tb = time.perf_counter()
+                if pool is not None:
                     if fut is not None:
                         state, metrics = fut.result()
                     fut = pool.submit(run_step, state, sb)
-                    tc = time.perf_counter()
-                    t_next += tb - ta
-                    t_step += tc - tb
-                    images += batch_images(sb)
-                    if tc - t0 > time_cap:
-                        break
-                if fut is not None:
-                    state, metrics = fut.result()
-                pool.shutdown(wait=True)
-            else:
-                t0 = time.perf_counter()
-                while images < items:
-                    ta = time.perf_counter()
-                    sb = next(it)
-                    tb = time.perf_counter()
+                else:
                     state, metrics = run_step(state, sb)
-                    tc = time.perf_counter()
-                    t_next += tb - ta
-                    t_step += tc - tb
-                    images += batch_images(sb)
-                    if tc - t0 > time_cap:
-                        break
+                tc = time.perf_counter()
+                t_next += tb - ta
+                t_step += tc - tb
+                images += batch_images(sb)
+                if tc - t0 > time_cap:
+                    break
+            if fut is not None:
+                state, metrics = fut.result()
+            if pool is not None:
+                pool.shutdown(wait=True)
             t_sync0 = time.perf_counter()
             final_loss = last_loss(metrics)  # full drain, see above
             t_sync = time.perf_counter() - t_sync0

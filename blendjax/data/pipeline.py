@@ -92,10 +92,13 @@ class DeviceFeeder:
             if k == "_meta":
                 out[k] = v
                 continue
-            if isinstance(v, jax.Array):
-                # Already a placed (possibly multi-process global) array
-                # — an upstream stage assembled it with the layout it
-                # needs; re-placing could force a reshard.
+            if isinstance(v, jax.Array) and len(v.sharding.device_set) > 1:
+                # Already an assembled multi-device global array (the
+                # multihost chunk flush builds these) — re-placing would
+                # force a reshard or a bogus re-assembly. Single-device
+                # jax arrays deliberately fall through: a user-fed
+                # device array still gets the configured batch sharding
+                # (or the multihost global assembly), same as before.
                 out[k] = v
                 continue
             if k == "__packed__":
@@ -549,15 +552,22 @@ class TileStreamDecoder:
             return
         from jax.experimental import multihost_utils
 
+        # Two uint32 words, not one uint64: with jax_enable_x64 off (the
+        # default) a uint64 array would be canonicalized to uint32 and
+        # the gather would silently compare only the low half.
+        words = np.asarray(
+            [digest & 0xFFFFFFFF, digest >> 32], dtype=np.uint32
+        )
         everyone = np.asarray(
-            multihost_utils.process_allgather(
-                np.asarray(digest, dtype=np.uint64)
-            )
-        ).reshape(-1)
+            multihost_utils.process_allgather(words)
+        ).reshape(-1, 2)
         if not (everyone == everyone[0]).all():
+            digests = {
+                int(lo) | (int(hi) << 32) for lo, hi in everyone.tolist()
+            }
             raise RuntimeError(
                 f"multihost tile stream {name!r}: processes selected "
-                f"DIFFERENT fleet references (digests {set(everyone.tolist())}) "
+                f"DIFFERENT fleet references (digests {digests}) "
                 "— the assembled global batch would decode some rows "
                 "against the wrong content. Pin one scene background "
                 "across all hosts."

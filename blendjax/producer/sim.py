@@ -150,10 +150,23 @@ class Rasterizer:
                     f"contiguous={target.flags.c_contiguous}"
                 )
         triangles = np.asarray(triangles, np.float64)
-        if self._native_frame is not None:
-            return self._render_frame_native(
-                camera, triangles, colors, target, out
-            )
+        # The one-call native path is an exact twin of the Python
+        # orchestration ONLY under its preconditions: the camera's pixel
+        # mapping matches the framebuffer, colors are uint8 (shading
+        # truncation order is observable for floats), and one color row
+        # per triangle (C++ cannot bounds-check the caller's buffer).
+        # Anything else takes the Python path — identical output where
+        # both are defined, loud IndexError where the input is wrong.
+        if self._native_frame is not None and camera.shape == self.shape:
+            cv = np.asarray(colors) if triangles.size else None
+            if triangles.size == 0 or (
+                cv.dtype == np.uint8
+                and cv.ndim == 2
+                and len(cv) == len(triangles)
+            ):
+                return self._render_frame_native(
+                    camera, triangles, cv, target, out
+                )
         if triangles.size == 0:
             px = depth = colors_v = shade_v = None
             bbox = None
@@ -206,12 +219,13 @@ class Rasterizer:
         orchestration below (same math, same rounding contract)."""
         h, w = self.shape
         n = len(triangles)
-        colors = np.asarray(colors)
-        if colors.ndim == 2 and colors.shape[-1] == 3:
+        if colors is None:
+            colors = np.empty((0, 4), np.uint8)
+        if colors.shape[-1] == 3:
             colors = np.concatenate(
                 [colors, np.full((n, 1), 255, colors.dtype)], axis=1
             )
-        colors = np.ascontiguousarray(colors, dtype=np.uint8)
+        colors = np.ascontiguousarray(colors)
         tri = np.ascontiguousarray(triangles)
         view, proj = camera._matrices()
         if self._prev_target is target:
