@@ -57,6 +57,23 @@ class Matrix:
     def inverted(self) -> "Matrix":
         return Matrix(np.linalg.inv(self._m))
 
+    @property
+    def translation(self) -> np.ndarray:
+        return self._m[:3, 3]
+
+    def to_euler(self, order: str = "XYZ") -> Euler:
+        """XYZ euler extraction for M = Rz @ Ry @ Rx (Blender's default
+        order; scale assumed uniform-positive for the surface we fake)."""
+        assert order == "XYZ", f"unsupported euler order {order!r}"
+        r = self._m[:3, :3]
+        # strip scale (columns are basis vectors times per-axis scale)
+        norms = np.linalg.norm(r, axis=0)
+        r = r / np.where(norms > 1e-12, norms, 1.0)
+        y = math.asin(np.clip(-r[2, 0], -1.0, 1.0))
+        x = math.atan2(r[2, 1], r[2, 2])
+        z = math.atan2(r[1, 0], r[0, 0])
+        return Euler((x, y, z))
+
     def __repr__(self):  # pragma: no cover - debugging aid
         return f"Matrix({self._m.tolist()!r})"
 
@@ -106,6 +123,7 @@ class FakeMesh:
         self.name = name
         self.vertices = FakeVertices(FakeVertex(v) for v in verts)
         self.polygons: list = []
+        self.materials: list = []  # supports .append like bpy's slots
 
     def from_pydata(self, verts, edges, faces) -> None:
         """Geometry-from-arrays (used by procedural scene scripts, e.g.
@@ -117,6 +135,41 @@ class FakeMesh:
 
     def update(self) -> None:  # recalc normals etc. — nothing cached here
         pass
+
+
+class FakeMaterial:
+    def __init__(self, name: str):
+        self.name = name
+        self.diffuse_color = (0.8, 0.8, 0.8, 1.0)
+
+
+class FakeRigidBody:
+    """``obj.rigid_body`` surface (``bpy.types.RigidBodyObject``)."""
+
+    def __init__(self, type: str = "ACTIVE"):
+        self.type = type
+        self.mass = 1.0
+        self.kinematic = False
+
+
+class FakeRigidBodyConstraint:
+    """``obj.rigid_body_constraint`` surface — the slider/motor and
+    hinge parameters the cartpole rig drives."""
+
+    def __init__(self, type: str):
+        self.type = type
+        self.object1 = None
+        self.object2 = None
+        self.enabled = True
+        self.use_motor_lin = False
+        self.motor_lin_max_impulse = 0.0
+        self.motor_lin_target_velocity = 0.0
+        # pinned at creation by the simulator (see _physics_step)
+        self._pin = None
+        self._hinge_arm = None
+        self._prev_v = 0.0
+        self._theta = None
+        self._omega = 0.0
 
 
 class FakeCameraData:
@@ -138,6 +191,10 @@ class FakeObject:
         self.data = data
         self._location = np.zeros(3)
         self._rotation = Euler()
+        self._scale = np.ones(3)
+        self.rigid_body = None
+        self.rigid_body_constraint = None
+        self.active_material = None
 
     # location / rotation are assignable as tuples, mutable per-component
     @property
@@ -157,9 +214,17 @@ class FakeObject:
         self._rotation = Euler(value)
 
     @property
+    def scale(self):
+        return self._scale
+
+    @scale.setter
+    def scale(self, value):
+        self._scale = np.asarray(value, dtype=np.float64).copy()
+
+    @property
     def matrix_world(self) -> Matrix:
         m = np.eye(4)
-        m[:3, :3] = self._rotation.to_matrix3()
+        m[:3, :3] = self._rotation.to_matrix3() @ np.diag(self._scale)
         m[:3, 3] = self._location
         return Matrix(m)
 
@@ -251,6 +316,7 @@ class FakeRender:
         self.resolution_x = 1920
         self.resolution_y = 1080
         self.resolution_percentage = 100
+        self.fps = 24  # Blender default
 
 
 class FakeSceneObjects:
@@ -279,15 +345,49 @@ class FakeScene:
         self.frame_current = 1
         self.render = FakeRender()
         self.camera: FakeObject | None = None
-        self.rigidbody_world = None  # tests may attach a point_cache holder
+        self.rigidbody_world = None  # set by ops.rigidbody.world_add
         self.objects: list[FakeObject] = []
         self.collection = FakeSceneCollection(self)
+        self._phys_frame = 1
+        self._vel: dict = {}  # id(obj) -> velocity (free ACTIVE bodies)
 
     def frame_set(self, frame: int) -> None:
-        self.frame_current = int(frame)
+        frame = int(frame)
+        # frame_current updates BEFORE the pre handlers fire (handlers
+        # read scene.frame_current — the UI driver's dedup relies on it)
+        self.frame_current = frame
         dg = self._bpy.context.evaluated_depsgraph_get()
         for h in list(self._bpy.app.handlers.frame_change_pre):
             h(self, dg)
+        rb = self.rigidbody_world
+        if rb is not None and getattr(rb, "enabled", False):
+            # Blender order: pre handlers, then the scene (physics)
+            # evaluates for the new frame, then post handlers. Rewinds
+            # restart the sim from the cached start state (velocities
+            # zeroed; positions are whatever the script set).
+            df = frame - self._phys_frame
+            if df > 0:
+                if df > 10_000:  # loud, not a silent truncation
+                    raise RuntimeError(
+                        f"fake physics: frame jump of {df} exceeds the "
+                        "10k-step guard — seek in smaller increments"
+                    )
+                dt = 1.0 / self.render.fps
+                for _ in range(df):
+                    _physics_step(self, dt)
+            elif df < 0:
+                # Rewind restarts the sim from the cached start state
+                # (velocities zeroed); df == 0 is a plain re-evaluation
+                # (the common frame_set(frame_current) idiom) and keeps
+                # all dynamic state, like real Blender.
+                self._vel.clear()
+                for obj in self.objects:
+                    rc = obj.rigid_body_constraint
+                    if rc is not None:
+                        rc._prev_v = 0.0
+                        rc._omega = 0.0
+                        rc._theta = None
+        self._phys_frame = frame
         for h in list(self._bpy.app.handlers.frame_change_post):
             h(self, dg)
 
@@ -325,7 +425,118 @@ class FakeScene:
         )
 
 
+_GRAVITY = 9.81
+
+
+def _half_extent_z(obj) -> float:
+    if not isinstance(obj.data, FakeMesh) or not obj.data.vertices:
+        return 0.0
+    zs = np.array([v.co[2] for v in obj.data.vertices])
+    return float((zs.max() - zs.min()) / 2.0 * obj._scale[2])
+
+
+def _physics_step(scene, dt: float) -> None:
+    """One fixed step of the miniature rigid-body world.
+
+    Deliberately simple but honest dynamics (documented approximation,
+    NOT Bullet): gravity + rest-on-passive-plane for free ACTIVE bodies
+    (no body-body collision, no tumbling), a SLIDER constraint pinning
+    its object to x-translation with a linear motor, and a HINGE
+    modeled as a pendulum about y driven by gravity and the carrier's
+    acceleration — the classic cart-pole linkage. Enough for the
+    example physics scenes to exhibit their qualitative behavior
+    (cubes fall and come to rest; an uninverted pole stays down; an
+    inverted pole diverges and the cart responds to motor commands)."""
+    objs = scene.objects
+    plane_z = None
+    for o in objs:
+        if o.rigid_body is not None and o.rigid_body.type == "PASSIVE":
+            top = o._location[2] + _half_extent_z(o)
+            plane_z = top if plane_z is None else max(plane_z, top)
+
+    constrained: set = set()
+    sliders = []
+    hinges = []
+    for o in objs:
+        rc = o.rigid_body_constraint
+        if rc is None or not rc.enabled:
+            continue
+        if rc.type == "SLIDER" and rc.object2 is not None:
+            sliders.append((o, rc))
+            constrained.add(id(rc.object2))
+        elif rc.type == "HINGE" and rc.object2 is not None:
+            hinges.append((o, rc))
+            constrained.add(id(rc.object2))
+
+    # free ACTIVE bodies: gravity + rest on the highest passive plane
+    for o in objs:
+        rb = o.rigid_body
+        if (
+            rb is None or rb.type != "ACTIVE" or rb.kinematic
+            or id(o) in constrained
+        ):
+            continue
+        v = scene._vel.setdefault(id(o), np.zeros(3))
+        v[2] -= _GRAVITY * dt
+        o._location += v * dt
+        if plane_z is not None:
+            rest = plane_z + _half_extent_z(o)
+            if o._location[2] < rest:
+                o._location[2] = rest
+                v[:] = 0.0  # land and rest (no bounce/tumble)
+
+    # sliders: x-translation only, linear motor sets velocity
+    for holder, rc in sliders:
+        body = rc.object2
+        if rc._pin is None:
+            rc._pin = (float(body._location[1]), float(body._location[2]))
+        v = rc.motor_lin_target_velocity if rc.use_motor_lin else rc._prev_v
+        rc._accel = (v - rc._prev_v) / dt
+        rc._prev_v = v
+        body._location[0] += v * dt
+        body._location[1], body._location[2] = rc._pin
+
+    # hinges: pendulum about y at the holder's anchor on the carrier
+    for holder, rc in hinges:
+        pole, cart = rc.object2, rc.object1
+        if rc._hinge_arm is None:
+            anchor = holder._location.copy()
+            rc._anchor_off = (
+                anchor - (cart._location if cart is not None else 0.0)
+            )
+            arm = pole._location - anchor
+            rc._hinge_arm = float(np.linalg.norm(arm)) or 1e-6
+            rc._theta = float(pole._rotation[1])
+        if rc._theta is None:
+            rc._theta = float(pole._rotation[1])
+        # carrier acceleration couples in through the pivot (the slider
+        # constraint lives on its holder empty, keyed by object2)
+        a_cart = 0.0
+        if cart is not None:
+            for _, src in sliders:
+                if src.object2 is cart:
+                    a_cart = getattr(src, "_accel", 0.0)
+        L = rc._hinge_arm
+        th = rc._theta
+        rc._omega += (
+            (_GRAVITY * math.sin(th) - a_cart * math.cos(th)) / L
+        ) * dt
+        rc._theta = th + rc._omega * dt
+        pole._rotation[1] = rc._theta
+        anchor = (
+            cart._location + rc._anchor_off
+            if cart is not None else rc._anchor_off
+        )
+        # in place: obj.location references must keep tracking the body
+        pole._location[:] = anchor + np.array(
+            [L * math.sin(rc._theta), 0.0, L * math.cos(rc._theta)]
+        )
+
+
 class FakeViewLayer:
+    def __init__(self):
+        self.objects = types.SimpleNamespace(active=None)
+
     def update(self) -> None:  # matrices recompute lazily; nothing cached
         pass
 
@@ -407,7 +618,6 @@ class FakeContext:
     def __init__(self, bpy_mod, background: bool):
         self.scene = FakeScene(bpy_mod)
         self.view_layer = FakeViewLayer()
-        self.active_object: FakeObject | None = None
         self.region = None if background else FakeRegion()
         self._depsgraph = FakeDepsgraph()
         # --background has no windows: find_first_view3d must fail there
@@ -417,6 +627,16 @@ class FakeContext:
             self.screen, with_windows=not background
         )
         self.collection = self.scene.collection
+
+    # context.active_object and view_layer.objects.active are the same
+    # thing in Blender; keep one source of truth.
+    @property
+    def active_object(self):
+        return self.view_layer.objects.active
+
+    @active_object.setter
+    def active_object(self, obj):
+        self.view_layer.objects.active = obj
 
     def evaluated_depsgraph_get(self) -> FakeDepsgraph:
         return self._depsgraph
@@ -429,17 +649,12 @@ class _MeshOps:
     def __init__(self, bpy_mod):
         self._bpy = bpy_mod
 
-    def primitive_cube_add(self, size: float = 2.0,
-                           location=(0.0, 0.0, 0.0), **_kw):
+    def _add(self, base_name, verts, location):
         bpy = self._bpy
-        h = size / 2.0
-        verts = [
-            (x, y, z) for x in (-h, h) for y in (-h, h) for z in (-h, h)
-        ]
-        name = "Cube"
+        name = base_name
         n = 1
         while name in bpy.data.objects:
-            name, n = f"Cube.{n:03d}", n + 1
+            name, n = f"{base_name}.{n:03d}", n + 1
         mesh = FakeMesh(name, verts)
         bpy.data.meshes._append(mesh)
         obj = FakeObject(name, mesh)
@@ -447,6 +662,49 @@ class _MeshOps:
         bpy.data.objects._append(obj)
         bpy.context.collection.objects.link(obj)
         bpy.context.active_object = obj
+        return {"FINISHED"}
+
+    def primitive_cube_add(self, size: float = 2.0,
+                           location=(0.0, 0.0, 0.0), **_kw):
+        h = size / 2.0
+        verts = [
+            (x, y, z) for x in (-h, h) for y in (-h, h) for z in (-h, h)
+        ]
+        return self._add("Cube", verts, location)
+
+    def primitive_plane_add(self, size: float = 2.0,
+                            location=(0.0, 0.0, 0.0), **_kw):
+        h = size / 2.0
+        verts = [(x, y, 0.0) for x in (-h, h) for y in (-h, h)]
+        return self._add("Plane", verts, location)
+
+
+class _RigidbodyOps:
+    def __init__(self, bpy_mod):
+        self._bpy = bpy_mod
+
+    def world_add(self, **_kw):
+        scene = self._bpy.context.scene
+        scene.rigidbody_world = types.SimpleNamespace(
+            enabled=True,
+            point_cache=types.SimpleNamespace(
+                frame_start=scene.frame_start, frame_end=scene.frame_end
+            ),
+        )
+        return {"FINISHED"}
+
+    def object_add(self, type: str = "ACTIVE", **_kw):
+        obj = self._bpy.context.active_object
+        assert obj is not None, "rigidbody.object_add needs an active object"
+        obj.rigid_body = FakeRigidBody(type)
+        return {"FINISHED"}
+
+    def constraint_add(self, type: str = "FIXED", **_kw):
+        obj = self._bpy.context.active_object
+        assert obj is not None, (
+            "rigidbody.constraint_add needs an active object"
+        )
+        obj.rigid_body_constraint = FakeRigidBodyConstraint(type)
         return {"FINISHED"}
 
 
@@ -526,7 +784,7 @@ def _build_bpy(background: bool, default_scene: bool) -> types.ModuleType:
     data = types.SimpleNamespace(
         objects=FakeCollection(FakeObject),
         meshes=FakeCollection(FakeMesh),
-        materials=FakeCollection(),
+        materials=FakeCollection(FakeMaterial),
         images=FakeCollection(),
         cameras=FakeCollection(FakeCameraData),
     )
@@ -534,7 +792,8 @@ def _build_bpy(background: bool, default_scene: bool) -> types.ModuleType:
     bpy.data = data
     bpy.context = FakeContext(bpy, background=background)
     bpy.ops = types.SimpleNamespace(
-        mesh=_MeshOps(bpy), screen=_ScreenOps(bpy)
+        mesh=_MeshOps(bpy), screen=_ScreenOps(bpy),
+        rigidbody=_RigidbodyOps(bpy),
     )
     bpy.types = types.SimpleNamespace(
         Camera=FakeCameraData, Object=FakeObject, Mesh=FakeMesh,
@@ -594,7 +853,8 @@ def reset(background: bool | None = None,
                  "_background", "_default_scene"):
         setattr(bpy, attr, getattr(fresh, attr))
     # ops/context captured the fresh module; point them back at the live one
-    bpy.ops.mesh._bpy = bpy
-    bpy.ops.screen._bpy = bpy
+    for op_ns in vars(bpy.ops).values():
+        if hasattr(op_ns, "_bpy"):
+            op_ns._bpy = bpy
     bpy.context.scene._bpy = bpy
     return bpy
