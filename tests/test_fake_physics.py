@@ -1,0 +1,128 @@
+"""Focused regressions for the fake runtime's math and rigid-body
+semantics (blendjax.testing.fake_bpy) — locks in the contracts the
+scene tests exercise indirectly: euler/matrix consistency, in-place
+location tracking, and frame_set's rewind-vs-reevaluation rule."""
+
+import math
+
+import numpy as np
+import pytest
+
+from blendjax.testing import install_fake_bpy, reset_fake_bpy
+
+
+@pytest.fixture()
+def bpy():
+    mod = install_fake_bpy(background=True)
+    reset_fake_bpy(background=True)
+    return mod
+
+
+def test_euler_matrix_roundtrip(bpy):
+    """to_euler('XYZ') inverts Euler.to_matrix3 across the non-gimbal
+    range, including through object matrix_world with scale applied."""
+    obj = bpy.data.objects.new("Probe")
+    bpy.context.collection.objects.link(obj)
+    rng = np.random.default_rng(0)
+    for _ in range(50):
+        e = rng.uniform((-np.pi, -np.pi / 2 + 0.1, -np.pi),
+                        (np.pi, np.pi / 2 - 0.1, np.pi))
+        obj.rotation_euler = e
+        obj.scale = rng.uniform(0.5, 2.0, 3)
+        got = obj.matrix_world.to_euler("XYZ")
+        np.testing.assert_allclose(list(got), e, atol=1e-9)
+
+
+def test_matrix_translation_tracks_location(bpy):
+    obj = bpy.data.objects.new("Probe")
+    bpy.context.collection.objects.link(obj)
+    obj.location = (1.0, -2.0, 3.0)
+    np.testing.assert_array_equal(
+        obj.matrix_world.translation, [1.0, -2.0, 3.0]
+    )
+
+
+def _falling_cube(bpy, z=10.0):
+    bpy.ops.rigidbody.world_add()
+    bpy.ops.mesh.primitive_plane_add(size=40)
+    bpy.ops.rigidbody.object_add(type="PASSIVE")
+    bpy.ops.mesh.primitive_cube_add(size=1.0, location=(0, 0, z))
+    cube = bpy.context.active_object
+    bpy.ops.rigidbody.object_add(type="ACTIVE")
+    return cube
+
+
+def test_reevaluation_keeps_velocity_rewind_resets_it(bpy):
+    """frame_set(frame_current) is a plain re-evaluation (dynamic state
+    survives — the common depsgraph-refresh idiom); seeking backward is
+    a rewind (velocities zero, like Blender resuming from the cache)."""
+    cube = _falling_cube(bpy)
+    scene = bpy.context.scene
+    for f in range(2, 12):
+        scene.frame_set(f)
+    z10 = float(cube.location[2])
+    v = scene._vel[id(cube)].copy()
+    assert v[2] < 0  # falling
+
+    scene.frame_set(scene.frame_current)  # re-evaluation: state kept
+    np.testing.assert_array_equal(scene._vel[id(cube)], v)
+    assert float(cube.location[2]) == z10
+
+    scene.frame_set(12)  # continues from the kept velocity
+    assert float(cube.location[2]) < z10
+
+    cube.location = (0, 0, 10.0)
+    scene.frame_set(1)  # rewind: velocities cleared
+    assert id(cube) not in scene._vel
+    scene.frame_set(2)
+    # first post-rewind step starts from rest: the step RAN (nonzero
+    # drop) but from zero velocity (small displacement only)
+    assert 0.0 < 10.0 - float(cube.location[2]) < 0.1
+
+
+def test_location_reference_tracks_hinge_body(bpy):
+    """obj.location references stay live through physics (in-place
+    mutation contract — a cached Vector tracks the object in Blender)."""
+    bpy.ops.rigidbody.world_add()
+    bpy.ops.mesh.primitive_cube_add(size=1.0, location=(0, 0, 1.0))
+    cart = bpy.context.active_object
+    bpy.ops.rigidbody.object_add(type="ACTIVE")
+    bpy.ops.mesh.primitive_cube_add(size=1.0, location=(0, 0, 2.0))
+    pole = bpy.context.active_object
+    bpy.ops.rigidbody.object_add(type="ACTIVE")
+    hinge = bpy.data.objects.new("Hinge")
+    hinge.location = (0, 0, 1.5)
+    bpy.context.collection.objects.link(hinge)
+    bpy.context.view_layer.objects.active = hinge
+    bpy.ops.rigidbody.constraint_add(type="HINGE")
+    hinge.rigid_body_constraint.object1 = cart
+    hinge.rigid_body_constraint.object2 = pole
+
+    pole.rotation_euler[1] = 0.3
+    cached = pole.location  # grabbed BEFORE physics runs
+    scene = bpy.context.scene
+    for f in range(2, 10):
+        scene.frame_set(f)
+    assert cached is pole.location  # same live array
+    assert abs(float(cached[0])) > 1e-3  # pendulum swung; cache tracked
+
+
+def test_oversized_frame_jump_fails_loudly(bpy):
+    """Seeks past the physics step guard raise instead of silently
+    truncating the simulated span."""
+    _falling_cube(bpy)
+    scene = bpy.context.scene
+    scene.frame_set(2)
+    with pytest.raises(RuntimeError, match="frame jump"):
+        scene.frame_set(scene.frame_current + 20_000)
+
+
+def test_visibility_unaffected_by_default_scene_flag(bpy):
+    """install/reset honor default_scene switching in place (prior
+    imports keep working; the graph actually swaps)."""
+    assert len(bpy.data.objects) == 0
+    reset_fake_bpy(default_scene=True)
+    assert "Cube" in bpy.data.objects and "Camera" in bpy.data.objects
+    assert bpy.context.scene.camera is bpy.data.objects["Camera"]
+    reset_fake_bpy(default_scene=False)
+    assert len(bpy.data.objects) == 0
