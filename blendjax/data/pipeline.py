@@ -546,9 +546,9 @@ class TileStreamDecoder:
         reference digest (no-op single-process and on re-checks)."""
         if name in self._mh_checked:
             return
-        self._mh_checked[name] = digest
         jax = _require_jax()
         if jax.process_count() <= 1:
+            self._mh_checked[name] = digest
             return
         from jax.experimental import multihost_utils
 
@@ -572,6 +572,10 @@ class TileStreamDecoder:
                 "against the wrong content. Pin one scene background "
                 "across all hosts."
             )
+        # Record only after the fleet agrees: a caller that catches the
+        # divergence error and keeps iterating stays checked (and keeps
+        # failing) instead of silently passing from then on.
+        self._mh_checked[name] = digest
 
     def _host_stage_multihost(self, hb, names, btid):
         """Tile batch -> per-field global assembly plan (multihost,

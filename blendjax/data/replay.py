@@ -260,9 +260,15 @@ class LegacyBtrReader:
         if idx >= self._warm:
             # Populate memo entries messages [warm, idx) contributed —
             # required before any later message's memo refs resolve.
+            # _warm advances only per successful load: a truncated tail
+            # message re-raises its own error on every retry instead of
+            # leaving later reads to fail with 'Memo value not found'.
             for j in range(self._warm, idx):
                 self._load_at(j)
+                self._warm = j + 1
+            obj = self._load_at(idx)
             self._warm = idx + 1
+            return obj
         return self._load_at(idx)
 
     def close(self):

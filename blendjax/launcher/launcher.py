@@ -216,8 +216,10 @@ class ProcessLauncher:
             # would swallow the FileNotFoundError a bad producer command
             # raises on the direct path — keep that contract by checking
             # the real target up front.
+            # Resolve against the PATH the shim's execvp will actually
+            # use (the env dict's), not the launcher's own.
             exe = str(argv[0])
-            if shutil.which(exe) is None:
+            if shutil.which(exe, path=env.get("PATH", os.defpath)) is None:
                 raise FileNotFoundError(
                     f"producer command not found or not executable: {exe!r}"
                 )

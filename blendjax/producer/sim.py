@@ -162,6 +162,7 @@ class Rasterizer:
             if triangles.size == 0 or (
                 cv.dtype == np.uint8
                 and cv.ndim == 2
+                and cv.shape[1] in (3, 4)
                 and len(cv) == len(triangles)
             ):
                 return self._render_frame_native(
