@@ -17,9 +17,18 @@ try:  # gymnasium is an optional dependency (reference guards gym the
         GymnasiumRemoteEnv,
         OpenAIRemoteEnv,
     )
+    from blendjax.env.registry import register_envs
+
+    # Reference parity: importing the env package makes
+    # ``gymnasium.make('blendjax/Cartpole-v0')`` (and the legacy
+    # ``blendtorch-cartpole-v0`` alias) work, the way importing
+    # ``cartpole_gym`` registered the reference's env
+    # (``examples/control/cartpole_gym/__init__.py:3-6``).
+    register_envs()
 except ImportError:  # pragma: no cover
     GymnasiumRemoteEnv = None
     OpenAIRemoteEnv = None
+    register_envs = None
 
 __all__ = [
     "RemoteEnv",
@@ -29,4 +38,5 @@ __all__ = [
     "BatchedRemoteEnv",
     "create_renderer",
     "RENDER_BACKENDS",
+    "register_envs",
 ]

@@ -1,0 +1,71 @@
+"""Gymnasium registry entries for the built-in environments.
+
+Reference parity: the reference registers its cartpole so user code can
+``gym.make('blendtorch-cartpole-v0')``
+(``examples/control/cartpole_gym/__init__.py:3-6``, consumed at
+``examples/control/cartpole.py:28``). blendjax registers the Gymnasium
+equivalent at ``import blendjax.env`` time, plus the reference-shaped id
+as an alias, so migrating ``make``-based code keeps working.
+
+Registered ids:
+
+- ``blendjax/Cartpole-v0`` — canonical.
+- ``blendtorch-cartpole-v0`` — legacy alias (same factory).
+
+Both launch the packaged headless producer
+(:mod:`blendjax.producer.scripts.cartpole`) through the production
+launcher path; ``gymnasium.make`` kwargs pass through to the factory
+(e.g. ``real_time=True``, ``render_mode='rgb_array'``, ``seed=7``).
+"""
+
+from __future__ import annotations
+
+import os
+
+import gymnasium
+import numpy as np
+
+from blendjax.env.gymnasium_adapter import GymnasiumRemoteEnv
+
+CARTPOLE_SCRIPT = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "producer", "scripts", "cartpole.py",
+)
+
+def make_cartpole(render_mode: str | None = None, **kwargs):
+    """Factory behind both cartpole registry ids."""
+    render_every = kwargs.pop("render_every", 0)
+    # Both render modes need the producer to actually render frames
+    # (human mode displays the same rgb_array stream).
+    if render_mode in ("rgb_array", "human") and not render_every:
+        render_every = 1
+    launch_kwargs = dict(kwargs)
+    if render_every:
+        launch_kwargs["render_every"] = render_every
+    # Unbounded obs space: the terminal observation legitimately lands
+    # outside the termination box (|theta| > 0.4, |x| > 3.0), so bounded
+    # Box limits would trip Gymnasium's passive env checker. The action
+    # is the motor velocity — unbounded like the reference's motor
+    # constraint (``cartpole.blend.py:38-43``).
+    return GymnasiumRemoteEnv(
+        script=CARTPOLE_SCRIPT,
+        observation_space=gymnasium.spaces.Box(
+            -np.inf, np.inf, shape=(4,), dtype=np.float32
+        ),
+        action_space=gymnasium.spaces.Box(
+            -np.inf, np.inf, shape=(1,), dtype=np.float32
+        ),
+        render_mode=render_mode,
+        **launch_kwargs,
+    )
+
+
+def register_envs() -> None:
+    """Idempotently register the built-in envs with Gymnasium."""
+    for env_id in ("blendjax/Cartpole-v0", "blendtorch-cartpole-v0"):
+        if env_id not in gymnasium.registry:
+            gymnasium.register(
+                id=env_id,
+                entry_point="blendjax.env.registry:make_cartpole",
+                max_episode_steps=500,
+            )

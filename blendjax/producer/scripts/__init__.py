@@ -1,0 +1,12 @@
+"""Packaged producer scripts for registry-made environments.
+
+The Gymnasium registry (:mod:`blendjax.env.registry`) needs producer
+scripts that exist wherever blendjax is installed — not only in an
+examples checkout — so the built-in environments live here, under
+:mod:`blendjax.producer` (NOT :mod:`blendjax.env`): producer processes
+import this package, and the env package's import-time Gymnasium
+registration must never ride along into every spawned producer. Each
+module is both importable (tests reuse the env classes) and runnable as
+a launcher script (the launcher spawns the file path directly with the
+package root on ``PYTHONPATH``).
+"""

@@ -1,0 +1,78 @@
+"""Producer script: a remote-controlled cartpole environment.
+
+Headless counterpart of the reference's ``examples/control/
+cartpole.blend.py`` (physics cartpole whose motor velocity is the remote
+action, ``cartpole.blend.py:38-43``): physics run in
+:class:`blendjax.producer.sim.CartpoleScene`, the episode/RPC machinery is
+the standard BaseEnv + RemoteControlledAgent pair.
+
+Packaged (rather than examples-only) so the Gymnasium registry entry
+``blendjax/Cartpole-v0`` resolves on any install
+(:mod:`blendjax.env.registry`, which launches this file directly); the
+reference kept its equivalent inside
+``examples/control/cartpole_gym/envs/``.
+
+Flags: ``--real-time`` switches the agent to free-running mode;
+``--render-every N`` attaches the scene renderer for rgb_array frames.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+import numpy as np
+
+from blendjax.transport import term_context
+from blendjax.producer import BaseEnv, RemoteControlledAgent, parse_launch_args
+from blendjax.producer.sim import CartpoleScene, SimEngine
+
+
+class CartpoleEnv(BaseEnv):
+    def __init__(self, agent, scene: CartpoleScene):
+        super().__init__(agent)
+        self.scene = scene
+
+    def _env_reset(self):
+        self.scene.reset()
+
+    def _env_prepare_step(self, action):
+        self.scene.apply_motor(float(np.asarray(action).reshape(())))
+
+    def _env_post_step(self):
+        x, x_dot, th, th_dot = self.scene.state
+        done = bool(abs(th) > 0.4 or abs(x) > 3.0)
+        return {
+            "obs": self.scene.observation_vector(),
+            "reward": 0.0 if done else 1.0,
+            "done": done,
+        }
+
+    def _default_renderer(self):
+        return self.scene.render
+
+
+def main() -> None:
+    args, remainder = parse_launch_args(sys.argv)
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--real-time", action="store_true", default=False)
+    ap.add_argument("--no-real-time", dest="real_time", action="store_false")
+    ap.add_argument("--render-every", type=int, default=0)
+    opts = ap.parse_args(remainder)
+
+    scene = CartpoleScene(seed=args.btseed)
+    agent = RemoteControlledAgent(
+        args.btsockets["GYM"], real_time=opts.real_time
+    )
+    env = CartpoleEnv(agent, scene)
+    if opts.render_every > 0:
+        env.attach_default_renderer(every_nth=opts.render_every)
+    try:
+        env.run(SimEngine(scene))
+    finally:
+        agent.close()
+        term_context()
+
+
+if __name__ == "__main__":
+    main()

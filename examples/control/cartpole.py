@@ -2,20 +2,19 @@
 
 Mirrors the reference example (``examples/control/cartpole.py:19-39``: a
 proportional controller on pole angle driving the motor velocity through
-the gym API), against the headless producer here.
+``gym.make('blendtorch-cartpole-v0')``), against the registered headless
+env here — ``import blendjax.env`` registers ``blendjax/Cartpole-v0``
+(and the legacy reference-shaped alias) with Gymnasium.
 
 Run: ``python examples/control/cartpole.py``
 """
 
 from __future__ import annotations
 
-import os
-
+import gymnasium
 import numpy as np
 
-from blendjax.env import launch_env
-
-SCRIPT = os.path.join(os.path.dirname(__file__), "cartpole_producer.py")
+import blendjax.env  # noqa: F401  (registers blendjax/Cartpole-v0)
 
 
 def control(obs) -> float:
@@ -25,19 +24,24 @@ def control(obs) -> float:
     return float(8.0 * theta + 1.0 * theta_dot + 0.2 * x)
 
 
-def main() -> None:
-    with launch_env(script=SCRIPT, seed=3) as env:
+def main(steps_total: int = 300) -> None:
+    env = gymnasium.make("blendjax/Cartpole-v0", seed=3, proto="ipc")
+    try:
         obs, _ = env.reset()
         total, steps = 0.0, 0
-        for _ in range(300):
-            obs, reward, done, info = env.step(control(obs))
+        for _ in range(steps_total):
+            obs, reward, terminated, truncated, info = env.step(
+                np.array([control(obs)], np.float32)
+            )
             total += reward
             steps += 1
-            if done:
+            if terminated or truncated:
                 print(f"episode end after {steps} steps, return {total}")
                 obs, _ = env.reset()
                 total, steps = 0.0, 0
         print(f"final: {steps} steps balanced, return {total}")
+    finally:
+        env.close()
 
 
 if __name__ == "__main__":

@@ -20,7 +20,10 @@ def main():
     ) as launcher:
         with StreamDataPipeline(
             launcher.addresses["DATA"],
-            batch_size=4,
+            # batch 8 = the reference benchmark's batch; batches shard
+            # over the data axis, so batch_size must be a multiple of
+            # the mesh size (1/2/4/8-device meshes all divide 8).
+            batch_size=8,
             sharding=batch_sharding(mesh),
             launcher=launcher,
         ) as pipe:

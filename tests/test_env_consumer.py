@@ -123,6 +123,38 @@ def test_gymnasium_adapter_api():
 @pytest.mark.skipif(
     pytest.importorskip("gymnasium") is None, reason="gymnasium missing"
 )
+def test_gymnasium_make_registry_round_trip():
+    """Registry parity (reference ``cartpole_gym/__init__.py:3-6``):
+    ``gymnasium.make`` on the registered id launches and steps the
+    headless cartpole; the legacy blendtorch-shaped alias resolves to
+    the same factory."""
+    import gymnasium
+
+    import blendjax.env  # noqa: F401  (import registers the envs)
+
+    assert "blendjax/Cartpole-v0" in gymnasium.registry
+    assert "blendtorch-cartpole-v0" in gymnasium.registry
+    spec = gymnasium.registry["blendtorch-cartpole-v0"]
+    assert spec.entry_point == gymnasium.registry[
+        "blendjax/Cartpole-v0"
+    ].entry_point
+
+    env = gymnasium.make("blendjax/Cartpole-v0", seed=4, proto="ipc")
+    try:
+        obs, info = env.reset()
+        assert np.asarray(obs).shape == (4,)
+        for _ in range(5):
+            obs, reward, terminated, truncated, info = env.step(
+                np.zeros(1, np.float32)
+            )
+            assert reward == 1.0 and not terminated and not truncated
+    finally:
+        env.close()
+
+
+@pytest.mark.skipif(
+    pytest.importorskip("gymnasium") is None, reason="gymnasium missing"
+)
 def test_openai_compat_shim_classic_call_shape():
     """OpenAIRemoteEnv restores the reference's classic-gym call shape
     (``btt/env.py:195-313``): reset -> obs, step -> (obs, reward, done,
