@@ -11,6 +11,7 @@ latent bug); here both paths just work.
 from __future__ import annotations
 
 import json
+import os
 from contextlib import nullcontext
 from dataclasses import dataclass, field
 
@@ -35,11 +36,20 @@ class LaunchInfo:
         )
 
     def save_json(self, file) -> None:
-        """Write to a path or an open file-like object."""
-        ctx = open(file, "w") if isinstance(file, (str, bytes)) or hasattr(
-            file, "__fspath__"
-        ) else nullcontext(file)
-        with ctx as f:
+        """Write to a path or an open file-like object.
+
+        Path writes are ATOMIC (temp file + ``os.replace``): the
+        two-machine workflow polls for this file and reads it the moment
+        it appears (``apps.py``; reference ``apps/launch.py:40``), so a
+        partially-flushed JSON must never be observable."""
+        if isinstance(file, (str, bytes)) or hasattr(file, "__fspath__"):
+            path = os.fspath(file)
+            tmp = f"{path}.tmp.{os.getpid()}"
+            with open(tmp, "w") as f:
+                f.write(self.to_json())
+            os.replace(tmp, path)
+            return
+        with nullcontext(file) as f:
             f.write(self.to_json())
 
     @staticmethod

@@ -93,6 +93,7 @@ def test_train_transformer(monkeypatch, capsys):
 
 
 def test_cartpole_controller(monkeypatch, capsys):
+    pytest.importorskip("gymnasium")  # the example drives gymnasium.make
     mod = load_example("control/cartpole.py")
     mod.main(steps_total=40)
     out = capsys.readouterr().out
