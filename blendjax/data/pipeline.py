@@ -239,6 +239,8 @@ class TileStreamDecoder:
         self._decode_chunk = None
         self._decode_mh = None
         self._decode_mh_chunk = None
+        self._decode_pal = None
+        self._decode_pal_chunk = None
 
     def reset(self) -> None:
         """Drop queued per-batch decode plans (call when re-iterating a
@@ -307,6 +309,7 @@ class TileStreamDecoder:
         jax = _require_jax()
         group: dict = {}
         mh_group: dict = {}  # multihost chunk>1 buffering (lockstep flush)
+        pal_group: dict = {}  # chunk>1 full-frame palette grouping
         for hb in host_batches:
             btid = hb.get("btid")
             new_refs: dict = {}
@@ -347,6 +350,62 @@ class TileStreamDecoder:
                     if s is not None:
                         ref_tiles = jax.device_put(ref_tiles, s)
                 self._refs[key] = ref_tiles
+            pal_groups = T.pop_frame_palette_batches(hb)
+            if pal_groups:
+                if self.multihost or self.emit_packed:
+                    # Correctness-first fallback: expand on host and let
+                    # the batch ride the existing raw paths (multihost
+                    # global assembly). The device-gather paths below
+                    # are the single-host configurations the non-sparse
+                    # codec targets.
+                    for name, (h_, w_, c_, bits) in pal_groups:
+                        key = name + (
+                            T.FRAMEPAL4_SUFFIX if bits == 4
+                            else T.FRAMEPAL8_SUFFIX
+                        )
+                        hb[name] = T.expand_palette_frames_np(
+                            hb.pop(key), hb.pop(name + T.PALETTE_SUFFIX),
+                            bits, h_, w_, c_,
+                        )
+                else:
+                    arrays = {
+                        k: v for k, v in hb.items()
+                        if isinstance(v, np.ndarray)
+                    }
+                    rest = {k: v for k, v in hb.items() if k not in arrays}
+                    with metrics.span("tiles.pack"):
+                        buf, spec = T.pack_fields(arrays)
+                    metrics.count("pal.batches")
+                    metrics.count("pal.wire_bytes", int(buf.nbytes))
+                    for name, (h_, w_, c_, bits) in pal_groups:
+                        lead = int(arrays[name + (
+                            T.FRAMEPAL4_SUFFIX if bits == 4
+                            else T.FRAMEPAL8_SUFFIX
+                        )].shape[0])
+                        metrics.count(
+                            "pal.decoded_bytes", int(h_ * w_ * c_) * lead
+                        )
+                    if self.chunk == 1:
+                        self._plans.append(
+                            ("pal", spec, rest, tuple(pal_groups))
+                        )
+                        yield {"__packed__": buf}
+                        continue
+                    # chunk>1: coalesce K packed pal batches into ONE
+                    # stacked transfer + one scanned step, exactly like
+                    # the tile chunk path (the non-sparse row is
+                    # op-latency bound on tunneled links: K transfers +
+                    # K step dispatches collapse K-fold).
+                    gkey = (spec, tuple(pal_groups))
+                    if pal_group and pal_group["key"] != gkey:
+                        yield from self._flush_pal_group(pal_group)
+                    if not pal_group:
+                        pal_group.update(key=gkey, bufs=[], rests=[])
+                    pal_group["bufs"].append(buf)
+                    pal_group["rests"].append(rest)
+                    if len(pal_group["bufs"]) == self.chunk:
+                        yield from self._flush_pal_group(pal_group)
+                    continue
             groups = T.pop_tile_batches(hb)
             names = []
             missing = False
@@ -401,6 +460,7 @@ class TileStreamDecoder:
                         )
                     yield from self._flush_group(group)
                     yield from self._flush_mh_group(mh_group)
+                    yield from self._flush_pal_group(pal_group)
                     # Surfaced in the bench/metrics report: a fleet whose
                     # chunk groups silently degrade to K'=1 loses ~10x
                     # throughput, and one log line is easy to miss.
@@ -468,6 +528,20 @@ class TileStreamDecoder:
                 yield from self._flush_group(group)
         yield from self._flush_group(group)
         yield from self._flush_mh_group(mh_group)
+        yield from self._flush_pal_group(pal_group)
+
+    def _flush_pal_group(self, pal_group):
+        """Emit a buffered palette chunk group (possibly shorter than
+        ``chunk``) as one stacked packed transfer; no-op when empty."""
+        if not pal_group:
+            return
+        spec, pal_groups = pal_group["key"]
+        self._plans.append(
+            ("palchunk", spec, pal_group["rests"], pal_groups)
+        )
+        stacked = np.stack(pal_group["bufs"])
+        pal_group.clear()
+        yield {"__packed__": stacked}
 
     def _mh_fields(self, hb, names, btid):
         """Shared multihost prep: split ndarray fields from sidecars,
@@ -725,6 +799,37 @@ class TileStreamDecoder:
             self._decode_mh = jax.jit(
                 _decode_fields, static_argnames=("names", "geoms")
             )
+        if self._decode_pal is None:
+
+            def _decode_pal(packed, spec, pal_groups):
+                fields = T.unpack_fields(packed, spec)
+                for name, (h_, w_, c_, bits) in pal_groups:
+                    key = name + (
+                        T.FRAMEPAL4_SUFFIX if bits == 4
+                        else T.FRAMEPAL8_SUFFIX
+                    )
+                    pk = fields.pop(key)
+                    pal = fields.pop(name + T.PALETTE_SUFFIX)
+                    fields[name] = T.expand_palette_frames(
+                        pk, pal, bits, h_, w_, c_
+                    )
+                return fields
+
+            self._decode_pal = jax.jit(
+                _decode_pal, static_argnames=("spec", "pal_groups")
+            )
+
+            def _decode_pal_chunk(stacked, spec, pal_groups):
+                # (K', total) stacked packed buffers -> (K', B, ...)
+                # superbatch fields; each group member gathers through
+                # its OWN palette (vmap over the chunk axis).
+                return jax.vmap(
+                    lambda p: _decode_pal(p, spec, pal_groups)
+                )(stacked)
+
+            self._decode_pal_chunk = jax.jit(
+                _decode_pal_chunk, static_argnames=("spec", "pal_groups")
+            )
         if self._decode_mh_chunk is None:
             mesh, axis = self._decode_mesh()
 
@@ -782,6 +887,37 @@ class TileStreamDecoder:
                 self._pin_superbatch(fields)
                 fields["_meta"] = rests
                 yield fields
+                continue
+            if plan is not None and plan[0] == "pal":
+                _, spec, rest, pal_groups = plan
+                with metrics.span("decode.dispatch"):
+                    fields = self._decode_pal(
+                        db.pop("__packed__"), spec=spec,
+                        pal_groups=pal_groups,
+                    )
+                # packed buffer travels unsharded: reshard decoded fields
+                # to their configured layouts (no-op on one device)
+                for k, v in fields.items():
+                    s = self._field_sharding(k)
+                    if s is not None and getattr(v, "ndim", 0) >= len(
+                        getattr(s, "spec", ()) or ()
+                    ):
+                        fields[k] = jax.device_put(v, s)
+                db.update(rest)
+                db.update(fields)
+                yield db
+                continue
+            if plan is not None and plan[0] == "palchunk":
+                _, spec, rests, pal_groups = plan
+                with metrics.span("decode.dispatch"):
+                    fields = self._decode_pal_chunk(
+                        db.pop("__packed__"), spec=spec,
+                        pal_groups=pal_groups,
+                    )
+                self._pin_superbatch(fields)
+                db["_meta"] = rests
+                db.update(fields)
+                yield db
                 continue
             if plan is not None and plan[0] == "raw1":
                 # Mixed-stream degradation (chunk_strict=False): lift the

@@ -43,7 +43,7 @@ class LaunchInfo:
         it appears (``apps.py``; reference ``apps/launch.py:40``), so a
         partially-flushed JSON must never be observable."""
         if isinstance(file, (str, bytes)) or hasattr(file, "__fspath__"):
-            path = os.fspath(file)
+            path = os.fsdecode(file)  # bytes paths stay supported
             tmp = f"{path}.tmp.{os.getpid()}"
             with open(tmp, "w") as f:
                 f.write(self.to_json())

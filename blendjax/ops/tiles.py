@@ -54,6 +54,11 @@ TILEREF_SUFFIX = "__tileref"
 TILEPAL4_SUFFIX = "__tilepal4"   # two 4-bit palette indices per byte
 TILEPAL8_SUFFIX = "__tilepal8"   # one byte per pixel
 PALETTE_SUFFIX = "__palette"     # (cap, C) uint8, zero-padded
+# palette-compressed FULL frames (the non-sparse codec: no reference
+# frame, no temporal assumption — see palettize_frames):
+FRAMEPAL4_SUFFIX = "__framepal4"  # (B, H*W/2) nibble indices
+FRAMEPAL8_SUFFIX = "__framepal8"  # (B, H*W) byte indices
+FRAMESHAPE_SUFFIX = "__frameshape"  # [H, W, C, bits]
 
 
 def tile_grid(shape, tile: int = TILE):
@@ -377,21 +382,14 @@ def decode_tile_delta_np(ref: np.ndarray, idx: np.ndarray,
 # byte per pixel (4x). The device side is a trivial fused gather.
 
 
-def palettize_tiles(tiles: np.ndarray, max_colors: int = 256):
-    """Try to palette-compress a packed tile array (B, K, t, t, C).
-
-    Returns ``(packed, palette, bits)`` — ``packed`` is (B, K, t*t/2)
-    uint8 nibbles for ``bits=4`` or (B, K, t*t) bytes for ``bits=8``,
-    ``palette`` is (16|256, C) zero-padded — or ``None`` when the tiles
-    hold more than ``max_colors`` distinct colors (ship raw instead).
-    Runs as one native C pass when available; numpy fallback.
-    """
+def _palettize_flat(flat: np.ndarray, max_colors: int):
+    """Core palette pass over (N, C) uint8 pixels: returns
+    ``(idx (N,) uint8, palette (max_colors, C), count)`` or ``None``
+    when the pixels hold more than ``max_colors`` distinct colors.
+    One native C pass when available; numpy fallback."""
     from blendjax._native import load_palettize
 
-    max_colors = min(int(max_colors), 256)  # uint8 indices; native tables
-    b, k, t, _, c = tiles.shape
-    flat = np.ascontiguousarray(tiles).reshape(-1, c)
-    n = flat.shape[0]
+    n, c = flat.shape
     native = load_palettize()
     if native is not None:
         import ctypes
@@ -405,24 +403,111 @@ def palettize_tiles(tiles: np.ndarray, max_colors: int = 256):
         )
         if count < 0:
             return None
-    else:
-        key = np.zeros(n, np.uint32)
-        for j in range(c):
-            key |= flat[:, j].astype(np.uint32) << (8 * j)
-        uniq, idx32 = np.unique(key, return_inverse=True)
-        count = len(uniq)
-        if count > max_colors:
-            return None
-        idx = idx32.astype(np.uint8)
-        pal = np.zeros((max_colors, c), np.uint8)
-        for j in range(c):
-            pal[:count, j] = (uniq >> (8 * j)).astype(np.uint8)
+        return idx, pal, count
+    key = np.zeros(n, np.uint32)
+    for j in range(c):
+        key |= flat[:, j].astype(np.uint32) << (8 * j)
+    uniq, idx32 = np.unique(key, return_inverse=True)
+    count = len(uniq)
+    if count > max_colors:
+        return None
+    idx = idx32.astype(np.uint8)
+    pal = np.zeros((max_colors, c), np.uint8)
+    for j in range(c):
+        pal[:count, j] = (uniq >> (8 * j)).astype(np.uint8)
+    return idx, pal, count
+
+
+def palettize_tiles(tiles: np.ndarray, max_colors: int = 256):
+    """Try to palette-compress a packed tile array (B, K, t, t, C).
+
+    Returns ``(packed, palette, bits)`` — ``packed`` is (B, K, t*t/2)
+    uint8 nibbles for ``bits=4`` or (B, K, t*t) bytes for ``bits=8``,
+    ``palette`` is (16|256, C) zero-padded — or ``None`` when the tiles
+    hold more than ``max_colors`` distinct colors (ship raw instead).
+    Runs as one native C pass when available; numpy fallback.
+    """
+    max_colors = min(int(max_colors), 256)  # uint8 indices; native tables
+    b, k, t, _, c = tiles.shape
+    flat = np.ascontiguousarray(tiles).reshape(-1, c)
+    out = _palettize_flat(flat, max_colors)
+    if out is None:
+        return None
+    idx, pal, count = out
     if count <= 16 and (t * t) % 2 == 0:
         pal16 = np.zeros((16, c), np.uint8)
         pal16[: min(len(pal), 16)] = pal[:16]
         packed = ((idx[0::2] << 4) | idx[1::2]).reshape(b, k, (t * t) // 2)
         return packed, pal16, 4
     return idx.reshape(b, k, t * t), pal, 8
+
+
+def palettize_frames(frames: np.ndarray, max_colors: int = 256):
+    """Try to palette-compress FULL frames (B, H, W, C) — the lossless
+    wire+transfer codec for the non-sparse path (no reference frame, no
+    temporal assumption; only "synthetic frames carry few colors").
+
+    Returns ``(packed, palette, bits)`` — ``packed`` (B, H*W/2) uint8
+    nibbles for ``bits=4`` or (B, H*W) bytes for ``bits=8`` (4x/8x fewer
+    bytes than RGBA across BOTH the socket and the host->device link;
+    the device side is one fused gather) — or ``None`` when the batch
+    holds more than ``max_colors`` distinct colors (ship raw instead).
+    """
+    max_colors = min(int(max_colors), 256)
+    b, h, w, c = frames.shape
+    flat = np.ascontiguousarray(frames).reshape(-1, c)
+    out = _palettize_flat(flat, max_colors)
+    if out is None:
+        return None
+    idx, pal, count = out
+    if count <= 16 and (h * w) % 2 == 0:
+        pal16 = np.zeros((16, c), np.uint8)
+        pal16[: min(len(pal), 16)] = pal[:16]
+        packed = ((idx[0::2] << 4) | idx[1::2]).reshape(b, (h * w) // 2)
+        return packed, pal16, 4
+    return idx.reshape(b, h * w), pal, 8
+
+
+def expand_palette_frames(packed, palette, bits: int, h: int, w: int,
+                          c: int):
+    """Device-side inverse of :func:`palettize_frames` (jit-safe
+    gather). ``packed``: (..., H*W/2|H*W) uint8; returns
+    (..., H, W, C) uint8."""
+    import jax.numpy as jnp
+
+    lead = packed.shape[:-1]
+    if bits == 4:
+        idx = jnp.stack(
+            [packed >> 4, packed & 0xF], axis=-1
+        ).reshape(*lead, h * w)
+    else:
+        idx = packed
+    return palette[idx].reshape(*lead, h, w, c)
+
+
+def expand_palette_frames_np(packed, palette, bits: int, h: int, w: int,
+                             c: int):
+    """Host (numpy) twin of :func:`expand_palette_frames`."""
+    lead = packed.shape[:-1]
+    if bits == 4:
+        idx = np.stack(
+            [packed >> 4, packed & 0xF], axis=-1
+        ).reshape(*lead, h * w)
+    else:
+        idx = packed
+    return palette[idx].reshape(*lead, h, w, c)
+
+
+def pop_frame_palette_batches(hb: dict):
+    """Detect+pop full-frame palette batches from a host batch: returns
+    ``[(name, (h, w, c, bits))]`` and removes each ``name__frameshape``
+    sidecar (the payload/palette fields stay for the decode stage)."""
+    out = []
+    for key in [k for k in hb if k.endswith(FRAMESHAPE_SUFFIX)]:
+        name = key[: -len(FRAMESHAPE_SUFFIX)]
+        h, w, c, bits = (int(v) for v in hb.pop(key))
+        out.append((name, (h, w, c, bits)))
+    return out
 
 
 def expand_palette_tiles(packed, palette, bits: int, t: int, c: int):

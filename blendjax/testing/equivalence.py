@@ -111,17 +111,15 @@ def moe_per_token_reference(params, x) -> np.ndarray:
     probs /= probs.sum(-1, keepdims=True)
     idx = probs.argmax(-1)
     gate = probs.max(-1)
-    w1 = np.asarray(params["expert_wi"])
-    b1 = np.asarray(params["expert_bi"])
-    w2 = np.asarray(params["expert_wo"])
-    b2 = np.asarray(params["expert_bo"])
-
-    def gelu(v):
-        return np.asarray(jax.nn.gelu(jnp.asarray(v)))
-
-    out = np.stack([
-        gate[n] * (gelu(tokens[n] @ w1[idx[n]] + b1[idx[n]]) @ w2[idx[n]]
-                   + b2[idx[n]])
-        for n in range(tokens.shape[0])
-    ])
+    # Gather each token's expert weights, then ONE batched pass (a
+    # per-token Python loop would pay one device dispatch per token —
+    # seconds of pure latency on tunneled backends).
+    w1 = np.asarray(params["expert_wi"])[idx]   # (N, C, H)
+    b1 = np.asarray(params["expert_bi"])[idx]   # (N, H)
+    w2 = np.asarray(params["expert_wo"])[idx]   # (N, H, C)
+    b2 = np.asarray(params["expert_bo"])[idx]   # (N, C)
+    hidden = np.einsum("nc,nch->nh", tokens, w1) + b1
+    hidden = np.asarray(jax.nn.gelu(jnp.asarray(hidden)))
+    out = np.einsum("nh,nhc->nc", hidden, w2) + b2
+    out = gate[:, None] * out
     return out.reshape(np.asarray(x).shape)

@@ -1355,3 +1355,139 @@ def test_prebatched_size_mismatch_warns_once(caplog):
             np.testing.assert_array_equal(img[i], frames[start + i])
     warns = [r for r in caplog.records if "prebatched" in r.message]
     assert len(warns) == 1  # warned once, not per message
+
+
+# -- full-frame palette codec (the non-sparse path) --------------------------
+
+
+def test_palettize_frames_roundtrip_pal4_pal8_and_overflow():
+    from blendjax.ops.tiles import (
+        expand_palette_frames,
+        expand_palette_frames_np,
+        palettize_frames,
+    )
+
+    rng = np.random.default_rng(0)
+    h, w = 16, 24
+    # <=16 colors -> pal4 (8x)
+    few = rng.integers(0, 16, (4, h, w, 1), np.uint8) * 17
+    few = np.repeat(few, 4, axis=-1)
+    packed, pal, bits = palettize_frames(few)
+    assert bits == 4 and packed.shape == (4, h * w // 2)
+    np.testing.assert_array_equal(
+        expand_palette_frames_np(packed, pal, bits, h, w, 4), few
+    )
+    # <=256 colors -> pal8 (4x)
+    some = np.repeat(
+        rng.integers(0, 200, (4, h, w, 1), np.uint8), 4, axis=-1
+    )
+    packed, pal, bits = palettize_frames(some)
+    assert bits == 8 and packed.shape == (4, h * w)
+    np.testing.assert_array_equal(
+        expand_palette_frames_np(packed, pal, bits, h, w, 4), some
+    )
+    # device twin agrees
+    np.testing.assert_array_equal(
+        np.asarray(
+            jax.jit(
+                lambda p, q: __import__(
+                    "blendjax.ops.tiles", fromlist=["expand_palette_frames"]
+                ).expand_palette_frames(p, q, 8, h, w, 4)
+            )(packed, pal)
+        ),
+        some,
+    )
+    # >256 colors -> None (ship raw)
+    many = rng.integers(0, 255, (2, 32, 32, 4), np.uint8)
+    assert palettize_frames(many) is None
+
+
+def test_stream_pipeline_pal_encoding_end_to_end():
+    """--encoding pal -> ONE packed transfer per batch, decoded by a
+    device gather to bit-exact full frames (the lossless non-sparse
+    codec; VERDICT r3 next #2)."""
+    from blendjax.data import StreamDataPipeline
+    from blendjax.launcher import PythonProducerLauncher
+    from blendjax.producer.sim import CubeScene
+    from blendjax.utils.metrics import metrics as reg
+
+    mesh = Mesh(np.array(jax.devices()).reshape(-1), axis_names=("data",))
+    sharding = NamedSharding(mesh, P("data"))
+    seed = 7
+    reg.reset()
+    with PythonProducerLauncher(
+        script=PRODUCER,
+        num_instances=1,
+        named_sockets=["DATA"],
+        seed=seed,
+        instance_args=[
+            ["--shape", "64", "64", "--batch", "8", "--encoding", "pal"]
+        ],
+    ) as launcher:
+        with StreamDataPipeline(
+            launcher.addresses["DATA"],
+            batch_size=8,
+            sharding=sharding,
+            timeoutms=30_000,
+        ) as pipe:
+            it = iter(pipe)
+            batches = [next(it) for _ in range(3)]
+
+    scene = CubeScene(shape=(64, 64), seed=seed)
+    local = {}
+    for f in range(1, 8 * len(batches) + 1):
+        scene.step(f)
+        local[f] = scene.render().copy()
+
+    for b in batches:
+        assert b["image"].shape == (8, 64, 64, 4)
+        assert b["image"].dtype == np.uint8
+        img = np.asarray(b["image"])
+        for i, f in enumerate(np.asarray(b["frameid"])):
+            np.testing.assert_array_equal(img[i], local[int(f)])
+    # wire accounting: the codec actually compressed (cube scene fits
+    # pal4 => ~8x; assert a conservative 3x to stay weather-proof)
+    wire = reg.counters.get("pal.wire_bytes", 0)
+    decoded = reg.counters.get("pal.decoded_bytes", 0)
+    assert decoded and wire and decoded / wire > 3.0
+
+
+def test_pal_stream_chunk_mode_superbatch_bit_exact():
+    """chunk>1 coalesces K packed pal batches into ONE stacked transfer
+    decoded to a (K, B, ...) superbatch — bit-exact per frame, each
+    group member through its own palette (the non-sparse row's
+    op-latency fix: K transfers + K dispatches collapse K-fold)."""
+    from blendjax.data import StreamDataPipeline
+    from blendjax.launcher import PythonProducerLauncher
+    from blendjax.producer.sim import CubeScene
+
+    mesh = Mesh(np.array(jax.devices()).reshape(-1), axis_names=("data",))
+    sharding = NamedSharding(mesh, P("data"))
+    seed = 3
+    with PythonProducerLauncher(
+        script=PRODUCER,
+        num_instances=1,
+        named_sockets=["DATA"],
+        seed=seed,
+        instance_args=[
+            ["--shape", "64", "64", "--batch", "8", "--encoding", "pal"]
+        ],
+    ) as launcher:
+        with StreamDataPipeline(
+            launcher.addresses["DATA"],
+            batch_size=8,
+            sharding=sharding,
+            chunk=2,
+            timeoutms=30_000,
+        ) as pipe:
+            it = iter(pipe)
+            sb = next(it)
+    assert sb["image"].shape == (2, 8, 64, 64, 4)  # (K, B, ...)
+    scene = CubeScene(shape=(64, 64), seed=seed)
+    local = {}
+    for f in range(1, 17):
+        scene.step(f)
+        local[f] = scene.render().copy()
+    img = np.asarray(sb["image"]).reshape(16, 64, 64, 4)
+    for i, f in enumerate(np.asarray(sb["frameid"]).reshape(-1)):
+        np.testing.assert_array_equal(img[i], local[int(f)])
