@@ -319,6 +319,106 @@ def measure_step_alone(chunk: int, calls: int = 8) -> dict:
     return {"img_s": round(best, 1), "chunk": chunk, "calls": calls}
 
 
+def measure_pipelined_ceiling(chunk: int, items: int = 512) -> dict:
+    """Runtime ceiling of the live tile path: pre-stage every wire
+    message on the HOST, then replay them through the IDENTICAL
+    production pipeline (pack -> placement ring -> decode jit -> chunked
+    step). Ingest cost drops to ~zero, so the measured wall is the
+    transfer+decode+train pipeline alone — the number the live headline
+    could reach if producer supply and ingest were free (VERDICT r3 next
+    #1: either the headline chases this, or headline ~= ceiling proves
+    the runtime's serialized dispatch is the wall).
+    """
+    import jax
+
+    from blendjax.data import StreamDataPipeline
+    from blendjax.data.stream import RemoteStream
+    from blendjax.launcher import PythonProducerLauncher
+    from blendjax.models import CubeRegressor
+    from blendjax.parallel import batch_sharding, create_mesh
+    from blendjax.train import (
+        make_chunked_supervised_step,
+        make_train_state,
+    )
+
+    producer = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)),
+        "examples", "datagen", "cube_producer.py",
+    )
+    # Capture enough real wire messages for warmup + the measured window
+    # (one producer => FIFO => the ref arrives first).
+    n_batches = (max(2, WARMUP_BATCHES // chunk) + 1) * chunk + items // BATCH
+    captured = []
+    with PythonProducerLauncher(
+        script=producer, num_instances=1, named_sockets=["DATA"], seed=0,
+        proto="ipc",
+        instance_args=[
+            ["--shape", str(SHAPE[0]), str(SHAPE[1]), "--batch", str(BATCH),
+             "--encoding", "tile", "--tile", "16", "--tile-rgba",
+             "--tile-capacity", "288"]
+        ],
+    ) as launcher:
+        stream = RemoteStream(
+            launcher.addresses["DATA"], timeoutms=60_000, copy_arrays=True
+        )
+        it = iter(stream)
+        while len(captured) < n_batches:
+            captured.append(next(it))
+        it.close()  # generator finally: releases the PULL socket
+
+    mesh = create_mesh({"data": -1})
+    sharding = batch_sharding(mesh)
+    state = make_train_state(
+        CubeRegressor(), np.zeros((BATCH, *SHAPE, 4), np.uint8), mesh=mesh
+    )
+    # Same chunk branching as measure()/measure_step_alone: the ceiling
+    # must run the identical step program as the live pass it gates.
+    if chunk > 1:
+        step = make_chunked_supervised_step()
+    else:
+        from blendjax.train import make_supervised_step
+
+        step = make_supervised_step(mesh=mesh, batch_sharding=sharding)
+
+    def n_images(sb):
+        return (
+            sb["image"].shape[0] * sb["image"].shape[1]
+            if chunk > 1 else sb["image"].shape[0]
+        )
+
+    def replay():
+        # Shallow copies: the pipeline's stages pop keys destructively.
+        for m in captured:
+            yield dict(m)
+
+    with StreamDataPipeline(
+        replay(), batch_size=BATCH, sharding=sharding, chunk=chunk,
+    ) as pipe:
+        it = iter(pipe)
+        for _ in range(max(2, WARMUP_BATCHES // chunk)):
+            sb = next(it)
+            state, metrics_ = step(
+                state, {"image": sb["image"], "xy": sb["xy"]}
+            )
+        float(np.asarray(metrics_["loss"]).reshape(-1)[-1])  # drain
+        images = 0
+        t0 = time.perf_counter()
+        while images < items:
+            sb = next(it)
+            state, metrics_ = step(
+                state, {"image": sb["image"], "xy": sb["xy"]}
+            )
+            images += n_images(sb)
+        float(np.asarray(metrics_["loss"]).reshape(-1)[-1])  # drain
+        dt = time.perf_counter() - t0
+    return {
+        "img_s": round(images / dt, 1),
+        "chunk": chunk,
+        "images": images,
+        "seconds": round(dt, 2),
+    }
+
+
 def measure_rl_hz(seconds: float = 3.0) -> dict:
     """Full REQ/REP rendezvous stepping rate, rendering off (the
     reference's '2000 Hz are easily achieved' row, ``Readme.md:95``;
@@ -399,6 +499,23 @@ def main() -> None:
         detail["utilization"] = round(ips / alone["img_s"], 3)
     except Exception as e:  # pragma: no cover - device flake path
         detail["step_alone"] = {"error": repr(e)[:200]}
+    if ENCODING == "tile":
+        # Only meaningful when the headline ran the tile stream the
+        # ceiling replays — comparing codecs would make the ratio lie.
+        try:
+            # Runtime ceiling (VERDICT r3 next #1): the same transfer ->
+            # decode -> step pipeline with every wire message pre-staged
+            # on the host (ingest free). utilization_vs_ceiling is the
+            # honest "how much of what this runtime could do does the
+            # live path achieve" — step_alone remains the transfers-free
+            # chip number.
+            ceil = measure_pipelined_ceiling(primary["chunk"])
+            detail["pipelined_ceiling"] = ceil
+            detail["utilization_vs_ceiling"] = round(
+                ips / ceil["img_s"], 3
+            )
+        except Exception as e:  # pragma: no cover - device flake path
+            detail["pipelined_ceiling"] = {"error": repr(e)[:200]}
     try:
         # RL stepping rate (REQ/REP rendezvous, rendering off) — CPU/IPC
         # only, so it is weather-independent.

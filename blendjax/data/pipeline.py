@@ -610,9 +610,10 @@ class TileStreamDecoder:
                 )
                 b = fields[packed_key].shape[0]
                 pal = fields[pal_key]
-                fields[pal_key] = np.ascontiguousarray(
-                    np.broadcast_to(pal[None], (b, *pal.shape))
-                )
+                if pal.ndim == 2:  # batch-level palette: one row each
+                    fields[pal_key] = np.ascontiguousarray(
+                        np.broadcast_to(pal[None], (b, *pal.shape))
+                    )
         return fields, rest, refs
 
     def _assert_fleet_digest(self, name, digest) -> None:

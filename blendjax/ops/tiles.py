@@ -53,7 +53,8 @@ TILEREF_SUFFIX = "__tileref"
 # palette-compressed tile payloads (PNG-8 style; lossless):
 TILEPAL4_SUFFIX = "__tilepal4"   # two 4-bit palette indices per byte
 TILEPAL8_SUFFIX = "__tilepal8"   # one byte per pixel
-PALETTE_SUFFIX = "__palette"     # (cap, C) uint8, zero-padded
+PALETTE_SUFFIX = "__palette"     # (cap, C) or per-row (B, cap, C)
+#                                  uint8, zero-padded past used entries
 # palette-compressed FULL frames (the non-sparse codec: no reference
 # frame, no temporal assumption — see palettize_frames):
 FRAMEPAL4_SUFFIX = "__framepal4"  # (B, H*W/2) nibble indices
@@ -224,7 +225,9 @@ class TileDeltaEncoder:
 
         Returns ``None`` when a pixel would push the table past 256
         colors — the caller falls back to :meth:`encode` (the table
-        state stays valid). Call :meth:`reset_palette` per batch.
+        state stays valid). The caller owns the reset policy via
+        :meth:`reset_palette` (TileBatchPublisher resets per frame and
+        ships per-row palette snapshots).
         """
         if not self.palidx_available():
             return None
@@ -514,13 +517,14 @@ def expand_palette_tiles(packed, palette, bits: int, t: int, c: int):
     """Device-side inverse of :func:`palettize_tiles` (jit-safe gather).
 
     ``packed``: (..., K, t*t/2|t*t) uint8; ``palette``: (cap, C), or
-    (G, cap, C) with a leading group axis matching ``packed``'s first
-    dim (the chunked-decode case) — then each group gathers through its
-    own palette. Returns (..., K, t, t, C) uint8.
+    (..., cap, C) with leading axes matching ``packed``'s leading dims
+    (per-frame palettes, and the chunked-decode case stacks another
+    level) — each row then gathers through its own palette. Returns
+    (..., K, t, t, C) uint8.
     """
     import jax.numpy as jnp
 
-    if palette.ndim == 3:
+    if palette.ndim >= 3:
         import jax
 
         return jax.vmap(
@@ -538,6 +542,11 @@ def expand_palette_tiles(packed, palette, bits: int, t: int, c: int):
 
 def expand_palette_tiles_np(packed, palette, bits: int, t: int, c: int):
     """Host (numpy) twin of :func:`expand_palette_tiles`."""
+    if palette.ndim >= 3:
+        return np.stack([
+            expand_palette_tiles_np(p, q, bits, t, c)
+            for p, q in zip(packed, palette)
+        ])
     lead = packed.shape[:-1]
     if bits == 4:
         idx = np.stack([packed >> 4, packed & 0xF], axis=-1).reshape(

@@ -58,16 +58,18 @@ class TileBatchPublisher:
     keyframe interval lets the others sync (they skip tile batches until
     a ref arrives) at ~``ref_bytes / N`` amortized overhead.
 
-    ``palette=True`` (default) palette-compresses tile payloads when a
-    batch's changed tiles hold few distinct colors (flat-shaded frames
-    usually do): <=16 colors ship as 4-bit indices (8x fewer bytes),
-    <=256 as bytes (4x); more falls back to raw tiles. Lossless either
-    way — the consumer's decode gathers through the palette on device.
-    With full-channel tiles (``alpha_slice=False``) and the native
-    helpers available, palettization FUSES into the changed-tile scan
-    (one pass, no raw-tile materialization; the color table resets per
-    batch, matching the two-pass semantics); a >256-color batch falls
-    back to raw tiles transparently.
+    ``palette=True`` (default) palette-compresses tile payloads when
+    changed tiles hold few distinct colors (flat-shaded frames usually
+    do): <=16 colors ship as 4-bit indices (8x fewer bytes), <=256 as
+    bytes (4x); more falls back to raw tiles. Lossless either way — the
+    consumer's decode gathers through the palette on device. With
+    full-channel tiles (``alpha_slice=False``) and the native helpers
+    available, palettization FUSES into the changed-tile scan (one
+    pass, no raw-tile materialization) with PER-FRAME color tables:
+    each row of the batch ships its own palette (the wire carries a
+    ``(B, cap, C)`` palette array), so a single frame's color count —
+    not the whole batch's — picks the index width; a >256-color frame
+    falls back to raw tiles transparently.
 
     ``capacity`` pins the per-frame tile capacity from the first batch
     (it still grows on overflow). Every distinct capacity is a distinct
@@ -126,10 +128,11 @@ class TileBatchPublisher:
         self._batch_tiles: np.ndarray | None = None
         self._row = 0
         # Fused scan+palettize (encoder.encode_palidx, native): one pass
-        # both finds changed tiles and emits PER-BATCH palette indices
-        # (the table resets at each batch boundary, so color-drifting
-        # animated scenes never exhaust it) — the separate whole-batch
-        # palettize pass and the raw-tile materialization disappear.
+        # both finds changed tiles and emits PER-FRAME palette indices
+        # (the table resets at each frame, so neither color drift across
+        # a batch nor across an animation can exhaust it) — the separate
+        # whole-batch palettize pass and the raw-tile materialization
+        # disappear.
         # Engages when palettization is on and full-channel tiles stream
         # (alpha slicing needs raw tiles for its check); a >256-color
         # batch falls back to raw tiles, repeated fallbacks latch the
@@ -143,6 +146,10 @@ class TileBatchPublisher:
         )
         self._raw_batch = False  # this batch fell back to raw tiles
         self._batch_pal: np.ndarray | None = None
+        # per-row palette snapshots (fused path): colors + counts per
+        # frame of the current batch
+        self._row_pals: list = [None] * self.batch_size
+        self._row_counts: list = [0] * self.batch_size
 
     def add(self, image: np.ndarray, hint=None, **extras) -> None:
         """Add one frame plus its per-frame sidecar fields (annotations,
@@ -154,8 +161,13 @@ class TileBatchPublisher:
             and not self._raw_batch
             and self._capacity is not None
         ):
-            if self._row == 0:
-                self.encoder.reset_palette()  # per-batch palette
+            # PER-FRAME palette: each frame indexes its own fresh table,
+            # so a single frame's color count (not the whole batch's)
+            # decides 4-bit vs 8-bit packing — flat-shaded scenes whose
+            # batches drift past 16 colors still ship nibbles (halves
+            # the dominant wire term). The per-row palettes ride the
+            # wire as one (B, cap, C) array.
+            self.encoder.reset_palette()
             out = self.encoder.encode_palidx(image, hint=hint)
             if out is not None:
                 fi, fpal = out
@@ -168,6 +180,10 @@ class TileBatchPublisher:
                 self._batch_idx[i, k:] = self.encoder.num_tiles
                 self._batch_pal[i, :k] = fpal
                 self._batch_pal[i, k:] = 0
+                self._row_counts[i] = self.encoder.palette_count
+                self._row_pals[i] = self.encoder.palette[
+                    : self.encoder.palette_count
+                ].copy()
                 self._row += 1
                 for key, v in extras.items():
                     self._extras.setdefault(key, []).append(v)
@@ -247,16 +263,21 @@ class TileBatchPublisher:
 
     def _depalettize_rows(self) -> None:
         """Fused -> raw fallback mid-batch: reconstruct raw tiles for the
-        rows already packed as palette indices (lossless gather)."""
+        rows already packed as palette indices (lossless gather). Each
+        row gathers through ITS OWN per-frame palette snapshot."""
         n = self._row
         if not n or self._batch_pal is None:
             return
         self._ensure_batch_arrays()
         t, c = self.tile, self._ref.shape[2]
-        colors = self.encoder.palette  # (256, c); indices < count
-        self._batch_tiles[:n] = colors[self._batch_pal[:n]].reshape(
-            n, self._capacity, t, t, c
-        )
+        for i in range(n):
+            colors = np.zeros((256, c), np.uint8)
+            rp = self._row_pals[i]
+            if rp is not None:
+                colors[: len(rp)] = rp
+            self._batch_tiles[i] = colors[self._batch_pal[i]].reshape(
+                self._capacity, t, t, c
+            )
         # padding slots must ship zeroed tiles (pack contract), not
         # palette color 0
         pad = self._batch_idx[:n] == self.encoder.num_tiles
@@ -286,6 +307,8 @@ class TileBatchPublisher:
         self._alpha_static = True
         self._row = 0
         self._raw_batch = False
+        self._row_pals = [None] * self.batch_size
+        self._row_counts = [0] * self.batch_size
         self.publisher.publish(**msg)
         self.batches_published += 1
 
@@ -303,10 +326,14 @@ class TileBatchPublisher:
             idx = self._batch_idx[:n].copy()
             pal_idx = self._batch_pal[:n]
             # palette success resets the miss latch (matching the
-            # two-pass path; a per-frame reset would defeat the latch)
+            # two-pass path; an overflow-only latch would defeat it)
             self._palette_misses = 0
-            count = self.encoder.palette_count
-            if count <= 16 and (self.tile * self.tile) % 2 == 0:
+            # Per-frame palettes: the LARGEST row count picks the index
+            # width for the whole batch (one wire shape), but each row
+            # ships (and the consumer gathers through) its own colors.
+            counts = self._row_counts[:n]
+            cmax = max(counts) if counts else 0
+            if cmax <= 16 and (self.tile * self.tile) % 2 == 0:
                 packed = (
                     (pal_idx[..., 0::2] << 4) | pal_idx[..., 1::2]
                 )  # fresh allocation; first pixel in the high nibble
@@ -316,10 +343,11 @@ class TileBatchPublisher:
                 packed = pal_idx.copy()
                 suffix = TILEPAL8_SUFFIX
                 cap_colors = 256
-            # zero-padded past `count` (the wire contract; the table's
-            # rows beyond count may hold a previous batch's colors)
-            pal = np.zeros((cap_colors, c), np.uint8)
-            pal[:count] = self.encoder.palette[:count]
+            # (B, cap, C), zero-padded past each row's count (the wire
+            # contract; row tables are snapshots taken per frame)
+            pal = np.zeros((n, cap_colors, c), np.uint8)
+            for i in range(n):
+                pal[i, : counts[i]] = self._row_pals[i]
             self._finish_publish({
                 "_prebatched": True,
                 self.field + TILEIDX_SUFFIX: idx,

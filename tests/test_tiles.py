@@ -296,10 +296,19 @@ def test_tile_publisher_fused_engages_for_rgb_default_config():
     pub.add(img)
     pub.add(img)
     (msg,) = cap.msgs
+    from blendjax.ops.tiles import TILEPAL4_SUFFIX
+
     pal = msg["image" + PALETTE_SUFFIX]
-    used = pub.encoder.palette_count
-    assert used >= 2
-    assert (pal[used:] == 0).all()  # zero-padded wire contract
+    packed = msg["image" + TILEPAL4_SUFFIX]
+    # per-frame palettes: one (cap, C) table per batch row
+    assert pal.ndim == 3 and pal.shape[0] == 2
+    for row_pal, row_packed in zip(pal, packed):
+        # highest palette index any pixel references bounds the used
+        # entries; everything past it must be zero (wire contract —
+        # stale table rows must never ship)
+        hi = int(max((row_packed >> 4).max(), (row_packed & 0xF).max()))
+        assert hi >= 1  # bg + the edited square's color
+        assert (row_pal[hi + 1:] == 0).all()
 
 
 def test_tile_publisher_raw_direct_pack_path():
