@@ -391,31 +391,85 @@ def measure_pipelined_ceiling(chunk: int, items: int = 512) -> dict:
         for m in captured:
             yield dict(m)
 
-    with StreamDataPipeline(
-        replay(), batch_size=BATCH, sharding=sharding, chunk=chunk,
-    ) as pipe:
-        it = iter(pipe)
-        for _ in range(max(2, WARMUP_BATCHES // chunk)):
-            sb = next(it)
-            state, metrics_ = step(
-                state, {"image": sb["image"], "xy": sb["xy"]}
-            )
-        float(np.asarray(metrics_["loss"]).reshape(-1)[-1])  # drain
-        images = 0
-        t0 = time.perf_counter()
-        while images < items:
-            sb = next(it)
-            state, metrics_ = step(
-                state, {"image": sb["image"], "xy": sb["xy"]}
-            )
-            images += n_images(sb)
-        float(np.asarray(metrics_["loss"]).reshape(-1)[-1])  # drain
-        dt = time.perf_counter() - t0
+    def one_pass(warm: bool):
+        with StreamDataPipeline(
+            replay(), batch_size=BATCH, sharding=sharding, chunk=chunk,
+        ) as pipe:
+            nonlocal state
+            it = iter(pipe)
+            if warm:
+                for _ in range(max(2, WARMUP_BATCHES // chunk)):
+                    sb = next(it)
+                    state, metrics_ = step(
+                        state, {"image": sb["image"], "xy": sb["xy"]}
+                    )
+                float(np.asarray(metrics_["loss"]).reshape(-1)[-1])
+            images = 0
+            t0 = time.perf_counter()
+            while images < items:
+                sb = next(it)
+                state, metrics_ = step(
+                    state, {"image": sb["image"], "xy": sb["xy"]}
+                )
+                images += n_images(sb)
+            float(np.asarray(metrics_["loss"]).reshape(-1)[-1])  # drain
+            return images, time.perf_counter() - t0
+
+    # Best of 2 measured passes over the same captured messages — the
+    # headline this gates is itself best-of-N, so a single ceiling
+    # sample in a bad-weather window would read as "live beat the
+    # ceiling" (observed; it's measurement-window variance, not magic).
+    images, dt = one_pass(warm=True)
+    i2, d2 = one_pass(warm=False)
+    if i2 / d2 > images / dt:
+        images, dt = i2, d2
     return {
         "img_s": round(images / dt, 1),
         "chunk": chunk,
         "images": images,
         "seconds": round(dt, 2),
+    }
+
+
+# Peak dense bf16 throughput of one TPU v5e chip (197 TFLOP/s,
+# public spec) — the denominator weather can't move (VERDICT r3 next
+# #7: a FLOPs-based MFU row beside the throughput-ratio utilization).
+V5E_PEAK_FLOPS = 197e12
+
+
+def measure_model_flops() -> dict:
+    """Fwd+bwd FLOPs per image of the benchmark step, from the compiled
+    executable's own cost analysis (XLA's count, not a hand estimate).
+
+    Always lowers the UNCHUNKED per-batch step: the per-image math is
+    identical at any chunk, and XLA's cost model counts a ``lax.scan``
+    body ONCE regardless of trip count, so the chunked program would
+    under-report per-image FLOPs by ~chunk (verified on this backend).
+    """
+    from blendjax.models import CubeRegressor
+    from blendjax.parallel import batch_sharding, create_mesh
+    from blendjax.train import make_supervised_step, make_train_state
+
+    mesh = create_mesh({"data": -1})
+    state = make_train_state(
+        CubeRegressor(), np.zeros((BATCH, *SHAPE, 4), np.uint8), mesh=mesh
+    )
+    step = make_supervised_step(
+        mesh=mesh, batch_sharding=batch_sharding(mesh)
+    )
+    sb = {
+        "image": np.zeros((BATCH, *SHAPE, 4), np.uint8),
+        "xy": np.zeros((BATCH, 8, 2), np.float32),
+    }
+    ca = step.lower(state, sb).compile().cost_analysis()
+    ca = ca[0] if isinstance(ca, (list, tuple)) else ca
+    flops = float(ca["flops"])
+    return {
+        "flops_per_image": round(flops / BATCH),
+        "model": "CubeRegressor fwd+bwd",
+        "source": "compiled.cost_analysis() (unchunked step)",
+        "chip": "TPU v5e",
+        "peak_flops": V5E_PEAK_FLOPS,
     }
 
 
@@ -499,6 +553,29 @@ def main() -> None:
         detail["utilization"] = round(ips / alone["img_s"], 3)
     except Exception as e:  # pragma: no cover - device flake path
         detail["step_alone"] = {"error": repr(e)[:200]}
+    if jax.default_backend() == "tpu":
+        # MFU against the v5e peak is only meaningful on the chip —
+        # a CPU-fallback run must not print a TPU utilization figure.
+        try:
+            # FLOPs-based MFU: achieved model FLOPs over the chip's
+            # peak (docs/performance.md). Reported for the live
+            # headline AND the transfers-free step-alone run — the gap
+            # between the two is the pipeline; the gap from 1.0 is the
+            # model's arithmetic intensity (a small CNN on uint8 frames
+            # is memory-bound by design: the benchmark measures
+            # streaming, not matmul density).
+            fl = measure_model_flops()
+            detail["model_flops"] = fl
+            detail["mfu"] = round(
+                ips * fl["flops_per_image"] / V5E_PEAK_FLOPS, 6
+            )
+            alone_ips = detail.get("step_alone", {}).get("img_s")
+            if alone_ips:
+                detail["mfu_step_alone"] = round(
+                    alone_ips * fl["flops_per_image"] / V5E_PEAK_FLOPS, 6
+                )
+        except Exception as e:  # pragma: no cover - device flake path
+            detail["model_flops"] = {"error": repr(e)[:200]}
     if ENCODING == "tile":
         # Only meaningful when the headline ran the tile stream the
         # ceiling replays — comparing codecs would make the ratio lie.
