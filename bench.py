@@ -553,9 +553,11 @@ def main() -> None:
         detail["utilization"] = round(ips / alone["img_s"], 3)
     except Exception as e:  # pragma: no cover - device flake path
         detail["step_alone"] = {"error": repr(e)[:200]}
-    if jax.default_backend() == "tpu":
-        # MFU against the v5e peak is only meaningful on the chip —
-        # a CPU-fallback run must not print a TPU utilization figure.
+    device_kind = (jax.devices()[0].device_kind or "").lower()
+    if jax.default_backend() == "tpu" and "v5" in device_kind:
+        # MFU against the v5e peak is only meaningful on that chip — a
+        # CPU fallback (or a different TPU generation, whose peak
+        # differs) must not print a v5e utilization figure.
         try:
             # FLOPs-based MFU: achieved model FLOPs over the chip's
             # peak (docs/performance.md). Reported for the live

@@ -359,13 +359,9 @@ class TileStreamDecoder:
                     # are the single-host configurations the non-sparse
                     # codec targets.
                     for name, (h_, w_, c_, bits) in pal_groups:
-                        key = name + (
-                            T.FRAMEPAL4_SUFFIX if bits == 4
-                            else T.FRAMEPAL8_SUFFIX
-                        )
-                        hb[name] = T.expand_palette_frames_np(
-                            hb.pop(key), hb.pop(name + T.PALETTE_SUFFIX),
-                            bits, h_, w_, c_,
+                        hb[name] = T.pop_frame_palette_payload(
+                            hb, name, bits, h_, w_, c_,
+                            T.expand_palette_frames_np,
                         )
                 else:
                     arrays = {
@@ -805,14 +801,9 @@ class TileStreamDecoder:
             def _decode_pal(packed, spec, pal_groups):
                 fields = T.unpack_fields(packed, spec)
                 for name, (h_, w_, c_, bits) in pal_groups:
-                    key = name + (
-                        T.FRAMEPAL4_SUFFIX if bits == 4
-                        else T.FRAMEPAL8_SUFFIX
-                    )
-                    pk = fields.pop(key)
-                    pal = fields.pop(name + T.PALETTE_SUFFIX)
-                    fields[name] = T.expand_palette_frames(
-                        pk, pal, bits, h_, w_, c_
+                    fields[name] = T.pop_frame_palette_payload(
+                        fields, name, bits, h_, w_, c_,
+                        T.expand_palette_frames,
                     )
                 return fields
 

@@ -69,7 +69,10 @@ class RemoteIterableDataset(tud.IterableDataset):
         from blendjax.ops.tiles import (
             TILEIDX_SUFFIX,
             decode_tile_delta_np,
+            expand_palette_frames_np,
             expand_palette_tiles_np,
+            pop_frame_palette_batches,
+            pop_frame_palette_payload,
             pop_stream_refs,
             pop_tile_batches,
             pop_tile_payload,
@@ -85,6 +88,12 @@ class RemoteIterableDataset(tud.IterableDataset):
             )
             btid = msg.get("btid")
             pop_stream_refs(msg, self._refs, btid)
+            # Full-frame palette batches (--encoding pal): stateless host
+            # decode, no reference needed (the non-sparse codec).
+            for name, (h, w, c, bits) in pop_frame_palette_batches(msg):
+                msg[name] = pop_frame_palette_payload(
+                    msg, name, bits, h, w, c, expand_palette_frames_np
+                )
             skip = False
             for name, geom in pop_tile_batches(msg):
                 ref = self._refs.get((name, btid))

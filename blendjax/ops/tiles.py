@@ -501,6 +501,20 @@ def expand_palette_frames_np(packed, palette, bits: int, h: int, w: int,
     return palette[idx].reshape(*lead, h, w, c)
 
 
+def pop_frame_palette_payload(fields: dict, name: str, bits: int, h: int,
+                              w: int, c: int, expand):
+    """Pop ``name``'s full-frame palette payload from ``fields`` and
+    return the expanded frames, where ``expand`` is
+    :func:`expand_palette_frames` (device) or
+    :func:`expand_palette_frames_np` (host). Shared by every consumer
+    (pipeline fast paths, host fallbacks, torch adapter) so the 4-bit /
+    8-bit wire variants stay in one place."""
+    key = name + (FRAMEPAL4_SUFFIX if bits == 4 else FRAMEPAL8_SUFFIX)
+    packed = fields.pop(key)
+    pal = fields.pop(name + PALETTE_SUFFIX)
+    return expand(packed, pal, bits, h, w, c)
+
+
 def pop_frame_palette_batches(hb: dict):
     """Detect+pop full-frame palette batches from a host batch: returns
     ``[(name, (h, w, c, bits))]`` and removes each ``name__frameshape``

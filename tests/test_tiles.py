@@ -1500,3 +1500,45 @@ def test_pal_stream_chunk_mode_superbatch_bit_exact():
     img = np.asarray(sb["image"]).reshape(16, 64, 64, 4)
     for i, f in enumerate(np.asarray(sb["frameid"]).reshape(-1)):
         np.testing.assert_array_equal(img[i], local[int(f)])
+
+
+def test_pal_stream_multihost_host_expand_fallback():
+    """Full-frame palette batches in a multihost pipeline stay CORRECT
+    via the host-expand fallback: frames decode on the host and ride
+    the standard global-assembly path, bit-exact."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from blendjax.data import StreamDataPipeline
+    from blendjax.ops.tiles import (
+        FRAMEPAL8_SUFFIX,
+        FRAMESHAPE_SUFFIX,
+        PALETTE_SUFFIX,
+        palettize_frames,
+    )
+    from blendjax.parallel import batch_sharding, create_mesh
+
+    n = len(jax.devices())
+    mesh = create_mesh({"data": -1})
+    rng = np.random.default_rng(5)
+    frames = np.repeat(
+        rng.integers(0, 40, (n, 16, 24, 1), np.uint8) * 6, 4, axis=-1
+    )
+    out = palettize_frames(frames)
+    assert out is not None
+    packed, pal, bits = out
+    suffix = FRAMEPAL8_SUFFIX if bits == 8 else "__framepal4"
+    msg = {
+        "_prebatched": True, "btid": 0,
+        "image" + suffix: packed,
+        "xy": np.zeros((n, 8, 2), np.float32),
+        "image" + PALETTE_SUFFIX: pal,
+        "image" + FRAMESHAPE_SUFFIX: np.array([16, 24, 4, bits], np.int32),
+    }
+    with StreamDataPipeline(
+        iter([msg]), batch_size=n, sharding=batch_sharding(mesh),
+        multihost=True,
+    ) as pipe:
+        (b,) = list(pipe)
+    assert b["image"].shape == (n, 16, 24, 4)
+    np.testing.assert_array_equal(np.asarray(b["image"]), frames)

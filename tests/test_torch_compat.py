@@ -185,3 +185,47 @@ def test_max_items_cap_with_recording(tmp_path):
     assert len(replayed) >= 6
     for orig, rep in zip(items, replayed):
         np.testing.assert_array_equal(orig["image"], rep["image"])
+
+
+def test_torch_adapter_decodes_pal_streams_host_side():
+    """A full-frame palette producer (--encoding pal) feeds the
+    reference-style torch dataset: items arrive as plain per-frame
+    image dicts, decoded bit-exact on the host (stateless — no
+    reference image involved)."""
+    import os
+
+    import numpy as np
+
+    from blendjax.data.torch_compat import RemoteIterableDataset
+    from blendjax.launcher import PythonProducerLauncher
+    from blendjax.producer.sim import CubeScene
+
+    producer = os.path.join(
+        os.path.dirname(__file__), "..", "examples", "datagen",
+        "cube_producer.py",
+    )
+    seed = 9
+    with PythonProducerLauncher(
+        script=producer,
+        num_instances=1,
+        named_sockets=["DATA"],
+        seed=seed,
+        instance_args=[
+            ["--shape", "64", "64", "--batch", "4", "--encoding", "pal"]
+        ],
+    ) as launcher:
+        ds = RemoteIterableDataset(
+            launcher.addresses["DATA"], max_items=8, timeoutms=30_000
+        )
+        items = list(ds)
+    assert len(items) == 8
+    scene = CubeScene(shape=(64, 64), seed=seed)
+    local = {}
+    for f in range(1, 13):
+        scene.step(f)
+        local[f] = scene.render().copy()
+    for it in items:
+        assert it["image"].shape == (64, 64, 4)
+        np.testing.assert_array_equal(
+            it["image"], local[int(it["frameid"])]
+        )
