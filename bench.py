@@ -554,7 +554,9 @@ def main() -> None:
     except Exception as e:  # pragma: no cover - device flake path
         detail["step_alone"] = {"error": repr(e)[:200]}
     device_kind = (jax.devices()[0].device_kind or "").lower()
-    if jax.default_backend() == "tpu" and "v5" in device_kind:
+    if jax.default_backend() == "tpu" and (
+        "v5e" in device_kind or "v5 lite" in device_kind
+    ):
         # MFU against the v5e peak is only meaningful on that chip — a
         # CPU fallback (or a different TPU generation, whose peak
         # differs) must not print a v5e utilization figure.

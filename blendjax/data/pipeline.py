@@ -374,10 +374,11 @@ class TileStreamDecoder:
                     metrics.count("pal.batches")
                     metrics.count("pal.wire_bytes", int(buf.nbytes))
                     for name, (h_, w_, c_, bits) in pal_groups:
-                        lead = int(arrays[name + (
-                            T.FRAMEPAL4_SUFFIX if bits == 4
-                            else T.FRAMEPAL8_SUFFIX
-                        )].shape[0])
+                        lead = int(
+                            arrays[
+                                name + T.FRAMEPAL_SUFFIXES[bits]
+                            ].shape[0]
+                        )
                         metrics.count(
                             "pal.decoded_bytes", int(h_ * w_ * c_) * lead
                         )
@@ -599,10 +600,11 @@ class TileStreamDecoder:
                 # gathers row i through palette row i, and the global
                 # assembly stacks processes on the leading axis, so each
                 # process's rows keep their own palette.
-                packed_key = (
-                    name + T.TILEPAL4_SUFFIX
-                    if name + T.TILEPAL4_SUFFIX in fields
-                    else name + T.TILEPAL8_SUFFIX
+                packed_key = next(
+                    name + s
+                    for s in (T.TILEPAL2_SUFFIX, T.TILEPAL4_SUFFIX,
+                              T.TILEPAL8_SUFFIX)
+                    if name + s in fields
                 )
                 b = fields[packed_key].shape[0]
                 pal = fields[pal_key]
@@ -837,7 +839,8 @@ class TileStreamDecoder:
                         return v.reshape((k * b,) + tuple(v.shape[2:]))
 
                     for suf in (
-                        T.TILES_SUFFIX, T.TILEPAL4_SUFFIX,
+                        T.TILES_SUFFIX, T.TILEPAL2_SUFFIX,
+                        T.TILEPAL4_SUFFIX,
                         T.TILEPAL8_SUFFIX, T.PALETTE_SUFFIX,
                     ):
                         if name + suf in fields:

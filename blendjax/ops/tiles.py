@@ -51,15 +51,55 @@ TILES_SUFFIX = "__tiles"
 TILESHAPE_SUFFIX = "__tileshape"
 TILEREF_SUFFIX = "__tileref"
 # palette-compressed tile payloads (PNG-8 style; lossless):
+TILEPAL2_SUFFIX = "__tilepal2"   # four 2-bit palette indices per byte
 TILEPAL4_SUFFIX = "__tilepal4"   # two 4-bit palette indices per byte
 TILEPAL8_SUFFIX = "__tilepal8"   # one byte per pixel
 PALETTE_SUFFIX = "__palette"     # (cap, C) or per-row (B, cap, C)
 #                                  uint8, zero-padded past used entries
 # palette-compressed FULL frames (the non-sparse codec: no reference
 # frame, no temporal assumption — see palettize_frames):
+FRAMEPAL2_SUFFIX = "__framepal2"  # (B, H*W/4) 2-bit indices
 FRAMEPAL4_SUFFIX = "__framepal4"  # (B, H*W/2) nibble indices
 FRAMEPAL8_SUFFIX = "__framepal8"  # (B, H*W) byte indices
 FRAMESHAPE_SUFFIX = "__frameshape"  # [H, W, C, bits]
+
+FRAMEPAL_SUFFIXES = {
+    2: FRAMEPAL2_SUFFIX, 4: FRAMEPAL4_SUFFIX, 8: FRAMEPAL8_SUFFIX,
+}
+
+
+def pack_palette_indices(idx, bits: int):
+    """Pack uint8 palette indices along the LAST axis: 4 per byte for
+    ``bits=2``, 2 per byte for ``bits=4``, pass-through for ``bits=8``.
+    The single definition of the bit order (first index in the high
+    bits) — every producer packs and every consumer unpacks through
+    this pair, so the wire variants stay in one place."""
+    if bits == 2:
+        return (
+            (idx[..., 0::4] << 6) | (idx[..., 1::4] << 4)
+            | (idx[..., 2::4] << 2) | idx[..., 3::4]
+        )
+    if bits == 4:
+        return (idx[..., 0::2] << 4) | idx[..., 1::2]
+    return idx
+
+
+def unpack_palette_indices(packed, bits: int, xp=np):
+    """Inverse of :func:`pack_palette_indices` (``xp``: ``numpy`` or
+    ``jax.numpy`` — the expression is jit-safe)."""
+    lead = packed.shape[:-1]
+    m = packed.shape[-1]
+    if bits == 2:
+        return xp.stack(
+            [packed >> 6, (packed >> 4) & 3, (packed >> 2) & 3,
+             packed & 3],
+            axis=-1,
+        ).reshape(*lead, m * 4)
+    if bits == 4:
+        return xp.stack(
+            [packed >> 4, packed & 0xF], axis=-1
+        ).reshape(*lead, m * 2)
+    return packed
 
 
 def tile_grid(shape, tile: int = TILE):
@@ -339,14 +379,13 @@ def pop_tile_payload(fields: dict, name: str, geom, expand):
     :func:`expand_palette_tiles_np` (host). Shared by every consumer so
     the raw-vs-palette wire variants stay in one place."""
     t = int(geom[3])
-    if name + TILEPAL4_SUFFIX in fields:
-        packed = fields.pop(name + TILEPAL4_SUFFIX)
-        pal = fields.pop(name + PALETTE_SUFFIX)
-        return expand(packed, pal, 4, t, pal.shape[-1])
-    if name + TILEPAL8_SUFFIX in fields:
-        packed = fields.pop(name + TILEPAL8_SUFFIX)
-        pal = fields.pop(name + PALETTE_SUFFIX)
-        return expand(packed, pal, 8, t, pal.shape[-1])
+    for suffix, bits in (
+        (TILEPAL2_SUFFIX, 2), (TILEPAL4_SUFFIX, 4), (TILEPAL8_SUFFIX, 8)
+    ):
+        if name + suffix in fields:
+            packed = fields.pop(name + suffix)
+            pal = fields.pop(name + PALETTE_SUFFIX)
+            return expand(packed, pal, bits, t, pal.shape[-1])
     return fields.pop(name + TILES_SUFFIX)
 
 
@@ -437,10 +476,15 @@ def palettize_tiles(tiles: np.ndarray, max_colors: int = 256):
     if out is None:
         return None
     idx, pal, count = out
+    if count <= 4 and (t * t) % 4 == 0:
+        pal4c = np.zeros((4, c), np.uint8)
+        pal4c[: min(len(pal), 4)] = pal[:4]
+        packed = pack_palette_indices(idx, 2).reshape(b, k, (t * t) // 4)
+        return packed, pal4c, 2
     if count <= 16 and (t * t) % 2 == 0:
         pal16 = np.zeros((16, c), np.uint8)
         pal16[: min(len(pal), 16)] = pal[:16]
-        packed = ((idx[0::2] << 4) | idx[1::2]).reshape(b, k, (t * t) // 2)
+        packed = pack_palette_indices(idx, 4).reshape(b, k, (t * t) // 2)
         return packed, pal16, 4
     return idx.reshape(b, k, t * t), pal, 8
 
@@ -450,54 +494,74 @@ def palettize_frames(frames: np.ndarray, max_colors: int = 256):
     wire+transfer codec for the non-sparse path (no reference frame, no
     temporal assumption; only "synthetic frames carry few colors").
 
-    Returns ``(packed, palette, bits)`` — ``packed`` (B, H*W/2) uint8
-    nibbles for ``bits=4`` or (B, H*W) bytes for ``bits=8`` (4x/8x fewer
+    PER-FRAME palettes: each frame indexes its own color table, so one
+    frame's count — not the batch's — picks the index width (a
+    flat-shaded frame is typically <=4 colors even when the batch
+    drifts past 16). Returns ``(packed, palette, bits)`` — ``packed``
+    (B, H*W/4 | H*W/2 | H*W) uint8 for ``bits`` 2/4/8 (16x/8x/4x fewer
     bytes than RGBA across BOTH the socket and the host->device link;
-    the device side is one fused gather) — or ``None`` when the batch
-    holds more than ``max_colors`` distinct colors (ship raw instead).
+    the device side is one fused gather through ``palette`` (B, cap,
+    C)) — or ``None`` when any single frame holds more than
+    ``max_colors`` distinct colors (ship raw instead).
     """
     max_colors = min(int(max_colors), 256)
     b, h, w, c = frames.shape
-    flat = np.ascontiguousarray(frames).reshape(-1, c)
-    out = _palettize_flat(flat, max_colors)
-    if out is None:
-        return None
-    idx, pal, count = out
-    if count <= 16 and (h * w) % 2 == 0:
-        pal16 = np.zeros((16, c), np.uint8)
-        pal16[: min(len(pal), 16)] = pal[:16]
-        packed = ((idx[0::2] << 4) | idx[1::2]).reshape(b, (h * w) // 2)
-        return packed, pal16, 4
-    return idx.reshape(b, h * w), pal, 8
+    hw = h * w
+    rows = []
+    counts = []
+    frames = np.ascontiguousarray(frames)
+    for i in range(b):
+        out = _palettize_flat(frames[i].reshape(-1, c), max_colors)
+        if out is None:
+            return None
+        idx, pal, count = out
+        rows.append((idx, pal))
+        counts.append(count)
+    cmax = max(counts) if counts else 0
+    if cmax <= 4 and hw % 4 == 0:
+        bits, cap = 2, 4
+    elif cmax <= 16 and hw % 2 == 0:
+        bits, cap = 4, 16
+    else:
+        bits, cap = 8, 256
+    palette = np.zeros((b, cap, c), np.uint8)
+    packed = np.empty((b, hw * bits // 8), np.uint8)
+    for i, (idx, pal) in enumerate(rows):
+        palette[i, : counts[i]] = pal[: counts[i]]
+        packed[i] = pack_palette_indices(idx, bits)
+    return packed, palette, bits
 
 
 def expand_palette_frames(packed, palette, bits: int, h: int, w: int,
                           c: int):
     """Device-side inverse of :func:`palettize_frames` (jit-safe
-    gather). ``packed``: (..., H*W/2|H*W) uint8; returns
-    (..., H, W, C) uint8."""
+    gather). ``packed``: (..., H*W/4|H*W/2|H*W) uint8; ``palette``:
+    (cap, C) batch-level, or (..., cap, C) per-row with leading axes
+    matching ``packed``'s (each row gathers through its own table).
+    Returns (..., H, W, C) uint8."""
     import jax.numpy as jnp
 
+    if palette.ndim >= 3:
+        import jax
+
+        return jax.vmap(
+            lambda p, q: expand_palette_frames(p, q, bits, h, w, c)
+        )(packed, palette)
     lead = packed.shape[:-1]
-    if bits == 4:
-        idx = jnp.stack(
-            [packed >> 4, packed & 0xF], axis=-1
-        ).reshape(*lead, h * w)
-    else:
-        idx = packed
+    idx = unpack_palette_indices(packed, bits, jnp)
     return palette[idx].reshape(*lead, h, w, c)
 
 
 def expand_palette_frames_np(packed, palette, bits: int, h: int, w: int,
                              c: int):
     """Host (numpy) twin of :func:`expand_palette_frames`."""
+    if palette.ndim >= 3:
+        return np.stack([
+            expand_palette_frames_np(p, q, bits, h, w, c)
+            for p, q in zip(packed, palette)
+        ])
     lead = packed.shape[:-1]
-    if bits == 4:
-        idx = np.stack(
-            [packed >> 4, packed & 0xF], axis=-1
-        ).reshape(*lead, h * w)
-    else:
-        idx = packed
+    idx = unpack_palette_indices(packed, bits, np)
     return palette[idx].reshape(*lead, h, w, c)
 
 
@@ -507,10 +571,9 @@ def pop_frame_palette_payload(fields: dict, name: str, bits: int, h: int,
     return the expanded frames, where ``expand`` is
     :func:`expand_palette_frames` (device) or
     :func:`expand_palette_frames_np` (host). Shared by every consumer
-    (pipeline fast paths, host fallbacks, torch adapter) so the 4-bit /
-    8-bit wire variants stay in one place."""
-    key = name + (FRAMEPAL4_SUFFIX if bits == 4 else FRAMEPAL8_SUFFIX)
-    packed = fields.pop(key)
+    (pipeline fast paths, host fallbacks, torch adapter) so the 2/4/8-
+    bit wire variants stay in one place."""
+    packed = fields.pop(name + FRAMEPAL_SUFFIXES[bits])
     pal = fields.pop(name + PALETTE_SUFFIX)
     return expand(packed, pal, bits, h, w, c)
 
@@ -545,12 +608,7 @@ def expand_palette_tiles(packed, palette, bits: int, t: int, c: int):
             lambda p, q: expand_palette_tiles(p, q, bits, t, c)
         )(packed, palette)
     lead = packed.shape[:-1]
-    if bits == 4:
-        hi = packed >> 4
-        lo = packed & 0xF
-        idx = jnp.stack([hi, lo], axis=-1).reshape(*lead, t * t)
-    else:
-        idx = packed
+    idx = unpack_palette_indices(packed, bits, jnp)
     return palette[idx].reshape(*lead, t, t, c)
 
 
@@ -562,12 +620,7 @@ def expand_palette_tiles_np(packed, palette, bits: int, t: int, c: int):
             for p, q in zip(packed, palette)
         ])
     lead = packed.shape[:-1]
-    if bits == 4:
-        idx = np.stack([packed >> 4, packed & 0xF], axis=-1).reshape(
-            *lead, t * t
-        )
-    else:
-        idx = packed
+    idx = unpack_palette_indices(packed, bits, np)
     return palette[idx].reshape(*lead, t, t, c)
 
 

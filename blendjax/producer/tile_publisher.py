@@ -26,6 +26,7 @@ from blendjax.ops.tiles import (
     PALETTE_SUFFIX,
     TILE,
     TILEIDX_SUFFIX,
+    TILEPAL2_SUFFIX,
     TILEPAL4_SUFFIX,
     TILEPAL8_SUFFIX,
     TILEREF_SUFFIX,
@@ -33,6 +34,7 @@ from blendjax.ops.tiles import (
     TILESHAPE_SUFFIX,
     TileDeltaEncoder,
     pack_batch,
+    pack_palette_indices,
     palettize_tiles,
 )
 
@@ -333,16 +335,21 @@ class TileBatchPublisher:
             # ships (and the consumer gathers through) its own colors.
             counts = self._row_counts[:n]
             cmax = max(counts) if counts else 0
-            if cmax <= 16 and (self.tile * self.tile) % 2 == 0:
-                packed = (
-                    (pal_idx[..., 0::2] << 4) | pal_idx[..., 1::2]
-                )  # fresh allocation; first pixel in the high nibble
-                suffix = TILEPAL4_SUFFIX
-                cap_colors = 16
+            tt = self.tile * self.tile
+            if cmax <= 4 and tt % 4 == 0:
+                # four 2-bit indices per byte (flat-shaded frames often
+                # hold <=4 colors: background + a few faces)
+                bits, suffix, cap_colors = 2, TILEPAL2_SUFFIX, 4
+            elif cmax <= 16 and tt % 2 == 0:
+                bits, suffix, cap_colors = 4, TILEPAL4_SUFFIX, 16
             else:
-                packed = pal_idx.copy()
-                suffix = TILEPAL8_SUFFIX
-                cap_colors = 256
+                bits, suffix, cap_colors = 8, TILEPAL8_SUFFIX, 256
+            # fresh allocation either way: pal_idx is a reused batch
+            # array and publish hands buffers to the IO thread by ref
+            packed = (
+                pack_palette_indices(pal_idx, bits)
+                if bits < 8 else pal_idx.copy()
+            )
             # (B, cap, C), zero-padded past each row's count (the wire
             # contract; row tables are snapshots taken per frame)
             pal = np.zeros((n, cap_colors, c), np.uint8)
@@ -398,7 +405,10 @@ class TileBatchPublisher:
         if compressed is not None:
             self._palette_misses = 0
             packed, pal, bits = compressed
-            suffix = TILEPAL4_SUFFIX if bits == 4 else TILEPAL8_SUFFIX
+            suffix = {
+                2: TILEPAL2_SUFFIX, 4: TILEPAL4_SUFFIX,
+                8: TILEPAL8_SUFFIX,
+            }[bits]
             msg[self.field + suffix] = packed
             msg[self.field + PALETTE_SUFFIX] = pal
         else:

@@ -109,8 +109,7 @@ def main() -> None:
         # gather on device (blendjax.ops.tiles.palettize_frames).
         # Falls back to a raw batch whenever a batch exceeds 256 colors.
         from blendjax.ops.tiles import (
-            FRAMEPAL4_SUFFIX,
-            FRAMEPAL8_SUFFIX,
+            FRAMEPAL_SUFFIXES,
             FRAMESHAPE_SUFFIX,
             PALETTE_SUFFIX,
             palettize_frames,
@@ -145,7 +144,7 @@ def main() -> None:
                 )
                 return
             packed, pal, bits = out
-            suffix = FRAMEPAL4_SUFFIX if bits == 4 else FRAMEPAL8_SUFFIX
+            suffix = FRAMEPAL_SUFFIXES[bits]
             pub.publish(
                 _prebatched=True,
                 **{

@@ -296,17 +296,21 @@ def test_tile_publisher_fused_engages_for_rgb_default_config():
     pub.add(img)
     pub.add(img)
     (msg,) = cap.msgs
-    from blendjax.ops.tiles import TILEPAL4_SUFFIX
+    from blendjax.ops.tiles import TILEPAL2_SUFFIX
 
     pal = msg["image" + PALETTE_SUFFIX]
-    packed = msg["image" + TILEPAL4_SUFFIX]
+    # <=4 colors per frame => 2-bit indices ship (the densest form)
+    packed = msg["image" + TILEPAL2_SUFFIX]
     # per-frame palettes: one (cap, C) table per batch row
     assert pal.ndim == 3 and pal.shape[0] == 2
     for row_pal, row_packed in zip(pal, packed):
         # highest palette index any pixel references bounds the used
         # entries; everything past it must be zero (wire contract —
         # stale table rows must never ship)
-        hi = int(max((row_packed >> 4).max(), (row_packed & 0xF).max()))
+        hi = int(max(
+            (row_packed >> 6).max(), ((row_packed >> 4) & 3).max(),
+            ((row_packed >> 2) & 3).max(), (row_packed & 3).max(),
+        ))
         assert hi >= 1  # bg + the edited square's color
         assert (row_pal[hi + 1:] == 0).all()
 
@@ -403,10 +407,11 @@ def test_tile_publisher_fused_palette_overflow_falls_back():
         for got, want in zip(out, batch):
             np.testing.assert_array_equal(got, want)
     # batch 1 shipped raw tiles (overflow), batch 2 palette again
-    from blendjax.ops.tiles import TILEPAL4_SUFFIX, TILES_SUFFIX
+    from blendjax.ops.tiles import TILEPAL2_SUFFIX, TILES_SUFFIX
 
     assert "image" + TILES_SUFFIX in cap.msgs[0]
-    assert "image" + TILEPAL4_SUFFIX in cap.msgs[1]
+    # <=4 colors => the 2-bit palette form ships
+    assert "image" + TILEPAL2_SUFFIX in cap.msgs[1]
     assert pub._palette_misses == 0  # success resets the miss latch
 
 
@@ -1369,7 +1374,10 @@ def test_prebatched_size_mismatch_warns_once(caplog):
 # -- full-frame palette codec (the non-sparse path) --------------------------
 
 
-def test_palettize_frames_roundtrip_pal4_pal8_and_overflow():
+def test_palettize_frames_roundtrip_all_widths_and_overflow():
+    """Per-frame full-frame palettes: the widest FRAME picks 2/4/8-bit
+    indices; every width round-trips bit-exact (numpy and device twins),
+    and a single >256-color frame fails the whole batch to raw."""
     from blendjax.ops.tiles import (
         expand_palette_frames,
         expand_palette_frames_np,
@@ -1378,35 +1386,42 @@ def test_palettize_frames_roundtrip_pal4_pal8_and_overflow():
 
     rng = np.random.default_rng(0)
     h, w = 16, 24
-    # <=16 colors -> pal4 (8x)
-    few = rng.integers(0, 16, (4, h, w, 1), np.uint8) * 17
-    few = np.repeat(few, 4, axis=-1)
-    packed, pal, bits = palettize_frames(few)
-    assert bits == 4 and packed.shape == (4, h * w // 2)
-    np.testing.assert_array_equal(
-        expand_palette_frames_np(packed, pal, bits, h, w, 4), few
+
+    def roundtrip(frames, want_bits, want_len):
+        packed, pal, bits = palettize_frames(frames)
+        assert bits == want_bits and packed.shape == (len(frames), want_len)
+        assert pal.ndim == 3 and pal.shape[0] == len(frames)  # per-frame
+        np.testing.assert_array_equal(
+            expand_palette_frames_np(packed, pal, bits, h, w, 4), frames
+        )
+        np.testing.assert_array_equal(
+            np.asarray(jax.jit(
+                lambda p, q: expand_palette_frames(p, q, bits, h, w, 4)
+            )(packed, pal)),
+            frames,
+        )
+
+    # <=4 colors per frame -> 2-bit (16x)
+    tiny = np.repeat(
+        rng.integers(0, 4, (4, h, w, 1), np.uint8) * 60, 4, axis=-1
     )
-    # <=256 colors -> pal8 (4x)
+    roundtrip(tiny, 2, h * w // 4)
+    # <=16 colors per frame -> 4-bit (8x); per-frame tables mean DISTINCT
+    # colors across frames still fit (here ~64 batch-wide)
+    few = np.stack([
+        np.repeat(
+            rng.integers(0, 16, (h, w, 1), np.uint8) * 13 + i * 17,
+            4, axis=-1,
+        )
+        for i in range(4)
+    ])
+    roundtrip(few, 4, h * w // 2)
+    # <=256 colors in one frame -> 8-bit (4x)
     some = np.repeat(
         rng.integers(0, 200, (4, h, w, 1), np.uint8), 4, axis=-1
     )
-    packed, pal, bits = palettize_frames(some)
-    assert bits == 8 and packed.shape == (4, h * w)
-    np.testing.assert_array_equal(
-        expand_palette_frames_np(packed, pal, bits, h, w, 4), some
-    )
-    # device twin agrees
-    np.testing.assert_array_equal(
-        np.asarray(
-            jax.jit(
-                lambda p, q: __import__(
-                    "blendjax.ops.tiles", fromlist=["expand_palette_frames"]
-                ).expand_palette_frames(p, q, 8, h, w, 4)
-            )(packed, pal)
-        ),
-        some,
-    )
-    # >256 colors -> None (ship raw)
+    roundtrip(some, 8, h * w)
+    # >256 colors in any frame -> None (ship raw)
     many = rng.integers(0, 255, (2, 32, 32, 4), np.uint8)
     assert palettize_frames(many) is None
 
@@ -1527,7 +1542,9 @@ def test_pal_stream_multihost_host_expand_fallback():
     out = palettize_frames(frames)
     assert out is not None
     packed, pal, bits = out
-    suffix = FRAMEPAL8_SUFFIX if bits == 8 else "__framepal4"
+    from blendjax.ops.tiles import FRAMEPAL_SUFFIXES
+
+    suffix = FRAMEPAL_SUFFIXES[bits]
     msg = {
         "_prebatched": True, "btid": 0,
         "image" + suffix: packed,
