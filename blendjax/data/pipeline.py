@@ -602,8 +602,7 @@ class TileStreamDecoder:
                 # process's rows keep their own palette.
                 packed_key = next(
                     name + s
-                    for s in (T.TILEPAL2_SUFFIX, T.TILEPAL4_SUFFIX,
-                              T.TILEPAL8_SUFFIX)
+                    for s in T.TILEPAL_SUFFIXES.values()
                     if name + s in fields
                 )
                 b = fields[packed_key].shape[0]
@@ -839,9 +838,8 @@ class TileStreamDecoder:
                         return v.reshape((k * b,) + tuple(v.shape[2:]))
 
                     for suf in (
-                        T.TILES_SUFFIX, T.TILEPAL2_SUFFIX,
-                        T.TILEPAL4_SUFFIX,
-                        T.TILEPAL8_SUFFIX, T.PALETTE_SUFFIX,
+                        T.TILES_SUFFIX, *T.TILEPAL_SUFFIXES.values(),
+                        T.PALETTE_SUFFIX,
                     ):
                         if name + suf in fields:
                             fields[name + suf] = flat(fields[name + suf])

@@ -66,6 +66,9 @@ FRAMESHAPE_SUFFIX = "__frameshape"  # [H, W, C, bits]
 FRAMEPAL_SUFFIXES = {
     2: FRAMEPAL2_SUFFIX, 4: FRAMEPAL4_SUFFIX, 8: FRAMEPAL8_SUFFIX,
 }
+TILEPAL_SUFFIXES = {
+    2: TILEPAL2_SUFFIX, 4: TILEPAL4_SUFFIX, 8: TILEPAL8_SUFFIX,
+}
 
 
 def pack_palette_indices(idx, bits: int):
@@ -379,9 +382,7 @@ def pop_tile_payload(fields: dict, name: str, geom, expand):
     :func:`expand_palette_tiles_np` (host). Shared by every consumer so
     the raw-vs-palette wire variants stay in one place."""
     t = int(geom[3])
-    for suffix, bits in (
-        (TILEPAL2_SUFFIX, 2), (TILEPAL4_SUFFIX, 4), (TILEPAL8_SUFFIX, 8)
-    ):
+    for bits, suffix in TILEPAL_SUFFIXES.items():
         if name + suffix in fields:
             packed = fields.pop(name + suffix)
             pal = fields.pop(name + PALETTE_SUFFIX)

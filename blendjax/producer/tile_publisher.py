@@ -29,6 +29,7 @@ from blendjax.ops.tiles import (
     TILEPAL2_SUFFIX,
     TILEPAL4_SUFFIX,
     TILEPAL8_SUFFIX,
+    TILEPAL_SUFFIXES,
     TILEREF_SUFFIX,
     TILES_SUFFIX,
     TILESHAPE_SUFFIX,
@@ -62,8 +63,8 @@ class TileBatchPublisher:
 
     ``palette=True`` (default) palette-compresses tile payloads when
     changed tiles hold few distinct colors (flat-shaded frames usually
-    do): <=16 colors ship as 4-bit indices (8x fewer bytes), <=256 as
-    bytes (4x); more falls back to raw tiles. Lossless either way — the
+    do): <=4 colors ship as 2-bit indices (16x fewer bytes), <=16 as
+    4-bit (8x), <=256 as bytes (4x); more falls back to raw tiles. Lossless either way — the
     consumer's decode gathers through the palette on device. With
     full-channel tiles (``alpha_slice=False``) and the native helpers
     available, palettization FUSES into the changed-tile scan (one
@@ -339,11 +340,12 @@ class TileBatchPublisher:
             if cmax <= 4 and tt % 4 == 0:
                 # four 2-bit indices per byte (flat-shaded frames often
                 # hold <=4 colors: background + a few faces)
-                bits, suffix, cap_colors = 2, TILEPAL2_SUFFIX, 4
+                bits, cap_colors = 2, 4
             elif cmax <= 16 and tt % 2 == 0:
-                bits, suffix, cap_colors = 4, TILEPAL4_SUFFIX, 16
+                bits, cap_colors = 4, 16
             else:
-                bits, suffix, cap_colors = 8, TILEPAL8_SUFFIX, 256
+                bits, cap_colors = 8, 256
+            suffix = TILEPAL_SUFFIXES[bits]
             # fresh allocation either way: pal_idx is a reused batch
             # array and publish hands buffers to the IO thread by ref
             packed = (
@@ -405,10 +407,7 @@ class TileBatchPublisher:
         if compressed is not None:
             self._palette_misses = 0
             packed, pal, bits = compressed
-            suffix = {
-                2: TILEPAL2_SUFFIX, 4: TILEPAL4_SUFFIX,
-                8: TILEPAL8_SUFFIX,
-            }[bits]
+            suffix = TILEPAL_SUFFIXES[bits]
             msg[self.field + suffix] = packed
             msg[self.field + PALETTE_SUFFIX] = pal
         else:

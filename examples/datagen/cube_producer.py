@@ -104,10 +104,12 @@ def main() -> None:
     elif opts.encoding == "pal":
         # Non-sparse lossless codec: palette-compress FULL frames (no
         # reference, no temporal assumption — only "synthetic frames
-        # carry few colors"). 4x/8x fewer bytes across the socket AND
+        # carry few colors"). Per-frame palettes: 16x/8x/4x fewer bytes
+        # (2/4/8-bit indices by the widest frame) across the socket AND
         # the host->device link; the consumer decodes with one fused
         # gather on device (blendjax.ops.tiles.palettize_frames).
-        # Falls back to a raw batch whenever a batch exceeds 256 colors.
+        # Falls back to a raw batch whenever ANY frame exceeds 256
+        # colors.
         from blendjax.ops.tiles import (
             FRAMEPAL_SUFFIXES,
             FRAMESHAPE_SUFFIX,

@@ -27,13 +27,15 @@ def main():
     ap.add_argument("--record", default=None, help="record stream to PREFIX")
     ap.add_argument("--replay", default=None, help="replay from PREFIX (no producers)")
     ap.add_argument(
-        "--encoding", choices=["raw", "tile"], default="raw",
-        help="'tile' streams only changed tiles (decoded on device)",
+        "--encoding", choices=["raw", "tile", "pal"], default="raw",
+        help="'tile' streams only changed tiles (decoded on device); "
+        "'pal' palette-compresses whole frames (the lossless non-sparse "
+        "codec — no reference frame)",
     )
     ap.add_argument(
         "--chunk", type=int, default=1,
-        help="coalesce K tile batches into one transfer + one jitted "
-        "scan of K updates (needs --encoding tile)",
+        help="coalesce K tile/pal batches into one transfer + one "
+        "jitted scan of K updates (needs --encoding tile or pal)",
     )
     ap.add_argument(
         "--augment", action="store_true",
@@ -72,7 +74,7 @@ def main():
         from blendjax.ops.augment import color_jitter, make_augment
 
         augment = make_augment(color_jitter)
-    chunk = args.chunk if args.encoding == "tile" else 1
+    chunk = args.chunk if args.encoding in ("tile", "pal") else 1
     if chunk > 1:
         # K sequential updates per device call (see docs/performance.md);
         # augmentation keys fold the in-scan step counter, so this
@@ -117,8 +119,10 @@ def main():
         return
 
     producer_args = ["--shape", str(h), str(w)]
-    if args.encoding == "tile":
-        producer_args += ["--batch", str(args.batch), "--encoding", "tile"]
+    if args.encoding in ("tile", "pal"):
+        producer_args += [
+            "--batch", str(args.batch), "--encoding", args.encoding,
+        ]
     with PythonProducerLauncher(
         script=__file__.replace("train.py", "cube_producer.py"),
         num_instances=args.instances,

@@ -64,6 +64,16 @@ def test_datagen_train_tile_chunk_augment(monkeypatch, capsys):
     assert "step 0: loss=" in out and "images/sec" in out
 
 
+def test_datagen_train_pal_chunk(monkeypatch, capsys):
+    run_main(
+        monkeypatch, "datagen/train.py",
+        "--steps", "2", "--instances", "1", "--batch", "8",
+        "--shape", "64", "64", "--encoding", "pal", "--chunk", "2",
+    )
+    out = capsys.readouterr().out
+    assert "step 0: loss=" in out and "images/sec" in out
+
+
 def test_datagen_train_record_then_replay(monkeypatch, capsys, tmp_path):
     prefix = str(tmp_path / "rec")
     run_main(
