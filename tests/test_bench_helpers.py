@@ -1,0 +1,46 @@
+"""Guards for bench.py's measurement helpers (they feed BENCH_r*.json,
+the judged record — a silent mis-measurement is worse than a crash)."""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+
+
+def test_model_flops_matches_analytic_count():
+    """cost_analysis-derived FLOPs/img must agree with the analytic
+    conv count — catches the lax.scan-body-counted-once class of bug
+    (r4 shipped a 16x undercount briefly) and any future model/shape
+    drift that silently changes the MFU denominator."""
+    import bench
+
+    fl = bench.measure_model_flops()
+    got = fl["flops_per_image"]
+
+    # Analytic fwd FLOPs for CubeRegressor at 480x640: stride-2 3x3
+    # convs (32, 64, 128, 256) + the dense head; backward ~2x forward.
+    h, w, cin = 480, 640, 4
+    fwd = 0
+    for f in (32, 64, 128, 256):
+        h, w = h // 2, w // 2
+        fwd += 2 * 9 * cin * f * h * w
+        cin = f
+    fwd += 2 * 256 * 256 + 2 * 256 * 16  # dense head
+    analytic = 3 * fwd  # fwd + ~2x bwd
+    assert 0.7 * analytic < got < 1.3 * analytic, (got, analytic)
+
+
+def test_pipelined_ceiling_caps_and_flags(monkeypatch):
+    """A ceiling run that exceeds its time cap must return what it
+    measured, flagged 'capped' (a silently depressed ceiling would
+    publish utilization_vs_ceiling > 1 as if live beat the runtime).
+
+    Bench-shape constants are shrunk for the CPU mesh (the cap logic is
+    shape-independent; full 640x480 CPU convs would cost ~6 min)."""
+    import bench
+
+    monkeypatch.setattr(bench, "SHAPE", (64, 64))
+    monkeypatch.setattr(bench, "BATCH", 8)
+    out = bench.measure_pipelined_ceiling(2, items=32, time_cap=0.0)
+    assert out["images"] > 0 and out["img_s"] > 0
+    assert out.get("capped") is True
