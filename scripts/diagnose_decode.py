@@ -1,0 +1,145 @@
+"""Per-stage slopes of the tile-decode chain on a real TPU.
+
+Quantifies where a chunk group's device time goes — palette expand,
+ref-broadcast base init, Pallas scatter (incl. transpose to frames),
+and the train step — using the ONLY timing method that is honest on
+tunneled backends (docs/performance.md "Measurement hygiene"): chain
+``--reps`` iterations of each stage between two d2h fetches and report
+the slope, so the ~0.1s sync constant divides out.
+
+Run: ``python scripts/diagnose_decode.py [--reps 8]``. Prints one line
+per stage. Feeds the r4->r5 lever ranking in PARITY.md.
+"""
+
+from __future__ import annotations
+
+import argparse
+import time
+
+import numpy as np
+
+
+def timed(fn, args, reps: int, sync) -> float:
+    out = fn(*args)
+    sync(out)  # warm/compile
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = fn(*args)
+    sync(out)
+    total = time.perf_counter() - t0
+    # one chained run has one sync; subtract a measured bare fetch
+    t1 = time.perf_counter()
+    sync(out)
+    bare = time.perf_counter() - t1
+    return max(total - bare, 1e-9) / reps
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--reps", type=int, default=8)
+    ap.add_argument("--batch", type=int, default=128,
+                    help="frames per chunk group (chunk*B)")
+    args = ap.parse_args()
+    if args.batch % 8:
+        ap.error("--batch must be a multiple of 8 (the step's B)")
+
+    import jax
+    import jax.numpy as jnp
+
+    import blendjax.ops.tiles as T
+    from blendjax.models import CubeRegressor
+    from blendjax.parallel import create_mesh
+    from blendjax.train import make_chunked_supervised_step, make_train_state
+
+    B, K, t, C, N = args.batch, 288, 16, 4, 1200
+    H, W = 480, 640
+    tt, lanes = t * t, t * t * C // 8
+    rng = np.random.default_rng(0)
+    palidx = rng.integers(0, 4, (B, K, tt), np.uint8)
+    packed2 = jax.device_put(T.pack_palette_indices(palidx, 2))
+    pal_d = jax.device_put(
+        rng.integers(0, 255, (B, 4, C)).astype(np.uint8)
+    )
+    idx_d = jax.device_put(
+        np.sort(rng.choice(N, (B, K), replace=True)).astype(np.int32)
+    )
+    ref = rng.integers(0, 255, (H, W, C), np.uint8)
+    ref_tiles = jax.device_put(np.asarray(T.tile_ref(ref, t)))
+    raw_tiles = jax.device_put(
+        rng.integers(0, 255, (B, K, t, t, C), np.uint8)
+    )
+
+    def sync(x):
+        leaf = jax.tree_util.tree_leaves(x)[-1]
+        np.asarray(leaf).reshape(-1)[-1]
+
+    expand = jax.jit(
+        lambda p, q: T.expand_palette_tiles(p, q, 2, t, C)
+    )
+    base_init = jax.jit(
+        lambda r: jnp.concatenate([
+            jnp.broadcast_to(r.reshape(1, N, 8, lanes), (B, N, 8, lanes)),
+            jnp.zeros((B, 1, 8, lanes), jnp.uint8),
+        ], axis=1)
+    )
+    scatter = jax.jit(
+        lambda i, tl, r: T.decode_tile_delta(r, i, tl, (H, W, C))
+    )
+    full_decode = jax.jit(
+        lambda p, q, i, r: T.decode_tile_delta(
+            r, i, T.expand_palette_tiles(p, q, 2, t, C), (H, W, C)
+        )
+    )
+
+    mesh = create_mesh({"data": -1})
+    state = make_train_state(
+        CubeRegressor(), np.zeros((8, H, W, 4), np.uint8), mesh=mesh
+    )
+    step = make_chunked_supervised_step()
+    frames = jax.device_put(
+        rng.integers(0, 255, (B // 8, 8, H, W, 4), np.uint8)
+    )
+    xy = jax.device_put(
+        (rng.random((B // 8, 8, 8, 2)) * W).astype(np.float32)
+    )
+
+    host_buf = np.ascontiguousarray(
+        rng.integers(0, 255, (B * 19 * 1024,), np.uint8)
+    )  # ~19KB/img: the pal2-era wire size
+
+    results = {
+        "transfer (pal2-sized buffer)": timed(
+            jax.device_put, (host_buf,), args.reps, sync
+        ),
+        "palette expand (pal2)": timed(
+            expand, (packed2, pal_d), args.reps, sync
+        ),
+        "base init (ref broadcast+concat)": timed(
+            base_init, (ref_tiles,), args.reps, sync
+        ),
+        "scatter+transpose (raw tiles)": timed(
+            scatter, (idx_d, raw_tiles, ref_tiles), args.reps, sync
+        ),
+        "full decode (expand+scatter)": timed(
+            full_decode, (packed2, pal_d, idx_d, ref_tiles),
+            args.reps, sync,
+        ),
+    }
+
+    cell = {"state": state}  # the step donates its state buffers
+
+    def run_step(fr, xy_):
+        cell["state"], m = step(cell["state"], {"image": fr, "xy": xy_})
+        return m["loss"]
+
+    results["train step (chunked)"] = timed(
+        run_step, (frames, xy), args.reps, sync
+    )
+
+    for name, dt in results.items():
+        print(f"{name}: {dt * 1000:8.1f} ms/group  "
+              f"({args.batch / dt:7.0f} img/s)")
+
+
+if __name__ == "__main__":
+    main()

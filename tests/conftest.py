@@ -16,6 +16,14 @@ _pp = [p for p in os.environ.get("PYTHONPATH", "").split(os.pathsep) if p]
 if _repo_root not in _pp:
     os.environ["PYTHONPATH"] = os.pathsep.join([_repo_root] + _pp)
 
+# The CURRENT interpreter also needs the repo root importable (tests
+# import repo-root modules like `bench`): the bare `pytest` entry point
+# does not put the cwd on sys.path the way `python -m pytest` does.
+import sys
+
+if _repo_root not in sys.path:
+    sys.path.insert(0, _repo_root)
+
 # Opt-in real-device runs: `BLENDJAX_TEST_TPU=1 pytest -m tpu` skips the
 # CPU-mesh override so tpu-marked tests really touch the device.
 if os.environ.get("BLENDJAX_TEST_TPU") != "1":
