@@ -26,9 +26,6 @@ from blendjax.ops.tiles import (
     PALETTE_SUFFIX,
     TILE,
     TILEIDX_SUFFIX,
-    TILEPAL2_SUFFIX,
-    TILEPAL4_SUFFIX,
-    TILEPAL8_SUFFIX,
     TILEPAL_SUFFIXES,
     TILEREF_SUFFIX,
     TILES_SUFFIX,
