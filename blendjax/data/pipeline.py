@@ -89,7 +89,13 @@ class DeviceFeeder:
         jax = _require_jax()
         out = {}
         for k, v in batch.items():
-            if k == "_meta":
+            if k == "_meta" or getattr(v, "ndim", None) in (None, 0):
+                # Host-side sidecars: per-item provenance and scalars —
+                # plain ints AND rank-0 numpy values (the wire codec
+                # preserves either form of a producer's ``btid`` stamp)
+                # — stay off-device: multihost assembly would otherwise
+                # build a "replicated" global from values that DIFFER
+                # per process (each producer stamps its own id).
                 out[k] = v
                 continue
             if isinstance(v, jax.Array) and len(v.sharding.device_set) > 1:

@@ -205,6 +205,51 @@ def main() -> int:
         fid, np.arange(ndev)[None, :] + 100 * np.arange(K)[:, None]
     )
 
+    # -- full-frame palette stream (non-sparse codec) ---------------------
+    # multihost pal batches take the host-expand fallback, then the
+    # standard global assembly; every process's shard rows must decode
+    # bit-exact vs its own frames.
+    from blendjax.ops.tiles import (
+        FRAMEPAL_SUFFIXES,
+        FRAMESHAPE_SUFFIX,
+        PALETTE_SUFFIX,
+        palettize_frames,
+    )
+
+    pal_frames = np.stack([
+        np.repeat(
+            ((np.arange(32 * 32).reshape(32, 32, 1) + g * 7) % 4
+             ).astype(np.uint8) * 61,
+            4, axis=-1,
+        )
+        for g in range(ndev)
+    ])
+    local_pal = pal_frames[pid * b_local: (pid + 1) * b_local]
+    packed, palette, bits = palettize_frames(local_pal)
+
+    def pal_messages():
+        yield {
+            "_prebatched": True, "btid": pid,
+            "image" + FRAMEPAL_SUFFIXES[bits]: packed,
+            "frameid": np.asarray(rows),
+            "image" + PALETTE_SUFFIX: palette,
+            "image" + FRAMESHAPE_SUFFIX: np.array(
+                [32, 32, 4, bits], np.int32
+            ),
+        }
+
+    with StreamDataPipeline(
+        pal_messages(), batch_size=b_local, sharding=sharding,
+        multihost=True,
+    ) as pipe:
+        (pb,) = list(pipe)
+    assert pb["image"].shape == (ndev, 32, 32, 4), pb["image"].shape
+    for shard in pb["image"].addressable_shards:
+        g = shard.index[0].start or 0
+        np.testing.assert_array_equal(
+            np.asarray(shard.data)[0], pal_frames[g]
+        )
+
     print(f"mp_worker {pid}/{nproc} ok: ndev={ndev}")
     return 0
 
