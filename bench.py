@@ -539,20 +539,47 @@ def main() -> None:
     except Exception:
         pass  # older jax without these flags: compile per run
 
+    # Upfront link-health probe: the tunnel has multi-hour outage modes
+    # (observed: d2h round trips of 3-24 s vs ~0.1 s normal). In that
+    # state a full-size bench would grind past any reasonable driver
+    # timeout and record NOTHING — shrink the workload instead and
+    # stamp the probe into the JSON so the numbers read as what they
+    # are: a measurement of a degraded link, not of the framework.
+    rtt = None
+    try:
+        # one UNTIMED round trip first: the first device op pays PJRT
+        # backend init, which would misread as link latency
+        np.asarray(jax.device_put(np.zeros(8, np.uint8)))
+        t0 = time.perf_counter()
+        probe = jax.device_put(np.zeros(8, np.uint8))
+        np.asarray(probe)
+        rtt = time.perf_counter() - t0
+    except Exception:
+        pass
+    degraded = rtt is not None and rtt > 1.0
+
     # BLENDJAX_BENCH_PASSES measurement passes (default 4), best
     # sustained reported: the device link's throughput swings
     # several-fold within minutes (tunnel weather), so a single sample
     # under-reports the pipeline more often than not. Every pass lands
     # in detail.passes for the full picture.
     n_passes = max(1, int(os.environ.get("BLENDJAX_BENCH_PASSES", "4")))
+    items = MEASURE_ITEMS
+    if degraded:
+        n_passes = min(n_passes, 2)
+        items = min(items, 256)
     passes = [
-        measure(ENCODING, CHUNK, MEASURE_ITEMS, TIME_CAP_S)
+        measure(ENCODING, CHUNK, items, TIME_CAP_S)
         for _ in range(n_passes)
     ]
     primary = max(passes, key=lambda r: r["value"])
     detail = dict(primary)
     ips = detail.pop("value")
     detail["backend"] = jax.default_backend()
+    if rtt is not None:
+        detail["link_rtt_s"] = round(rtt, 3)
+    if degraded:
+        detail["degraded_link"] = True
     detail["passes"] = [
         {"value": p["value"], "seconds": p["seconds"]} for p in passes
     ]
@@ -595,7 +622,7 @@ def main() -> None:
                 )
         except Exception as e:  # pragma: no cover - device flake path
             detail["model_flops"] = {"error": repr(e)[:200]}
-    if ENCODING == "tile":
+    if ENCODING == "tile" and not degraded:
         # Only meaningful when the headline ran the tile stream the
         # ceiling replays — comparing codecs would make the ratio lie.
         try:
@@ -618,7 +645,7 @@ def main() -> None:
         detail["rl_hz"] = measure_rl_hz()
     except Exception as e:  # pragma: no cover - producer flake path
         detail["rl_hz"] = {"error": repr(e)[:200]}
-    if ENCODING == "tile" and RAW_ROW:
+    if ENCODING == "tile" and RAW_ROW and not degraded:
         # Shorter full-frame row: tracks the non-sparse path (whole
         # frames, no temporal-delta assumption) without doubling bench
         # time. Default codec is the lossless full-frame palette

@@ -89,13 +89,16 @@ class DeviceFeeder:
         jax = _require_jax()
         out = {}
         for k, v in batch.items():
-            if k == "_meta" or getattr(v, "ndim", None) in (None, 0):
+            if k == "_meta" or isinstance(v, (int, float)) or getattr(
+                v, "ndim", -1
+            ) == 0:
                 # Host-side sidecars: per-item provenance and scalars —
                 # plain ints AND rank-0 numpy values (the wire codec
                 # preserves either form of a producer's ``btid`` stamp)
                 # — stay off-device: multihost assembly would otherwise
                 # build a "replicated" global from values that DIFFER
-                # per process (each producer stamps its own id).
+                # per process (each producer stamps its own id). Lists
+                # and other array-likes keep their device placement.
                 out[k] = v
                 continue
             if isinstance(v, jax.Array) and len(v.sharding.device_set) > 1:
@@ -123,8 +126,11 @@ class DeviceFeeder:
             )
             spec_rank = len(getattr(s, "spec", ()) or ())
             if s is not None and getattr(v, "ndim", 0) < spec_rank:
-                # Scalar/low-rank sidecar fields (e.g. a producer's btid
-                # stamp) can't take the batch sharding: replicate instead.
+                # Fields of lower rank than the configured spec can't
+                # take the batch sharding: replicate instead. (True
+                # scalars never reach here — they stay on host above;
+                # this covers e.g. a rank-1 field under a rank-2
+                # per-field spec.)
                 from jax.sharding import NamedSharding, PartitionSpec
 
                 s = NamedSharding(s.mesh, PartitionSpec())
