@@ -524,7 +524,10 @@ def measure_rl_hz(seconds: float = 3.0) -> dict:
             "steps": steps, "seconds": round(dt, 2)}
 
 
-def main() -> None:
+def _build_record(progress: dict) -> dict:
+    """The whole measurement workload; ``progress`` is shared with the
+    watchdog in :func:`main` so a hard device stall can still emit
+    whatever phases completed."""
     import jax
 
     # Persistent XLA compile cache: the train step costs a few seconds to
@@ -568,12 +571,16 @@ def main() -> None:
     if degraded:
         n_passes = min(n_passes, 2)
         items = min(items, 256)
-    passes = [
-        measure(ENCODING, CHUNK, items, TIME_CAP_S)
-        for _ in range(n_passes)
-    ]
+    passes = []
+    for _ in range(n_passes):
+        passes.append(measure(ENCODING, CHUNK, items, TIME_CAP_S))
+        progress["passes"] = [
+            {"value": q["value"], "seconds": q["seconds"]} for q in passes
+        ]
     primary = max(passes, key=lambda r: r["value"])
     detail = dict(primary)
+    progress["detail"] = detail  # live reference: add-on rows appear
+    # in the watchdog's partial record as they land
     ips = detail.pop("value")
     detail["backend"] = jax.default_backend()
     if rtt is not None:
@@ -674,17 +681,80 @@ def main() -> None:
                 )
                 raw["compression"] = round(decoded / wire, 2)
         detail["raw_row"] = raw
+    return {
+        "metric": "cube_640x480_stream+train images/sec/chip",
+        "value": ips,
+        "unit": "images/s",
+        "vs_baseline": round(ips / BASELINE_IMG_PER_SEC, 3),
+        "detail": detail,
+    }
+
+
+def main() -> None:
+    """Run the workload under a watchdog: the tunnel has hard-stall
+    modes (a single device call blocking for 10+ minutes with a HEALTHY
+    round-trip probe) in which the record would otherwise be lost to
+    the driver's process timeout. On deadline the partial record prints
+    and every spawned producer is reaped (worker-thread spawns carry no
+    PDEATHSIG, and os._exit skips their context-manager teardown)."""
+    import threading
+
+    # imported BEFORE the worker starts: during a bail-out the stalled
+    # worker may hold import locks, and this module pulls no jax
+    from blendjax.launcher.launcher import kill_all_spawned
+
+    progress: dict = {}
+    done: dict = {}
+
+    def work():
+        try:
+            done["record"] = _build_record(progress)
+        except BaseException as e:  # noqa: BLE001 - recorded, re-raised
+            done["error"] = repr(e)[:300]
+            raise
+
+    t = threading.Thread(target=work, daemon=True)
+    t.start()
+    deadline = float(os.environ.get("BLENDJAX_BENCH_DEADLINE_S", "1500"))
+    t.join(deadline)
+    if "record" in done:
+        print(json.dumps(done["record"]))
+        return
+    if not t.is_alive():
+        # the workload CRASHED (vs stalled): emit the partial record for
+        # the archive but exit nonzero so drivers/CI see the failure
+        detail = dict(progress.get("detail") or {})
+        detail["error"] = done.get("error", "workload thread died")
+        detail["passes"] = progress.get("passes", [])
+        print(json.dumps({
+            "metric": "cube_640x480_stream+train images/sec/chip",
+            "value": 0.0, "unit": "images/s", "vs_baseline": 0.0,
+            "detail": detail,
+        }))
+        sys.exit(1)
+    passes = progress.get("passes", [])
+    best = max((p["value"] for p in passes), default=0.0)
+    detail = dict(progress.get("detail") or {})
+    detail["passes"] = passes
+    detail["hard_stall"] = (
+        done.get("error")
+        or f"no result within BLENDJAX_BENCH_DEADLINE_S={deadline:.0f}s "
+        "(device call stalled)"
+    )
     print(
         json.dumps(
             {
                 "metric": "cube_640x480_stream+train images/sec/chip",
-                "value": ips,
+                "value": best,
                 "unit": "images/s",
-                "vs_baseline": round(ips / BASELINE_IMG_PER_SEC, 3),
+                "vs_baseline": round(best / BASELINE_IMG_PER_SEC, 3),
                 "detail": detail,
             }
         )
     )
+    sys.stdout.flush()
+    kill_all_spawned()
+    os._exit(0)
 
 
 if __name__ == "__main__":

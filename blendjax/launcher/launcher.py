@@ -32,6 +32,25 @@ from blendjax.utils.logging import get_logger
 
 logger = get_logger("launcher")
 
+# Every producer ever spawned by this process (Popen objects; exited
+# ones stay harmlessly in the list). Emergency teardown for callers
+# that must abandon a stuck session without running context-manager
+# exits — e.g. a benchmark watchdog bailing out of a hard device
+# stall via os._exit, where spawns from worker threads carry no
+# PDEATHSIG and would otherwise orphan onto the shared core forever.
+_ALL_SPAWNED: list = []
+
+
+def kill_all_spawned() -> None:
+    """SIGKILL every still-running spawned producer (by process group:
+    each spawn starts its own session)."""
+    for proc in _ALL_SPAWNED:
+        if proc.poll() is None:
+            try:
+                os.killpg(proc.pid, signal.SIGKILL)
+            except (ProcessLookupError, PermissionError):
+                pass
+
 # PDEATHSIG orphan-proofing is Linux-only (prctl(2)). It is applied via
 # an exec-shim — a fresh single-threaded python that sets the flag on
 # ITSELF then execs the producer in place (same PID) — never via
@@ -230,7 +249,9 @@ class ProcessLauncher:
                 sys.executable, "-S", "-E", "-c", _PDEATHSIG_SHIM,
                 str(os.getpid()), *map(str, argv),
             ]
-        return subprocess.Popen(argv, start_new_session=True, env=env)
+        proc = subprocess.Popen(argv, start_new_session=True, env=env)
+        _ALL_SPAWNED.append(proc)
+        return proc
 
     @property
     def addresses(self) -> dict:
