@@ -43,13 +43,21 @@ _ALL_SPAWNED: list = []
 
 def kill_all_spawned() -> None:
     """SIGKILL every still-running spawned producer (by process group:
-    each spawn starts its own session)."""
-    for proc in _ALL_SPAWNED:
-        if proc.poll() is None:
-            try:
-                os.killpg(proc.pid, signal.SIGKILL)
-            except (ProcessLookupError, PermissionError):
-                pass
+    each spawn starts its own session). Sweeps until the registry stops
+    growing: a concurrently-unsticking worker thread may spawn a new
+    producer mid-sweep, which would otherwise slip through."""
+    swept = 0
+    while True:
+        snapshot = list(_ALL_SPAWNED)
+        if len(snapshot) <= swept:
+            return
+        for proc in snapshot[swept:]:
+            if proc.poll() is None:
+                try:
+                    os.killpg(proc.pid, signal.SIGKILL)
+                except (ProcessLookupError, PermissionError):
+                    pass
+        swept = len(snapshot)
 
 # PDEATHSIG orphan-proofing is Linux-only (prctl(2)). It is applied via
 # an exec-shim — a fresh single-threaded python that sets the flag on
