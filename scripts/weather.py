@@ -1,0 +1,71 @@
+"""Tunnel-weather thermometer for the dev box.
+
+The TPU sits behind a tunnel with three observed modes (memory +
+docs/performance.md "Caveat on recorded numbers"):
+
+- good: d2h RTT ~0.1 s, end-to-end ~500-600 img/s;
+- bandwidth-collapsed: RTT still ~0.1 s but passes at ~20-100 img/s;
+- hard-stall/outage: RTT 3-58 s, or single device calls blocking for
+  10+ minutes.
+
+Run before any perf work: ``python scripts/weather.py [--pass]``.
+``--pass`` adds one real measurement pass (the only way to detect the
+bandwidth-collapsed mode; ~10-45 s in any completing weather). Exits
+nonzero when the window is not fit for measurement.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import sys
+import time
+
+import numpy as np
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def main() -> int:
+    # Hard-stall guard: the mode this script exists to detect can block
+    # a single device call for 10+ minutes — a thermometer must answer.
+    def on_alarm(*_):
+        print("probe stalled: HARD-STALL/OUTAGE mode")
+        os._exit(4)
+
+    signal.signal(signal.SIGALRM, on_alarm)
+    signal.alarm(int(os.environ.get("BLENDJAX_WEATHER_DEADLINE_S", "300")))
+
+    import jax
+
+    try:
+        np.asarray(jax.device_put(np.zeros(8, np.uint8)))  # untimed init
+        t0 = time.perf_counter()
+        np.asarray(jax.device_put(np.zeros(8, np.uint8)))
+        rtt = time.perf_counter() - t0
+    except Exception as e:
+        print(f"probe failed: {e!r}")
+        return 5
+    print(f"d2h rtt: {rtt * 1000:.0f} ms "
+          f"({'ok' if rtt < 0.5 else 'DEGRADED'})")
+    if rtt >= 0.5:
+        return 2
+    if "--pass" not in sys.argv:
+        return 0
+
+    sys.path.insert(0, REPO_ROOT)
+    import bench
+
+    # Same config + floor the bench itself gates retries on, so the
+    # preflight verdict can't drift from the run it predicts.
+    floor = float(os.environ.get("BLENDJAX_BENCH_RETRY_FLOOR", "150"))
+    r = bench.measure(bench.ENCODING, bench.CHUNK, 512, 45.0,
+                      with_stages=False)
+    good = r["value"] > floor
+    print(f"measurement pass: {r['value']} img/s in {r['seconds']} s "
+          f"({'ok' if good else 'BANDWIDTH-COLLAPSED'})")
+    return 0 if good else 3
+
+
+if __name__ == "__main__":
+    sys.exit(main())
