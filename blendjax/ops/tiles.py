@@ -464,11 +464,12 @@ def _palettize_flat(flat: np.ndarray, max_colors: int):
 def palettize_tiles(tiles: np.ndarray, max_colors: int = 256):
     """Try to palette-compress a packed tile array (B, K, t, t, C).
 
-    Returns ``(packed, palette, bits)`` — ``packed`` is (B, K, t*t/2)
-    uint8 nibbles for ``bits=4`` or (B, K, t*t) bytes for ``bits=8``,
-    ``palette`` is (16|256, C) zero-padded — or ``None`` when the tiles
-    hold more than ``max_colors`` distinct colors (ship raw instead).
-    Runs as one native C pass when available; numpy fallback.
+    Returns ``(packed, palette, bits)`` — ``packed`` is
+    (B, K, t*t/4 | t*t/2 | t*t) uint8 for ``bits`` 2/4/8 (chosen by the
+    batch's distinct-color count: <=4 / <=16 / <=256), ``palette`` is
+    (4|16|256, C) zero-padded — or ``None`` when the tiles hold more
+    than ``max_colors`` distinct colors (ship raw instead). Runs as one
+    native C pass when available; numpy fallback.
     """
     max_colors = min(int(max_colors), 256)  # uint8 indices; native tables
     b, k, t, _, c = tiles.shape
