@@ -57,6 +57,19 @@ OVERLAP = os.environ.get("BLENDJAX_BENCH_OVERLAP", "0") == "1"
 # was op-latency bound once the bytes shrank).
 RAW_ENCODING = os.environ.get("BLENDJAX_BENCH_RAW_ENCODING", "pal")
 RAW_CHUNK = int(os.environ.get("BLENDJAX_BENCH_RAW_CHUNK", "8"))
+# Tile geometry: "16" = square 16x16 (slot-scatter decode); "16x32" =
+# rectangular tiles whose rows span 128 lanes at C=4, so the consumer
+# decode takes the direct-spatial Pallas kernel (one pass: no slot
+# buffer, no ref-broadcast init, no transpose). Capacity pins the fleet
+# wire shape: the 32-aligned fit over the cube's measured max changed-
+# tile count (282 @16x16 -> 288; 154 @16x32 -> 160). Both geometries
+# decode bit-exactly (scripts/check_spatial_decode.py on real TPU).
+TILE_GEOM = os.environ.get("BLENDJAX_BENCH_TILE", "16")
+_TILE_ARGS = TILE_GEOM.split("x")
+TILE_CAPACITY = os.environ.get(
+    "BLENDJAX_BENCH_TILE_CAPACITY",
+    "288" if len(_TILE_ARGS) == 1 else "160",
+)
 
 
 def measure(encoding: str, chunk: int, items: int, time_cap: float,
@@ -127,8 +140,8 @@ def measure(encoding: str, chunk: int, items: int, time_cap: float,
         # on overflow).
         instance_args=[
             ["--shape", str(SHAPE[0]), str(SHAPE[1]), "--batch", str(BATCH),
-             "--encoding", encoding, "--tile", "16", "--tile-rgba",
-             "--tile-capacity", "288"]
+             "--encoding", encoding, "--tile", *_TILE_ARGS, "--tile-rgba",
+             "--tile-capacity", TILE_CAPACITY]
         ] * instances,
     ) as launcher:
         def batch_images(sb):
@@ -355,8 +368,8 @@ def measure_pipelined_ceiling(chunk: int, items: int = 512,
         proto="ipc",
         instance_args=[
             ["--shape", str(SHAPE[0]), str(SHAPE[1]), "--batch", str(BATCH),
-             "--encoding", "tile", "--tile", "16", "--tile-rgba",
-             "--tile-capacity", "288"]
+             "--encoding", "tile", "--tile", *_TILE_ARGS, "--tile-rgba",
+             "--tile-capacity", TILE_CAPACITY]
         ],
     ) as launcher:
         stream = RemoteStream(

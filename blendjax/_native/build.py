@@ -54,9 +54,10 @@ def _build(src_path: str, tag: str):
 def load_tile_delta():
     """Returns the native changed-tile scan or None.
 
-    ``tile_delta(img u8[h,w,c], ref u8[h,w,c], h, w, c, t, ty0, ty1,
-    tx0, tx1, idx_out i32[n_tiles], tiles_out u8[n_tiles,t,t,c]) ->
-    count`` (tile-grid bounds restrict the scan).
+    ``tile_delta(img u8[h,w,c], ref u8[h,w,c], h, w, c, th, tw, ty0,
+    ty1, tx0, tx1, idx_out i32[n_tiles], tiles_out u8[n_tiles,th,tw,c])
+    -> count`` (tile-grid bounds restrict the scan; th/tw are the tile
+    pixel dims — square tiles pass the same value twice).
     """
     if os.environ.get("BLENDJAX_NO_NATIVE") == "1":
         return None
@@ -72,7 +73,7 @@ def load_tile_delta():
                 fn.argtypes = [
                     u8p, u8p,
                     ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
-                    ctypes.c_int64,
+                    ctypes.c_int64, ctypes.c_int64,
                     ctypes.c_int64, ctypes.c_int64,
                     ctypes.c_int64, ctypes.c_int64,
                     ctypes.POINTER(ctypes.c_int32), u8p,
@@ -109,8 +110,8 @@ def load_palettize():
 def load_tile_delta_palidx():
     """Returns the fused changed-tile scan + palettizer or None.
 
-    ``tile_delta_palidx(img, ref, h, w, c, t, ty0, ty1, tx0, tx1,
-    idx_out i32[n_tiles], palidx_out u8[n_tiles*t*t], keys u32[1024],
+    ``tile_delta_palidx(img, ref, h, w, c, th, tw, ty0, ty1, tx0, tx1,
+    idx_out i32[n_tiles], palidx_out u8[n_tiles*th*tw], keys u32[1024],
     vals i16[1024], palette u8[256*c], pcount i64[1], cap) ->
     count | -1`` — keys/vals/palette/pcount are caller-owned persistent
     stream state.
@@ -131,7 +132,7 @@ def load_tile_delta_palidx():
                 fn.argtypes = [
                     ctypes.c_void_p, ctypes.c_void_p,
                     ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
-                    ctypes.c_int64,
+                    ctypes.c_int64, ctypes.c_int64,
                     ctypes.c_int64, ctypes.c_int64,
                     ctypes.c_int64, ctypes.c_int64,
                     ctypes.c_void_p, ctypes.c_void_p,

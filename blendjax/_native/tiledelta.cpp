@@ -12,28 +12,32 @@
 
 extern "C" {
 
-// img, ref: h*w*c uint8, C-contiguous. t divides h and w (checked by the
-// Python caller). idx_out has capacity for all (h/t)*(w/t) tiles and
-// tiles_out for as many t*t*c blocks, so overflow is impossible.
-// [ty0,ty1) x [tx0,tx1) bounds the scan to tiles the caller knows may
-// have changed (e.g. the rasterizer's dirty rect); pass the full grid
-// when no such promise exists. Returns the number of changed tiles.
+// img, ref: h*w*c uint8, C-contiguous. Tiles are th x tw pixels (th
+// divides h, tw divides w — checked by the Python caller; rectangular
+// tiles exist so tw*c can hit the TPU's 128-lane width, see
+// ops/tiles.py:tile_hw). idx_out has capacity for all (h/th)*(w/tw)
+// tiles and tiles_out for as many th*tw*c blocks, so overflow is
+// impossible. [ty0,ty1) x [tx0,tx1) bounds the scan to tiles the
+// caller knows may have changed (e.g. the rasterizer's dirty rect);
+// pass the full grid when no such promise exists. Returns the number
+// of changed tiles.
 int64_t bjx_tile_delta(const uint8_t* img, const uint8_t* ref,
-                       int64_t h, int64_t w, int64_t c, int64_t t,
+                       int64_t h, int64_t w, int64_t c,
+                       int64_t th, int64_t tw,
                        int64_t ty0, int64_t ty1, int64_t tx0, int64_t tx1,
                        int32_t* idx_out, uint8_t* tiles_out) {
-  const int64_t tw = w / t;
-  const int64_t th = h / t;
-  const int64_t row_bytes = w * c;    // one image row
-  const int64_t trow_bytes = t * c;   // one tile row
-  ty0 = std::max<int64_t>(ty0, 0); ty1 = std::min<int64_t>(ty1, th);
-  tx0 = std::max<int64_t>(tx0, 0); tx1 = std::min<int64_t>(tx1, tw);
+  const int64_t gw = w / tw;
+  const int64_t gh = h / th;
+  const int64_t row_bytes = w * c;     // one image row
+  const int64_t trow_bytes = tw * c;   // one tile row
+  ty0 = std::max<int64_t>(ty0, 0); ty1 = std::min<int64_t>(ty1, gh);
+  tx0 = std::max<int64_t>(tx0, 0); tx1 = std::min<int64_t>(tx1, gw);
   int64_t count = 0;
   for (int64_t ty = ty0; ty < ty1; ++ty) {
     for (int64_t tx = tx0; tx < tx1; ++tx) {
-      const int64_t base = (ty * t) * row_bytes + tx * trow_bytes;
+      const int64_t base = (ty * th) * row_bytes + tx * trow_bytes;
       bool changed = false;
-      for (int64_t y = 0; y < t; ++y) {
+      for (int64_t y = 0; y < th; ++y) {
         if (std::memcmp(img + base + y * row_bytes,
                         ref + base + y * row_bytes, trow_bytes) != 0) {
           changed = true;
@@ -41,9 +45,9 @@ int64_t bjx_tile_delta(const uint8_t* img, const uint8_t* ref,
         }
       }
       if (!changed) continue;
-      idx_out[count] = (int32_t)(ty * tw + tx);
-      uint8_t* dst = tiles_out + count * t * trow_bytes;
-      for (int64_t y = 0; y < t; ++y) {
+      idx_out[count] = (int32_t)(ty * gw + tx);
+      uint8_t* dst = tiles_out + count * th * trow_bytes;
+      for (int64_t y = 0; y < th; ++y) {
         std::memcpy(dst + y * trow_bytes, img + base + y * row_bytes,
                     trow_bytes);
       }
@@ -110,7 +114,8 @@ int64_t bjx_palettize(const uint8_t* px, int64_t n, int64_t c,
 // within a batch), so frames already returned this batch remain
 // decodable against the table.
 int64_t bjx_tile_delta_palidx(const uint8_t* img, const uint8_t* ref,
-                              int64_t h, int64_t w, int64_t c, int64_t t,
+                              int64_t h, int64_t w, int64_t c,
+                              int64_t th, int64_t tw,
                               int64_t ty0, int64_t ty1,
                               int64_t tx0, int64_t tx1,
                               int32_t* idx_out, uint8_t* palidx_out,
@@ -118,19 +123,19 @@ int64_t bjx_tile_delta_palidx(const uint8_t* img, const uint8_t* ref,
                               uint8_t* palette, int64_t* pcount,
                               int64_t cap_colors) {
   if (cap_colors > 256 || c > 4) return -1;
-  const int64_t tw = w / t;
-  const int64_t th = h / t;
+  const int64_t gw = w / tw;
+  const int64_t gh = h / th;
   const int64_t row_bytes = w * c;
-  const int64_t trow_bytes = t * c;
+  const int64_t trow_bytes = tw * c;
   const int64_t mask = 1023;  // table is always 1024 slots
-  ty0 = std::max<int64_t>(ty0, 0); ty1 = std::min<int64_t>(ty1, th);
-  tx0 = std::max<int64_t>(tx0, 0); tx1 = std::min<int64_t>(tx1, tw);
+  ty0 = std::max<int64_t>(ty0, 0); ty1 = std::min<int64_t>(ty1, gh);
+  tx0 = std::max<int64_t>(tx0, 0); tx1 = std::min<int64_t>(tx1, gw);
   int64_t count = 0;
   for (int64_t ty = ty0; ty < ty1; ++ty) {
     for (int64_t tx = tx0; tx < tx1; ++tx) {
-      const int64_t base = (ty * t) * row_bytes + tx * trow_bytes;
+      const int64_t base = (ty * th) * row_bytes + tx * trow_bytes;
       bool changed = false;
-      for (int64_t y = 0; y < t; ++y) {
+      for (int64_t y = 0; y < th; ++y) {
         if (std::memcmp(img + base + y * row_bytes,
                         ref + base + y * row_bytes, trow_bytes) != 0) {
           changed = true;
@@ -138,11 +143,11 @@ int64_t bjx_tile_delta_palidx(const uint8_t* img, const uint8_t* ref,
         }
       }
       if (!changed) continue;
-      idx_out[count] = (int32_t)(ty * tw + tx);
-      uint8_t* dst = palidx_out + count * t * t;
-      for (int64_t y = 0; y < t; ++y) {
+      idx_out[count] = (int32_t)(ty * gw + tx);
+      uint8_t* dst = palidx_out + count * th * tw;
+      for (int64_t y = 0; y < th; ++y) {
         const uint8_t* src = img + base + y * row_bytes;
-        for (int64_t x = 0; x < t; ++x) {
+        for (int64_t x = 0; x < tw; ++x) {
           uint32_t key = 0;
           for (int64_t j = 0; j < c; ++j)
             key |= (uint32_t)src[x * c + j] << (8 * j);
@@ -160,7 +165,7 @@ int64_t bjx_tile_delta_palidx(const uint8_t* img, const uint8_t* ref,
             if (keys[hh] == key) break;
             hh = (hh + 1) & mask;
           }
-          dst[y * t + x] = (uint8_t)vals[hh];
+          dst[y * tw + x] = (uint8_t)vals[hh];
         }
       }
       ++count;

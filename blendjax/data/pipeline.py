@@ -345,9 +345,11 @@ class TileStreamDecoder:
                         self._host_refs[key].tobytes(), digest_size=8
                     ).digest(), "little",
                 )
-                tile = int(
-                    hb.get(key[0] + T.TILESHAPE_SUFFIX, [0, 0, 0, T.TILE])[3]
-                )
+                tile = T.geom_tile(tuple(
+                    int(v) for v in hb.get(
+                        key[0] + T.TILESHAPE_SUFFIX, [0, 0, 0, T.TILE]
+                    )
+                ))
                 s = self._replicated()
                 if self.multihost and s is not None:
                     # Global replicated ref: every process holds the same

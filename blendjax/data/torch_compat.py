@@ -111,9 +111,7 @@ class RemoteIterableDataset(tud.IterableDataset):
                 tiles = pop_tile_payload(
                     msg, name, geom, expand_palette_tiles_np
                 )
-                msg[name] = decode_tile_delta_np(
-                    ref, idx, tiles, tile=int(geom[3])
-                )
+                msg[name] = decode_tile_delta_np(ref, idx, tiles)
             if skip:
                 # Skipped messages still count against the stream's
                 # max_items budget — a worker that never gets a ref

@@ -22,6 +22,9 @@ _TILES = {
     "decode_tile_delta",
     "pack_batch",
     "tile_ref",
+    "tile_hw",
+    "geom_tile",
+    "tileshape_wire",
 }
 _AUGMENT = {
     "make_augment",

@@ -105,16 +105,55 @@ def unpack_palette_indices(packed, bits: int, xp=np):
     return packed
 
 
-def tile_grid(shape, tile: int = TILE):
-    """(H, W, C) image shape -> (TH, TW) tile-grid shape.
+def tile_hw(tile):
+    """Normalize a tile spec — an int side or a ``(rows, cols)`` pair —
+    to ``(th, tw)`` pixel dims.
 
-    Raises if the tile size does not divide the image dims (callers should
-    fall back to raw frames for such shapes).
+    Rectangular tiles exist for the decoder's benefit: a (16, 32) tile
+    at C=4 spans exactly 128 output lanes (the TPU's native lane
+    width), which unlocks the direct-spatial Pallas decode
+    (:func:`_pallas_decode_spatial`: no slot buffer, no reference-
+    broadcast init pass, no tile->frame transpose pass).
     """
+    if isinstance(tile, (tuple, list, np.ndarray)):
+        if len(tile) != 2:
+            raise ValueError(
+                f"tile spec must be an int or (th, tw), got {tile!r}"
+            )
+        return int(tile[0]), int(tile[1])
+    return int(tile), int(tile)
+
+
+def geom_tile(geom):
+    """Wire-geometry tuple -> ``(th, tw)`` tile pixel dims: the square
+    v1 form is ``[h, w, c, t]``, the rectangular form ``[h, w, c, th,
+    tw]`` (see :func:`tileshape_wire`)."""
+    if len(geom) >= 5:
+        return int(geom[3]), int(geom[4])
+    return int(geom[3]), int(geom[3])
+
+
+def tileshape_wire(h, w, c, tile):
+    """Geometry -> the wire ``__tileshape`` list. Square tiles keep the
+    4-element v1 form so consumers of either vintage decode square
+    streams; rectangular tiles use the 5-element form."""
+    th, tw = tile_hw(tile)
+    base = [int(h), int(w), int(c), th]
+    return base if th == tw else base + [tw]
+
+
+def tile_grid(shape, tile=TILE):
+    """(H, W, C) image shape -> (GH, GW) tile-grid shape.
+
+    ``tile`` is an int side or a ``(th, tw)`` pair. Raises if the tile
+    size does not divide the image dims (callers should fall back to
+    raw frames for such shapes).
+    """
+    th, tw = tile_hw(tile)
     h, w = int(shape[0]), int(shape[1])
-    if h % tile or w % tile:
-        raise ValueError(f"tile {tile} does not divide image {h}x{w}")
-    return h // tile, w // tile
+    if h % th or w % tw:
+        raise ValueError(f"tile {th}x{tw} does not divide image {h}x{w}")
+    return h // th, w // tw
 
 
 class TileDeltaEncoder:
@@ -125,17 +164,18 @@ class TileDeltaEncoder:
     changed tiles. Use one encoder per stream/scene.
     """
 
-    def __init__(self, ref: np.ndarray, tile: int = TILE):
+    def __init__(self, ref: np.ndarray, tile=TILE):
         ref = np.ascontiguousarray(ref)
         if ref.dtype != np.uint8 or ref.ndim != 3:
             raise ValueError(f"ref must be (H, W, C) uint8, got {ref.shape} {ref.dtype}")
         self.ref = ref
-        self.tile = int(tile)
-        self.grid = tile_grid(ref.shape, self.tile)
+        self.th, self.tw = tile_hw(tile)
+        self.tile = tile  # original spec (int or pair), for repr/pickle
+        self.grid = tile_grid(ref.shape, (self.th, self.tw))
         self.num_tiles = self.grid[0] * self.grid[1]
         h, w, c = ref.shape
         self._idx = np.empty((self.num_tiles,), np.int32)
-        self._tiles = np.empty((self.num_tiles, tile, tile, c), np.uint8)
+        self._tiles = np.empty((self.num_tiles, self.th, self.tw, c), np.uint8)
         from blendjax._native import load_tile_delta
 
         self._native = load_tile_delta()
@@ -174,20 +214,20 @@ class TileDeltaEncoder:
         c = self.ref.shape[2]
         self._idx = np.empty((self.num_tiles,), np.int32)
         self._tiles = np.empty(
-            (self.num_tiles, self.tile, self.tile, c), np.uint8
+            (self.num_tiles, self.th, self.tw, c), np.uint8
         )
 
     def tile_bounds(self, hint):
         """Pixel-rect ``hint`` -> tile-grid scan bounds
         ``(ty0, ty1, tx0, tx1)`` (full grid for ``hint=None``)."""
-        t = self.tile
-        th, tw = self.grid
+        th, tw = self.th, self.tw
+        gh, gw = self.grid
         if hint is None:
-            return 0, th, 0, tw
+            return 0, gh, 0, gw
         y0, y1, x0, x1 = hint
         return (
-            max(y0 // t, 0), min(-(-y1 // t), th),
-            max(x0 // t, 0), min(-(-x1 // t), tw),
+            max(y0 // th, 0), min(-(-y1 // th), gh),
+            max(x0 // tw, 0), min(-(-x1 // tw), gw),
         )
 
     def encode(self, img: np.ndarray, hint=None):
@@ -199,9 +239,9 @@ class TileDeltaEncoder:
         ``last_drawn`` dirty rect) — the scan then touches only the tiles
         the rect overlaps. ``hint=None`` scans the full frame.
         """
-        t = self.tile
+        th, tw = self.th, self.tw
         h, w, c = self.ref.shape
-        th, tw = self.grid
+        gh, gw = self.grid
         self._check_frame(img)
         ty0, ty1, tx0, tx1 = self.tile_bounds(hint)
         if ty0 >= ty1 or tx0 >= tx1:
@@ -213,22 +253,22 @@ class TileDeltaEncoder:
             count = self._native(
                 img.ctypes.data_as(u8),
                 self.ref.ctypes.data_as(u8),
-                h, w, c, t, ty0, ty1, tx0, tx1,
+                h, w, c, th, tw, ty0, ty1, tx0, tx1,
                 self._idx.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
                 self._tiles.ctypes.data_as(u8),
             )
             return self._idx[:count], self._tiles[:count]
-        v = img.reshape(th, t, tw, t, c)
-        r = self.ref.reshape(th, t, tw, t, c)
+        v = img.reshape(gh, th, gw, tw, c)
+        r = self.ref.reshape(gh, th, gw, tw, c)
         sub = (v[ty0:ty1, :, tx0:tx1] != r[ty0:ty1, :, tx0:tx1]).any(
             axis=(1, 3, 4)
         )  # (ty1-ty0, tx1-tx0)
         sy, sx = np.nonzero(sub)
-        idx = ((sy + ty0) * tw + (sx + tx0)).astype(np.int32)
+        idx = ((sy + ty0) * gw + (sx + tx0)).astype(np.int32)
         k = len(idx)
         self._idx[:k] = idx
-        # Advanced indexing (rows, :, cols) puts the K axis first -> (K,t,t,C).
-        self._tiles[:k] = v[idx // tw, :, idx % tw]
+        # Advanced indexing (rows, :, cols) puts the K axis first -> (K,th,tw,C).
+        self._tiles[:k] = v[idx // gw, :, idx % gw]
         return self._idx[:k], self._tiles[:k]
 
     # -- fused scan + palettize (native only) -------------------------------
@@ -285,7 +325,7 @@ class TileDeltaEncoder:
                 "count": np.zeros(1, np.int64),
             }
             self._palidx_stage = np.empty(
-                (self.num_tiles, self.tile * self.tile), np.uint8
+                (self.num_tiles, self.th * self.tw), np.uint8
             )
             # Pointers to the persistent buffers are cached as plain
             # ints (the native argtypes are void*): re-marshalling 8
@@ -306,7 +346,7 @@ class TileDeltaEncoder:
          ) = self._pal_state["ptrs"]
         k = self._native_palidx(
             img.ctypes.data, p_ref,
-            h, w, c, self.tile, ty0, ty1, tx0, tx1,
+            h, w, c, self.th, self.tw, ty0, ty1, tx0, tx1,
             p_idx, p_stage, p_keys, p_vals, p_table, p_count,
             256,
         )
@@ -337,9 +377,9 @@ def pack_batch(deltas, num_tiles: int, bucket: int = 16, capacity=None):
     else:
         cap = max(-(-kmax // bucket) * bucket, bucket)
     cap = min(cap, num_tiles)
-    t, c = deltas[0][1].shape[1], deltas[0][1].shape[3]
+    th, tw, c = deltas[0][1].shape[1], deltas[0][1].shape[2], deltas[0][1].shape[3]
     idx = np.full((b, cap), num_tiles, np.int32)
-    tiles = np.empty((b, cap, t, t, c), np.uint8)
+    tiles = np.empty((b, cap, th, tw, c), np.uint8)
     for i, (fi, ft) in enumerate(deltas):
         k = len(fi)
         idx[i, :k] = fi
@@ -359,8 +399,10 @@ def pop_stream_refs(msg: dict, refs: dict, btid) -> None:
 def pop_tile_batches(msg: dict):
     """Pop tile-delta geometry entries from a message.
 
-    Returns ``[(name, (h, w, c, tile)), ...]`` — empty for non-tile
-    messages. The payload fields (``__tileidx`` plus ``__tiles`` or the
+    Returns ``[(name, geom), ...]`` — empty for non-tile messages —
+    where ``geom`` is the wire tuple ``(h, w, c, t)`` for square tiles
+    or ``(h, w, c, th, tw)`` for rectangular ones (decode the tile dims
+    with :func:`geom_tile`, never by indexing position 3). The payload fields (``__tileidx`` plus ``__tiles`` or the
     palette-compressed ``__tilepal4/8`` + ``__palette``) stay in the
     message for the caller to transfer/decode. Callers look refs up
     under ``(name, btid)`` and should SKIP (not fail) messages whose ref
@@ -381,7 +423,7 @@ def pop_tile_payload(fields: dict, name: str, geom, expand):
     :func:`expand_palette_tiles` (device) or
     :func:`expand_palette_tiles_np` (host). Shared by every consumer so
     the raw-vs-palette wire variants stay in one place."""
-    t = int(geom[3])
+    t = geom_tile(geom)
     for bits, suffix in TILEPAL_SUFFIXES.items():
         if name + suffix in fields:
             packed = fields.pop(name + suffix)
@@ -391,29 +433,32 @@ def pop_tile_payload(fields: dict, name: str, geom, expand):
 
 
 def decode_tile_delta_np(ref: np.ndarray, idx: np.ndarray,
-                         tiles: np.ndarray, tile: int = TILE) -> np.ndarray:
+                         tiles: np.ndarray, tile=None) -> np.ndarray:
     """Host-side (numpy) reconstruction — for consumers that never touch
     a device, e.g. the torch-compat dataset adapter. Same semantics as
     :func:`decode_tile_delta`: sentinel indices are dropped, channel-
     sliced tiles restore their remaining channels from the reference.
 
-    ``idx``: (B, K) int32; ``tiles``: (B, K, t, t, Ct). Returns
-    (B, H, W, C) uint8, bit-exact.
+    ``idx``: (B, K) int32; ``tiles``: (B, K, th, tw, Ct) — the tile
+    pixel dims come from the tiles array itself (``tile`` is accepted
+    for back-compat and ignored). Returns (B, H, W, C) uint8, bit-exact.
     """
+    del tile
     h, w, c = ref.shape
-    th, tw = tile_grid(ref.shape, tile)
-    n = th * tw
+    th, tw = tiles.shape[2], tiles.shape[3]
+    gh, gw = tile_grid(ref.shape, (th, tw))
+    n = gh * gw
     b = idx.shape[0]
     ct = tiles.shape[-1]
     out = np.broadcast_to(ref, (b, h, w, c)).copy()
-    ov = out.reshape(b, th, tile, tw, tile, c)
+    ov = out.reshape(b, gh, th, gw, tw, c)
     for bi in range(b):
         # Positional like the device decoder: mask BOTH idx and tiles so
         # sentinels anywhere (not just a suffix) pair correctly.
         m = idx[bi] < n
         real = idx[bi][m]
         # (K,) flat ids -> rows/cols; advanced indexing puts K first
-        ov[bi, real // tw, :, real % tw, :, :ct] = tiles[bi][m]
+        ov[bi, real // gw, :, real % gw, :, :ct] = tiles[bi][m]
     return out
 
 
@@ -472,23 +517,24 @@ def palettize_tiles(tiles: np.ndarray, max_colors: int = 256):
     native C pass when available; numpy fallback.
     """
     max_colors = min(int(max_colors), 256)  # uint8 indices; native tables
-    b, k, t, _, c = tiles.shape
+    b, k, th, tw, c = tiles.shape
+    tt = th * tw
     flat = np.ascontiguousarray(tiles).reshape(-1, c)
     out = _palettize_flat(flat, max_colors)
     if out is None:
         return None
     idx, pal, count = out
-    if count <= 4 and (t * t) % 4 == 0:
+    if count <= 4 and tt % 4 == 0:
         pal4c = np.zeros((4, c), np.uint8)
         pal4c[: min(len(pal), 4)] = pal[:4]
-        packed = pack_palette_indices(idx, 2).reshape(b, k, (t * t) // 4)
+        packed = pack_palette_indices(idx, 2).reshape(b, k, tt // 4)
         return packed, pal4c, 2
-    if count <= 16 and (t * t) % 2 == 0:
+    if count <= 16 and tt % 2 == 0:
         pal16 = np.zeros((16, c), np.uint8)
         pal16[: min(len(pal), 16)] = pal[:16]
-        packed = pack_palette_indices(idx, 4).reshape(b, k, (t * t) // 2)
+        packed = pack_palette_indices(idx, 4).reshape(b, k, tt // 2)
         return packed, pal16, 4
-    return idx.reshape(b, k, t * t), pal, 8
+    return idx.reshape(b, k, tt), pal, 8
 
 
 def palettize_frames(frames: np.ndarray, max_colors: int = 256):
@@ -592,17 +638,18 @@ def pop_frame_palette_batches(hb: dict):
     return out
 
 
-def expand_palette_tiles(packed, palette, bits: int, t: int, c: int):
+def expand_palette_tiles(packed, palette, bits: int, t, c: int):
     """Device-side inverse of :func:`palettize_tiles` (jit-safe gather).
 
     ``packed``: (..., K, t*t/2|t*t) uint8; ``palette``: (cap, C), or
     (..., cap, C) with leading axes matching ``packed``'s leading dims
     (per-frame palettes, and the chunked-decode case stacks another
-    level) — each row then gathers through its own palette. Returns
-    (..., K, t, t, C) uint8.
+    level) — each row then gathers through its own palette. ``t`` is an
+    int side or ``(th, tw)`` pair. Returns (..., K, th, tw, C) uint8.
     """
     import jax.numpy as jnp
 
+    th, tw = tile_hw(t)
     if palette.ndim >= 3:
         import jax
 
@@ -611,11 +658,12 @@ def expand_palette_tiles(packed, palette, bits: int, t: int, c: int):
         )(packed, palette)
     lead = packed.shape[:-1]
     idx = unpack_palette_indices(packed, bits, jnp)
-    return palette[idx].reshape(*lead, t, t, c)
+    return palette[idx].reshape(*lead, th, tw, c)
 
 
-def expand_palette_tiles_np(packed, palette, bits: int, t: int, c: int):
+def expand_palette_tiles_np(packed, palette, bits: int, t, c: int):
     """Host (numpy) twin of :func:`expand_palette_tiles`."""
+    th, tw = tile_hw(t)
     if palette.ndim >= 3:
         return np.stack([
             expand_palette_tiles_np(p, q, bits, t, c)
@@ -623,7 +671,7 @@ def expand_palette_tiles_np(packed, palette, bits: int, t: int, c: int):
         ])
     lead = packed.shape[:-1]
     idx = unpack_palette_indices(packed, bits, np)
-    return palette[idx].reshape(*lead, t, t, c)
+    return palette[idx].reshape(*lead, th, tw, c)
 
 
 # -- packed single-transfer form --------------------------------------------
@@ -753,29 +801,31 @@ def decode_packed_superbatch(packed, refs, spec, names, geoms,
 # -- device side ------------------------------------------------------------
 
 
-def tile_ref(ref, tile: int = TILE):
+def tile_ref(ref, tile=TILE):
     """Reference image (H, W, C) -> device-resident tiled view
-    (num_tiles, t, t, C); compute once per stream, reuse per batch."""
+    (num_tiles, th, tw, C); compute once per stream, reuse per batch."""
     import jax.numpy as jnp
 
     ref = jnp.asarray(ref)
     h, w, c = ref.shape
-    th, tw = tile_grid(ref.shape, tile)
-    return ref.reshape(th, tile, tw, tile, c).transpose(0, 2, 1, 3, 4).reshape(
-        th * tw, tile, tile, c
+    th, tw = tile_hw(tile)
+    gh, gw = tile_grid(ref.shape, (th, tw))
+    return ref.reshape(gh, th, gw, tw, c).transpose(0, 2, 1, 3, 4).reshape(
+        gh * gw, th, tw, c
     )
 
 
-def tile_ref_np(ref: np.ndarray, tile: int = TILE) -> np.ndarray:
+def tile_ref_np(ref: np.ndarray, tile=TILE) -> np.ndarray:
     """Host (numpy) twin of :func:`tile_ref` — for consumers that must
     assemble the tiled reference into a multi-process global array
     (``jax.make_array_from_process_local_data`` takes host data)."""
     h, w, c = ref.shape
-    th, tw = tile_grid(ref.shape, tile)
+    th, tw = tile_hw(tile)
+    gh, gw = tile_grid(ref.shape, (th, tw))
     return np.ascontiguousarray(
-        ref.reshape(th, tile, tw, tile, c)
+        ref.reshape(gh, th, gw, tw, c)
         .transpose(0, 2, 1, 3, 4)
-        .reshape(th * tw, tile, tile, c)
+        .reshape(gh * gw, th, tw, c)
     )
 
 
@@ -801,8 +851,8 @@ def _pallas_decode_scatter(ref_tiles, idx, tiles, interpret: bool = False):
 
     b, k = idx.shape
     n = ref_tiles.shape[0]
-    t, c = tiles.shape[-3], tiles.shape[-1]
-    ttc = t * t * c
+    th, tw, c = tiles.shape[-3], tiles.shape[-2], tiles.shape[-1]
+    ttc = th * tw * c
     # Each tile is viewed as an (8, ttc/8) block: Mosaic's lowering check
     # requires the trailing two block dims be divisible by (8, 128), and
     # every RGBA tile size is a multiple of 1024 bytes (16*16*4), so
@@ -848,6 +898,92 @@ def _pallas_decode_scatter(ref_tiles, idx, tiles, interpret: bool = False):
     return out[:, :n].reshape(b, n, ttc)
 
 
+def _pallas_decode_spatial(ref_tiles, idx, tiles, shape,
+                           interpret: bool = False):
+    """Direct-spatial Pallas decode: ONE kernel pass writes the full
+    frames in frame layout. Each grid step owns one tile footprint of
+    the output and gathers either the changed tile that landed there or
+    the reference block — so the slot buffer, its reference-broadcast
+    init pass, and the tile->frame transpose pass of
+    :func:`_pallas_decode_scatter` all disappear (measured as the two
+    largest HBM terms of the decode chain; scripts/diagnose_decode.py).
+
+    The tile->slot map inverts on device first (one tiny scatter over
+    (B, GH*GW) int32): ``inv[b, p]`` is the row of ``tiles`` covering
+    slot ``p``, or K for "unchanged". The kernel's tile-input index_map
+    then reads ``inv`` as a scalar-prefetch operand (gather form — the
+    data-dependent BlockSpec pattern of pallas_guide.md), and the body
+    selects tile vs reference on ``inv < K``.
+
+    Needs ``tw*C % 128 == 0`` (a tile row spans whole 128-lane vregs —
+    why rectangular (16, 32) tiles exist for C=4) and ``th % 8 == 0``;
+    callers gate on that. ``idx``: (B, K) int32 with sentinel N (those
+    rows land in a dropped pad slot of ``inv``). Returns (B, H, W, C)
+    uint8, bit-exact.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, k = idx.shape
+    th, tw, c = tiles.shape[-3], tiles.shape[-2], tiles.shape[-1]
+    h, w, _ = (int(s) for s in shape)
+    gh, gw = h // th, w // tw
+    n = gh * gw
+    twc = tw * c
+    ref_img = ref_tiles.reshape(gh, gw, th, tw, c).transpose(
+        0, 2, 1, 3, 4
+    ).reshape(h, w * c)  # ~1 MB un-tiling; noise next to the frame write
+    if k == 0:  # nothing changed anywhere: every block is the reference
+        return jnp.broadcast_to(
+            ref_img.reshape(1, h, w, c), (b, h, w, c)
+        )
+    inv = jnp.full((b, n + 1), k, jnp.int32)
+    inv = inv.at[
+        jnp.arange(b, dtype=jnp.int32)[:, None], idx
+    ].set(
+        jnp.broadcast_to(jnp.arange(k, dtype=jnp.int32)[None, :], (b, k)),
+        mode="drop",
+    )[:, :n]
+    tiles3 = tiles.reshape(b, k, th, twc)
+
+    def kernel(inv_ref, ref_blk, tile_blk, out_blk):
+        bi = pl.program_id(0)
+        gy = pl.program_id(1)
+        gx = pl.program_id(2)
+        j = inv_ref[bi, gy * gw + gx]
+        out_blk[0] = jnp.where(j < k, tile_blk[0, 0], ref_blk[...])
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(b, gh, gw),
+        in_specs=[
+            pl.BlockSpec((th, twc), lambda bi, gy, gx, invp: (gy, gx)),
+            # Unchanged blocks clamp to a real (ignored) tile row so the
+            # index stays in bounds without a padded tile copy.
+            pl.BlockSpec(
+                (1, 1, th, twc),
+                lambda bi, gy, gx, invp: (
+                    bi,
+                    jnp.minimum(invp[bi, gy * gw + gx], k - 1),
+                    0, 0,
+                ),
+            ),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, th, twc), lambda bi, gy, gx, invp: (bi, gy, gx)
+        ),
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, h, w * c), jnp.uint8),
+        interpret=interpret,
+    )(inv, ref_img, tiles3)
+    return out.reshape(b, h, w, c)
+
+
 def decode_tile_delta(ref_tiles, idx, tiles, shape, use_pallas=None,
                       mesh=None, data_axis: str = "data"):
     """Reconstruct exact full frames on device.
@@ -865,30 +1001,35 @@ def decode_tile_delta(ref_tiles, idx, tiles, shape, use_pallas=None,
     scatter ``mode='drop'``), batch-parallel (``vmap`` over B, so a batch
     sharded along ``data`` decodes shard-locally with a replicated ref).
 
-    ``use_pallas=None`` auto-selects the Pallas scatter kernel
-    (:func:`_pallas_decode_scatter`) on TPU for full-channel tiles. On a
+    ``use_pallas=None`` auto-selects a Pallas kernel on TPU for
+    full-channel tiles: the direct-spatial gather
+    (:func:`_pallas_decode_spatial` — one pass, no slot buffer, no
+    transpose) when the tile geometry is lane-aligned (``tw*C % 128 ==
+    0``, ``th % 8 == 0``; the (16, 32) tiles the flagship scene streams),
+    else the slot scatter (:func:`_pallas_decode_scatter`). On a
     multi-device mesh pass ``mesh`` (with ``data_axis`` naming its batch
     axis): the kernel is wrapped in ``shard_map`` over that axis — each
-    device scatters its local batch shard against the replicated
+    device decodes its local batch shard against the replicated
     reference, so the fast path survives scale-out (the kernel alone is
     not GSPMD-partitionable). Without ``mesh`` on multi-device, or when
     B doesn't divide by the axis size, auto-select falls back to the
     vmap'd XLA scatter, which partitions like any other op. Off TPU the
-    kernel runs in interpreter mode (what the virtual-mesh tests use).
+    kernels run in interpreter mode (what the virtual-mesh tests use).
     """
     import jax
 
     h, w, c = (int(s) for s in shape)
-    t = tiles.shape[-3]
+    th, tw = tiles.shape[-3], tiles.shape[-2]
     ct = tiles.shape[-1]
-    th, tw = tile_grid((h, w, c), t)
+    gh, gw = tile_grid((h, w, c), (th, tw))
     b = idx.shape[0]
     n_axis = (
         int(np.prod([mesh.shape[a] for a in (data_axis,)]))
         if mesh is not None and data_axis in getattr(mesh, "shape", {})
         else 1
     )
-    eligible = ct == c and (t * t * ct) % 1024 == 0
+    eligible_spatial = ct == c and (tw * c) % 128 == 0 and th % 8 == 0
+    eligible = eligible_spatial or (ct == c and (th * tw * ct) % 1024 == 0)
     if use_pallas is None:
         use_pallas = (
             jax.default_backend() == "tpu"
@@ -901,8 +1042,18 @@ def decode_tile_delta(ref_tiles, idx, tiles, shape, use_pallas=None,
     if use_pallas:
         interpret = jax.default_backend() != "tpu"
 
-        def scatter(r, i, tl):
-            return _pallas_decode_scatter(r, i, tl, interpret=interpret)
+        if eligible_spatial:
+            def decode_fn(r, i, tl):
+                return _pallas_decode_spatial(
+                    r, i, tl, (h, w, c), interpret=interpret
+                )
+        else:
+            def decode_fn(r, i, tl):
+                return _pallas_decode_scatter(
+                    r, i, tl, interpret=interpret
+                ).reshape(-1, gh, gw, th, tw, c).transpose(
+                    0, 1, 3, 2, 4, 5
+                ).reshape(-1, h, w, c)
 
         if mesh is not None and n_axis > 1 and b % n_axis == 0:
             # Partition over the batch: each device runs the kernel on
@@ -914,23 +1065,20 @@ def decode_tile_delta(ref_tiles, idx, tiles, shape, use_pallas=None,
 
             # check=False: pallas_call's out_shape carries no varying-
             # mesh-axes annotation, which the VMA checker requires.
-            scatter = _shard_map(
-                scatter, mesh,
+            decode_fn = _shard_map(
+                decode_fn, mesh,
                 in_specs=(P(), P(data_axis), P(data_axis)),
                 out_specs=P(data_axis),
                 check=False,
             )
-        return scatter(ref_tiles, idx, tiles).reshape(
-            b, th, tw, t, t, c
-        ).transpose(0, 1, 3, 2, 4, 5).reshape(b, h, w, c)
+        return decode_fn(ref_tiles, idx, tiles)
 
     def one(i, tl):
         if ct < c:
             return ref_tiles.at[i, :, :, :ct].set(tl, mode="drop")
         return ref_tiles.at[i].set(tl, mode="drop")
 
-    out = jax.vmap(one)(idx, tiles)  # (B, N, t, t, C)
-    b = idx.shape[0]
-    return out.reshape(b, th, tw, t, t, c).transpose(0, 1, 3, 2, 4, 5).reshape(
+    out = jax.vmap(one)(idx, tiles)  # (B, N, th, tw, C)
+    return out.reshape(b, gh, gw, th, tw, c).transpose(0, 1, 3, 2, 4, 5).reshape(
         b, h, w, c
     )

@@ -34,6 +34,7 @@ from blendjax.ops.tiles import (
     pack_batch,
     pack_palette_indices,
     palettize_tiles,
+    tileshape_wire,
 )
 
 
@@ -80,7 +81,7 @@ class TileBatchPublisher:
     """
 
     def __init__(self, publisher, ref: np.ndarray, batch_size: int,
-                 tile: int = TILE, field: str = "image",
+                 tile=TILE, field: str = "image",
                  alpha_slice: bool = True, ref_interval: int = 0,
                  palette: bool = True, capacity: int | None = None):
         if batch_size < 1:
@@ -93,19 +94,21 @@ class TileBatchPublisher:
         self.palette = bool(palette)
         self._palette_misses = 0  # latch: stop paying the scan if futile
         self.encoder = TileDeltaEncoder(ref, tile=tile)
-        self.tile = int(tile)
+        # tile pixel dims: int side, or (th, tw) — rectangular (16, 32)
+        # tiles at C=4 unlock the consumer's direct-spatial decode
+        self.th, self.tw = self.encoder.th, self.encoder.tw
         self._ref = self.encoder.ref
         if self._ref.shape[2] == 4:
             # Tiled view of the reference's alpha plane, indexed by flat
             # tile id — the alpha-static check then touches only the
             # tiles each frame actually changed.
-            th, tw = self.encoder.grid
-            t = self.tile
+            gh, gw = self.encoder.grid
+            th, tw = self.th, self.tw
             self._ref_tile_alpha = np.ascontiguousarray(
                 self._ref[:, :, 3]
-                .reshape(th, t, tw, t)
+                .reshape(gh, th, gw, tw)
                 .transpose(0, 2, 1, 3)
-                .reshape(th * tw, t, t)
+                .reshape(gh * gw, th, tw)
             )
         else:
             self._ref_tile_alpha = None
@@ -229,16 +232,17 @@ class TileBatchPublisher:
 
     def _ensure_batch_arrays(self) -> None:
         if self._batch_idx is None:
-            t, c = self.tile, self._ref.shape[2]
+            c = self._ref.shape[2]
             self._batch_idx = np.empty(
                 (self.batch_size, self._capacity), np.int32
             )
             self._batch_tiles = np.empty(
-                (self.batch_size, self._capacity, t, t, c), np.uint8
+                (self.batch_size, self._capacity, self.th, self.tw, c),
+                np.uint8,
             )
         if self._fused_ok and self._batch_pal is None:
             self._batch_pal = np.empty(
-                (self.batch_size, self._capacity, self.tile * self.tile),
+                (self.batch_size, self._capacity, self.th * self.tw),
                 np.uint8,
             )
 
@@ -269,14 +273,14 @@ class TileBatchPublisher:
         if not n or self._batch_pal is None:
             return
         self._ensure_batch_arrays()
-        t, c = self.tile, self._ref.shape[2]
+        c = self._ref.shape[2]
         for i in range(n):
             colors = np.zeros((256, c), np.uint8)
             rp = self._row_pals[i]
             if rp is not None:
                 colors[: len(rp)] = rp
             self._batch_tiles[i] = colors[self._batch_pal[i]].reshape(
-                self._capacity, t, t, c
+                self._capacity, self.th, self.tw, c
             )
         # padding slots must ship zeroed tiles (pack contract), not
         # palette color 0
@@ -333,7 +337,7 @@ class TileBatchPublisher:
             # ships (and the consumer gathers through) its own colors.
             counts = self._row_counts[:n]
             cmax = max(counts) if counts else 0
-            tt = self.tile * self.tile
+            tt = self.th * self.tw
             if cmax <= 4 and tt % 4 == 0:
                 # four 2-bit indices per byte (flat-shaded frames often
                 # hold <=4 colors: background + a few faces)
@@ -357,7 +361,9 @@ class TileBatchPublisher:
             self._finish_publish({
                 "_prebatched": True,
                 self.field + TILEIDX_SUFFIX: idx,
-                self.field + TILESHAPE_SUFFIX: [h, w, c, self.tile],
+                self.field + TILESHAPE_SUFFIX: tileshape_wire(
+                    h, w, c, (self.th, self.tw)
+                ),
                 self.field + suffix: packed,
                 self.field + PALETTE_SUFFIX: pal,
             })
@@ -398,7 +404,9 @@ class TileBatchPublisher:
         msg = {
             "_prebatched": True,
             self.field + TILEIDX_SUFFIX: idx,
-            self.field + TILESHAPE_SUFFIX: [h, w, c, self.tile],
+            self.field + TILESHAPE_SUFFIX: tileshape_wire(
+                h, w, c, (self.th, self.tw)
+            ),
         }
         compressed = palettize_tiles(tiles) if self.palette else None
         if compressed is not None:

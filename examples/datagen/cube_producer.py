@@ -17,7 +17,9 @@ Usage flags (passed via ``instance_args``):
                    the 32x32 tiles that changed vs the scene background
                    (lossless; decoded on-device by the consumer — see
                    blendjax.ops.tiles). Requires --batch > 1.
-  --tile T         tile side for --encoding tile (default 32)
+  --tile T [TW]    tile side for --encoding tile (default 32); two values
+                   give rectangular (rows, cols) tiles — (16, 32) at
+                   C=4 unlocks the consumer's direct-spatial decode
 """
 
 from __future__ import annotations
@@ -41,7 +43,9 @@ def main() -> None:
     parser.add_argument(
         "--encoding", choices=["raw", "tile", "pal"], default="raw"
     )
-    parser.add_argument("--tile", type=int, default=32)
+    # one value = square tiles; two = (rows, cols) — rectangular (16, 32)
+    # tiles at C=4 unlock the consumer's direct-spatial Pallas decode
+    parser.add_argument("--tile", nargs="+", type=int, default=[32])
     parser.add_argument(
         "--tile-rgba", action="store_true",
         help="ship full RGBA tiles (Pallas-decodable) even when alpha is "
@@ -78,8 +82,11 @@ def main() -> None:
         pub = DataPublisher(
             args.btsockets["DATA"], btid=args.btid, lingerms=10000, send_hwm=2
         )
+        if len(opts.tile) > 2:
+            parser.error("--tile takes one side or two (rows cols) values")
+        tile = opts.tile[0] if len(opts.tile) == 1 else tuple(opts.tile)
         tiles = TileBatchPublisher(
-            pub, scene.background_image(), opts.batch, tile=opts.tile,
+            pub, scene.background_image(), opts.batch, tile=tile,
             alpha_slice=not opts.tile_rgba, ref_interval=opts.ref_interval,
             capacity=opts.tile_capacity or None,
         )

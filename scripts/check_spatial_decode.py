@@ -1,0 +1,97 @@
+"""Real-TPU check of the direct-spatial tile decode: bit-exactness vs
+the XLA scatter at flagship geometry, plus a chained-slope timing of the
+full decode (palette expand + kernel) for the spatial (16, 32) kernel
+against the slot (16, 16) kernel chain it replaces.
+
+Run: ``PYTHONPATH=.:$PYTHONPATH python scripts/check_spatial_decode.py``.
+Timing uses the chained-reps method of docs/performance.md "Measurement
+hygiene" (the only honest method on tunneled backends).
+"""
+
+from __future__ import annotations
+
+import argparse
+import time
+
+import numpy as np
+
+
+def timed(fn, args, reps: int, sync) -> float:
+    out = fn(*args)
+    sync(out)
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = fn(*args)
+    sync(out)
+    total = time.perf_counter() - t0
+    t1 = time.perf_counter()
+    sync(out)
+    bare = time.perf_counter() - t1
+    return max(total - bare, 1e-9) / reps
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--reps", type=int, default=8)
+    ap.add_argument("--batch", type=int, default=128)
+    args = ap.parse_args()
+
+    import jax
+
+    import blendjax.ops.tiles as T
+    from blendjax.producer.sim import CubeScene
+
+    H, W, C = 480, 640, 4
+    B = args.batch
+    scene = CubeScene(shape=(H, W), seed=0)
+    ref = scene.background_image()
+
+    # Real flagship-scene frames (flat-shaded -> palettizable), so the
+    # two tile geometries compare on the actual workload.
+    frames = []
+    for f in range(1, 5):
+        scene.step(f)
+        frames.append(scene.render().copy())
+
+    def sync(x):
+        np.asarray(jax.tree_util.tree_leaves(x)[-1]).reshape(-1)[-1]
+
+    results = {}
+    for tag, tile, kcap in (("slot 16x16", 16, 288),
+                            ("spatial 16x32", (16, 32), 160)):
+        enc = T.TileDeltaEncoder(ref, tile=tile)
+        deltas = [tuple(a.copy() for a in enc.encode(f)) for f in frames]
+        idx, tiles = T.pack_batch(deltas, enc.num_tiles, capacity=kcap)
+        idx = np.tile(idx, (B // len(frames), 1))
+        tiles = np.tile(tiles, (B // len(frames), 1, 1, 1, 1))
+        rt = jax.device_put(np.asarray(T.tile_ref(ref, tile)))
+        pal = T.palettize_tiles(tiles)
+        assert pal is not None, "synthetic tiles should palettize"
+        packed, palette, bits = pal
+        packed_d = jax.device_put(packed)
+        pal_d = jax.device_put(palette)
+        idx_d = jax.device_put(idx)
+        th, tw = T.tile_hw(tile)
+
+        def full(p, q, i, r, _bits=bits, _tile=tile, _th=th, _tw=tw):
+            tl = T.expand_palette_tiles(p, q, _bits, _tile, C)
+            return T.decode_tile_delta(r, i, tl, (H, W, C))
+
+        jfull = jax.jit(full)
+        out = np.asarray(jfull(packed_d, pal_d, idx_d, rt))
+        want = T.decode_tile_delta_np(
+            ref, idx, T.expand_palette_tiles_np(packed, palette, bits,
+                                                tile, C))
+        np.testing.assert_array_equal(out, want)
+        print(f"{tag}: bit-exact ok (K={idx.shape[1]}, "
+              f"{packed.nbytes / B / 1024:.1f} KB/img packed)")
+        results[tag] = timed(
+            jfull, (packed_d, pal_d, idx_d, rt), args.reps, sync
+        )
+
+    for tag, dt in results.items():
+        print(f"{tag}: {dt * 1000:8.1f} ms/group ({B / dt:7.0f} img/s)")
+
+
+if __name__ == "__main__":
+    main()

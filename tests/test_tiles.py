@@ -583,6 +583,152 @@ def test_encode_hint_matches_full_scan():
         assert len(i) == 0 and len(t) == 0
 
 
+def test_rect_tiles_roundtrip_all_decoders():
+    """Rectangular (16, 32) tiles — the geometry whose tile row spans
+    exactly 128 lanes at C=4, unlocking the direct-spatial Pallas decode
+    — encode identically on the native and numpy paths and reconstruct
+    bit-exactly through the XLA scatter, the spatial kernel (interpret
+    mode off-TPU), and the host-side numpy decoder."""
+    from blendjax.ops.tiles import decode_tile_delta_np
+
+    ref, frames = _frames(n=5, shape=(64, 96), seed=23)
+    enc = TileDeltaEncoder(ref, tile=(16, 32))
+    enc_np = TileDeltaEncoder(ref, tile=(16, 32))
+    enc_np._native = None
+    assert enc.grid == (4, 3) and enc.num_tiles == 12
+    deltas = []
+    for f in frames:
+        fi, ft = (a.copy() for a in enc.encode(f))
+        if enc._native is not None:
+            ni, nt = enc_np.encode(f)
+            np.testing.assert_array_equal(fi, ni)
+            np.testing.assert_array_equal(ft, nt)
+        deltas.append((fi, ft))
+    idx, tiles = pack_batch(deltas, enc.num_tiles)
+    assert tiles.shape[2:] == (16, 32, 4)
+    rt = tile_ref(ref, (16, 32))
+    xla = np.asarray(
+        decode_tile_delta(rt, idx, tiles, ref.shape, use_pallas=False)
+    )
+    spatial = np.asarray(
+        decode_tile_delta(rt, idx, tiles, ref.shape, use_pallas=True)
+    )
+    host = decode_tile_delta_np(ref, idx, tiles)
+    np.testing.assert_array_equal(xla, spatial)
+    np.testing.assert_array_equal(xla, host)
+    for i, f in enumerate(frames):
+        np.testing.assert_array_equal(spatial[i], f)
+
+
+def test_spatial_decode_empty_capacity_and_identical_frames():
+    """Spatial-kernel edge cases: K=0 capacity returns pure reference
+    frames; all-sentinel rows (identical frames at nonzero capacity)
+    also reconstruct as the reference."""
+    rng = np.random.default_rng(29)
+    ref = rng.integers(0, 255, (32, 64, 4), np.uint8)
+    rt = tile_ref(ref, (16, 32))
+    n = 2 * 2
+    b = 3
+    idx0 = np.empty((b, 0), np.int32)
+    tiles0 = np.empty((b, 0, 16, 32, 4), np.uint8)
+    out0 = np.asarray(
+        decode_tile_delta(rt, idx0, tiles0, ref.shape, use_pallas=True)
+    )
+    idx_s = np.full((b, 2), n, np.int32)  # all sentinels
+    tiles_s = np.zeros((b, 2, 16, 32, 4), np.uint8)
+    out_s = np.asarray(
+        decode_tile_delta(rt, idx_s, tiles_s, ref.shape, use_pallas=True)
+    )
+    for bi in range(b):
+        np.testing.assert_array_equal(out0[bi], ref)
+        np.testing.assert_array_equal(out_s[bi], ref)
+
+
+def test_sharded_spatial_decode_on_mesh():
+    """The direct-spatial kernel survives scale-out the same way the
+    slot scatter does: shard_map over the mesh's data axis, bit-exact
+    against the XLA path on the virtual 8-device mesh."""
+    from blendjax.parallel import create_mesh
+
+    mesh = create_mesh({"data": -1})
+    ref, frames = _frames(n=8, shape=(64, 64), seed=31)
+    enc = TileDeltaEncoder(ref, tile=(16, 32))
+    deltas = [tuple(a.copy() for a in enc.encode(f)) for f in frames]
+    idx, tiles = pack_batch(deltas, enc.num_tiles)
+    rt = tile_ref(ref, (16, 32))
+    sharded = np.asarray(
+        decode_tile_delta(
+            rt, idx, tiles, ref.shape, use_pallas=True, mesh=mesh
+        )
+    )
+    xla = np.asarray(
+        decode_tile_delta(rt, idx, tiles, ref.shape, use_pallas=False)
+    )
+    np.testing.assert_array_equal(sharded, xla)
+    for i, f in enumerate(frames):
+        np.testing.assert_array_equal(sharded[i], f)
+
+
+def test_tileshape_wire_geom_roundtrip():
+    """Wire-geometry helpers: the square v1 4-element form and the
+    rectangular 5-element form round-trip through geom_tile."""
+    from blendjax.ops.tiles import geom_tile, tile_hw, tileshape_wire
+
+    assert tileshape_wire(64, 96, 4, 16) == [64, 96, 4, 16]
+    assert tileshape_wire(64, 96, 4, (16, 16)) == [64, 96, 4, 16]
+    assert tileshape_wire(64, 96, 4, (16, 32)) == [64, 96, 4, 16, 32]
+    assert geom_tile((64, 96, 4, 16)) == (16, 16)
+    assert geom_tile((64, 96, 4, 16, 32)) == (16, 32)
+    assert tile_hw(16) == (16, 16)
+    assert tile_hw((8, 32)) == (8, 32)
+    with pytest.raises(ValueError):
+        tile_hw((1, 2, 3))
+
+
+def test_rect_tile_publisher_end_to_end_wire():
+    """TileBatchPublisher with rectangular tiles ships the 5-element
+    __tileshape form (fused per-frame-palette path included) and the
+    shared consumer helpers reconstruct bit-exact frames."""
+    from blendjax.ops.tiles import (
+        TILEIDX_SUFFIX,
+        TILESHAPE_SUFFIX,
+        decode_tile_delta_np,
+        expand_palette_tiles_np,
+        pop_tile_payload,
+    )
+    from blendjax.producer.sim import CubeScene
+    from blendjax.producer.tile_publisher import TileBatchPublisher
+
+    class Capture:
+        def __init__(self):
+            self.msgs = []
+
+        def publish(self, **kw):
+            self.msgs.append(kw)
+
+    scene = CubeScene(shape=(64, 96), seed=7)
+    ref = scene.background_image()
+    cap = Capture()
+    pub = TileBatchPublisher(cap, ref, batch_size=4, tile=(16, 32),
+                             alpha_slice=False, capacity=6)
+    frames = []
+    for f in range(1, 5):
+        scene.step(f)
+        img = scene.render()
+        frames.append(img.copy())
+        pub.add(img, frameid=np.int64(f))
+    assert len(cap.msgs) == 1
+    msg = dict(cap.msgs[0])
+    geom = tuple(int(v) for v in msg.pop("image" + TILESHAPE_SUFFIX))
+    assert geom == (64, 96, 4, 16, 32)
+    idx = msg.pop("image" + TILEIDX_SUFFIX)
+    tiles = pop_tile_payload(msg, "image", geom, expand_palette_tiles_np)
+    assert tiles.shape[2:] == (16, 32, 4)
+    out = decode_tile_delta_np(ref, idx, tiles)
+    for got, want in zip(out, frames):
+        np.testing.assert_array_equal(got, want)
+
+
 def test_pallas_scatter_decode_matches_xla_scatter():
     """The Pallas scalar-prefetch scatter kernel (interpret mode off-TPU)
     reconstructs identically to the XLA .at[].set path."""
