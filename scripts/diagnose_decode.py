@@ -1,8 +1,9 @@
 """Per-stage slopes of the tile-decode chain on a real TPU.
 
 Quantifies where a chunk group's device time goes — palette expand,
-ref-broadcast base init, Pallas scatter (incl. transpose to frames),
-and the train step — using the ONLY timing method that is honest on
+ref-broadcast base init, Pallas slot scatter (incl. transpose to
+frames), the one-pass direct-spatial (16, 32) decode that replaces all
+three, and the train step — using the ONLY timing method that is honest on
 tunneled backends (docs/performance.md "Measurement hygiene"): chain
 ``--reps`` iterations of each stage between two d2h fetches and report
 the slope, so the ~0.1s sync constant divides out.
@@ -107,6 +108,24 @@ def main() -> None:
         rng.integers(0, 255, (B * 19 * 1024,), np.uint8)
     )  # ~19KB/img: the pal2-era wire size
 
+    # Rectangular (16, 32) twin of the same workload: tile count halves
+    # (same pixel activity), tt doubles, and decode_tile_delta takes the
+    # direct-spatial kernel (no base init, no transpose) — the r4 lever.
+    Kr, ttr = K // 2, (16, 32)
+    ghr, gwr = T.tile_grid((H, W, C), ttr)
+    Nr = ghr * gwr
+    palidx_r = rng.integers(0, 4, (B, Kr, ttr[0] * ttr[1]), np.uint8)
+    packed2_r = jax.device_put(T.pack_palette_indices(palidx_r, 2))
+    idx_r = jax.device_put(
+        np.sort(rng.choice(Nr, (B, Kr), replace=True)).astype(np.int32)
+    )
+    ref_tiles_r = jax.device_put(np.asarray(T.tile_ref(ref, ttr)))
+    full_decode_r = jax.jit(
+        lambda p, q, i, r: T.decode_tile_delta(
+            r, i, T.expand_palette_tiles(p, q, 2, ttr, C), (H, W, C)
+        )
+    )
+
     results = {
         "transfer (pal2-sized buffer)": timed(
             jax.device_put, (host_buf,), args.reps, sync
@@ -122,6 +141,10 @@ def main() -> None:
         ),
         "full decode (expand+scatter)": timed(
             full_decode, (packed2, pal_d, idx_d, ref_tiles),
+            args.reps, sync,
+        ),
+        "full decode (expand+spatial 16x32)": timed(
+            full_decode_r, (packed2_r, pal_d, idx_r, ref_tiles_r),
             args.reps, sync,
         ),
     }

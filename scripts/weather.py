@@ -9,9 +9,11 @@ docs/performance.md "Caveat on recorded numbers"):
   10+ minutes.
 
 Run before any perf work: ``python scripts/weather.py [--pass]``.
-``--pass`` adds one real measurement pass (the only way to detect the
-bandwidth-collapsed mode; ~10-45 s in any completing weather). Exits
-nonzero when the window is not fit for measurement.
+The default run probes RTT and h2d bandwidth (an 8 MB incompressible
+transfer — catches the bandwidth-collapsed mode in seconds);
+``--pass`` adds one real end-to-end measurement pass (~10-45 s in any
+completing weather) as the definitive check. Exits nonzero when the
+window is not fit for measurement.
 """
 
 from __future__ import annotations
@@ -50,6 +52,27 @@ def main() -> int:
           f"({'ok' if rtt < 0.5 else 'DEGRADED'})")
     if rtt >= 0.5:
         return 2
+    # Bandwidth probe: the collapsed mode keeps a healthy RTT, so only a
+    # sized transfer exposes it (~43 MB/s good-weather h2d measured in
+    # BENCH_r03; collapsed windows sit at ~5-15 MB/s). Two 8 MB h2d
+    # puts chained before ONE tiny d2h sync (fetching the buffer back
+    # would time the d2h leg too and halve the number); incompressible
+    # bytes, in case any tunnel hop compresses (zeros would sail
+    # through a compressing hop at fantasy speed).
+    buf = np.random.default_rng(0).integers(
+        0, 255, 8 << 20, dtype=np.uint8
+    )
+    np.asarray(jax.device_put(buf)[:1])  # warm the transfer path/allocs
+    t0 = time.perf_counter()
+    jax.device_put(buf)
+    x = jax.device_put(buf)
+    np.asarray(x[:1])  # one-element d2h: ~rtt, subtracted below
+    dt = max(time.perf_counter() - t0 - rtt, 1e-9)
+    mbs = 2 * buf.nbytes / dt / 1e6
+    print(f"h2d bandwidth: {mbs:.0f} MB/s "
+          f"({'ok' if mbs >= 25 else 'BANDWIDTH-COLLAPSED'})")
+    if mbs < 25:
+        return 3
     if "--pass" not in sys.argv:
         return 0
 
