@@ -580,6 +580,29 @@ def palettize_frames(frames: np.ndarray, max_colors: int = 256):
     return packed, palette, bits
 
 
+def _lut_expand(packed, palette, bits: int):
+    """Device-side byte-LUT palette expand: ONE gather per packed byte
+    through a 256-entry LUT (byte value -> ``8/bits`` pixels x C bytes,
+    built on device from the palette) instead of bit-unpack + per-pixel
+    gather. Bit-exact by construction; measured 1.2x faster than the
+    unpack+gather chain on a v5e (scripts/exp_lut_expand.py).
+
+    ``packed``: (..., M) uint8; ``palette``: (cap, C). Returns
+    (..., M, (8/bits)*C) uint8 — the caller reshapes (packed bytes hold
+    consecutive pixels of the flattened pixel axis, so flattening the
+    last two dims restores flat pixel-major x channel order).
+    """
+    import jax.numpy as jnp
+
+    px = 8 // bits
+    nib = unpack_palette_indices(
+        jnp.arange(256, dtype=jnp.uint8)[:, None], bits, jnp
+    )  # (256, px) index table, built once per jit trace
+    c = palette.shape[-1]
+    lut = palette[nib].reshape(256, px * c)
+    return lut[packed]
+
+
 def expand_palette_frames(packed, palette, bits: int, h: int, w: int,
                           c: int):
     """Device-side inverse of :func:`palettize_frames` (jit-safe
@@ -596,6 +619,8 @@ def expand_palette_frames(packed, palette, bits: int, h: int, w: int,
             lambda p, q: expand_palette_frames(p, q, bits, h, w, c)
         )(packed, palette)
     lead = packed.shape[:-1]
+    if bits < 8:
+        return _lut_expand(packed, palette, bits).reshape(*lead, h, w, c)
     idx = unpack_palette_indices(packed, bits, jnp)
     return palette[idx].reshape(*lead, h, w, c)
 
@@ -657,6 +682,10 @@ def expand_palette_tiles(packed, palette, bits: int, t, c: int):
             lambda p, q: expand_palette_tiles(p, q, bits, t, c)
         )(packed, palette)
     lead = packed.shape[:-1]
+    if bits < 8:
+        return _lut_expand(packed, palette, bits).reshape(
+            *lead, th, tw, c
+        )
     idx = unpack_palette_indices(packed, bits, jnp)
     return palette[idx].reshape(*lead, th, tw, c)
 

@@ -1,0 +1,86 @@
+"""Experiment: byte-LUT palette expand vs the unpack+gather chain.
+
+For bits=2 the current expand unpacks each packed byte into four 2-bit
+indices (shifts + stack + reshape) then gathers the palette per pixel.
+A per-frame 256-entry LUT (byte value -> 4 pixels x C bytes, built on
+device from the (cap, C) palette) collapses that to ONE gather per
+packed byte. This script ranks the two on the real chip (chained-reps
+timing; relative ranking is meaningful even in degraded tunnel
+weather). If the LUT wins in a good window, wire it into
+expand_palette_tiles.
+
+Run: ``PYTHONPATH=.:$PYTHONPATH python scripts/exp_lut_expand.py``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import time
+
+import numpy as np
+
+
+def timed(fn, args, reps: int, sync) -> float:
+    out = fn(*args)
+    sync(out)
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = fn(*args)
+    sync(out)
+    total = time.perf_counter() - t0
+    t1 = time.perf_counter()
+    sync(out)
+    bare = time.perf_counter() - t1
+    return max(total - bare, 1e-9) / reps
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--reps", type=int, default=8)
+    ap.add_argument("--batch", type=int, default=128)
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+
+    import blendjax.ops.tiles as T
+
+    B, K, th, tw, C = args.batch, 160, 16, 32, 4
+    tt = th * tw
+    rng = np.random.default_rng(0)
+    palidx = rng.integers(0, 4, (B, K, tt), np.uint8)
+    packed = jax.device_put(T.pack_palette_indices(palidx, 2))
+    pal = jax.device_put(rng.integers(0, 255, (B, 4, C)).astype(np.uint8))
+
+    def sync(x):
+        np.asarray(jax.tree_util.tree_leaves(x)[-1]).reshape(-1)[-1]
+
+    # Baseline inlines the PRE-r4 unpack+gather chain (the library's
+    # expand_palette_tiles now dispatches to the LUT itself, so calling
+    # it here would compare LUT vs LUT).
+    def unpack_gather(p, q):
+        def one(pk, qq):
+            idx = T.unpack_palette_indices(pk, 2, jnp)
+            return qq[idx].reshape(K, th, tw, C)
+
+        return jax.vmap(one)(p, q)
+
+    current = jax.jit(unpack_gather)
+    jlut = jax.jit(
+        lambda p, q: jax.vmap(
+            lambda pk, qq: T._lut_expand(pk, qq, 2)
+        )(p, q).reshape(B, K, th, tw, C)
+    )
+    a = np.asarray(current(packed, pal))
+    b = np.asarray(jlut(packed, pal))
+    np.testing.assert_array_equal(a, b)
+    print("bit-exact ok")
+    t_cur = timed(current, (packed, pal), args.reps, sync)
+    t_lut = timed(jlut, (packed, pal), args.reps, sync)
+    print(f"unpack+gather: {t_cur * 1000:8.1f} ms/group")
+    print(f"byte-LUT     : {t_lut * 1000:8.1f} ms/group "
+          f"({t_cur / t_lut:.2f}x)")
+
+
+if __name__ == "__main__":
+    main()

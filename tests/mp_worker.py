@@ -119,7 +119,9 @@ def main() -> int:
 
     rng = np.random.default_rng(7)  # SAME ref content on every process
     ref = rng.integers(0, 255, (32, 32, 4), np.uint8)
-    enc = TileDeltaEncoder(ref, tile=16)
+    # Rectangular (16, 32) tiles: the 5-element wire form and rect grid
+    # math also hold through the true multi-process global-assembly path.
+    enc = TileDeltaEncoder(ref, tile=(16, 32))
     frames = []
     for i in range(ndev):
         img = ref.copy()
@@ -134,7 +136,7 @@ def main() -> int:
             "_prebatched": True, "btid": pid,
             "image" + TILEIDX_SUFFIX: idx,
             "image" + TILES_SUFFIX: tiles,
-            "image" + TILESHAPE_SUFFIX: [32, 32, 4, 16],
+            "image" + TILESHAPE_SUFFIX: [32, 32, 4, 16, 32],
             "image" + TILEREF_SUFFIX: ref,
             "frameid": np.asarray(rows),
         }
@@ -171,7 +173,7 @@ def main() -> int:
                 "_prebatched": True, "btid": pid,
                 "image" + TILEIDX_SUFFIX: idx_,
                 "image" + TILES_SUFFIX: tiles_,
-                "image" + TILESHAPE_SUFFIX: [32, 32, 4, 16],
+                "image" + TILESHAPE_SUFFIX: [32, 32, 4, 16, 32],
                 "frameid": np.asarray(rows) + 100 * k,
             }
             if k == 0:

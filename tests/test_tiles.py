@@ -973,6 +973,25 @@ def test_pallas_scatter_decode_on_real_tpu():
         np.testing.assert_array_equal(out[i], f)
 
 
+@pytest.mark.tpu
+def test_spatial_decode_on_real_tpu():
+    """Non-interpret lowering of the direct-spatial kernel on actual
+    hardware (run with BLENDJAX_TEST_TPU=1 pytest -m tpu)."""
+    ref, frames = _frames(n=4, shape=(64, 64), seed=25)
+    enc = TileDeltaEncoder(ref, tile=(16, 32))
+    deltas = [tuple(a.copy() for a in enc.encode(f)) for f in frames]
+    idx, tiles = pack_batch(deltas, enc.num_tiles)
+    out = np.asarray(
+        decode_tile_delta(
+            jax.device_put(np.asarray(tile_ref(ref, (16, 32)))),
+            jax.device_put(idx), jax.device_put(tiles),
+            ref.shape, use_pallas=True,
+        )
+    )
+    for i, f in enumerate(frames):
+        np.testing.assert_array_equal(out[i], f)
+
+
 def test_tile_stream_survives_producer_respawn():
     """Kill a tile-encoding producer mid-stream with respawn=True: the
     respawned process re-sends its reference image (first-message rule),
