@@ -66,9 +66,17 @@ RAW_CHUNK = int(os.environ.get("BLENDJAX_BENCH_RAW_CHUNK", "8"))
 # decode bit-exactly (scripts/check_spatial_decode.py on real TPU).
 TILE_GEOM = os.environ.get("BLENDJAX_BENCH_TILE", "16")
 _TILE_ARGS = TILE_GEOM.split("x")
+
+
+def tile_capacity_default(tile_args) -> str:
+    """32-aligned fit over the cube's measured max changed-tile count
+    (282 @16x16 -> 288; 154 @16x32 -> 160). Shared with the A/B script
+    so both always benchmark the capacity the bench would use."""
+    return "288" if len(tile_args) == 1 else "160"
+
+
 TILE_CAPACITY = os.environ.get(
-    "BLENDJAX_BENCH_TILE_CAPACITY",
-    "288" if len(_TILE_ARGS) == 1 else "160",
+    "BLENDJAX_BENCH_TILE_CAPACITY", tile_capacity_default(_TILE_ARGS)
 )
 
 

@@ -1,0 +1,73 @@
+"""Interleaved A/B of the tile geometries: square 16x16 (slot-scatter
+decode) vs rectangular 16x32 (direct-spatial decode, r4).
+
+Gates on the weather preflight first (pass ``--force`` to run anyway —
+in degraded windows the absolute numbers are meaningless, though the
+within-run ranking is still weakly informative). Alternates geometries
+pass-by-pass so tunnel drift affects both arms alike, then prints one
+JSON verdict line. If 16x32 wins in fit weather, flip bench.py's
+TILE_GEOM default and record the numbers in PARITY.md.
+
+Run: ``PYTHONPATH=.:$PYTHONPATH python scripts/ab_tile_geom.py
+[--reps 2] [--force]``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--reps", type=int, default=2,
+                    help="measurement passes per geometry (interleaved)")
+    ap.add_argument("--items", type=int, default=512)
+    ap.add_argument("--force", action="store_true",
+                    help="run even when the weather preflight fails")
+    args = ap.parse_args()
+
+    probe = subprocess.run(
+        [sys.executable, os.path.join(REPO_ROOT, "scripts", "weather.py")]
+    )
+    fit = probe.returncode == 0
+    if not fit and not args.force:
+        print("weather not fit for measurement; skipping A/B "
+              "(--force to override)")
+        return 3
+
+    sys.path.insert(0, REPO_ROOT)
+    import bench
+
+    arms = ("16", "16x32")
+    results: dict = {g: [] for g in arms}
+    for rep in range(args.reps):
+        for geom in arms:
+            bench._TILE_ARGS = geom.split("x")
+            bench.TILE_CAPACITY = bench.tile_capacity_default(
+                bench._TILE_ARGS
+            )
+            r = bench.measure(
+                bench.ENCODING, bench.CHUNK, args.items,
+                bench.TIME_CAP_S, with_stages=False,
+            )
+            results[geom].append(round(r["value"], 1))
+            print(f"pass {rep} tile={geom}: {r['value']:.1f} img/s "
+                  f"({r['seconds']:.1f} s)", flush=True)
+    best = {g: max(v) for g, v in results.items()}
+    print(json.dumps({
+        "weather_fit": fit,
+        "passes": results,
+        "best": best,
+        "winner": max(best, key=best.get),
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
