@@ -57,14 +57,19 @@ OVERLAP = os.environ.get("BLENDJAX_BENCH_OVERLAP", "0") == "1"
 # was op-latency bound once the bytes shrank).
 RAW_ENCODING = os.environ.get("BLENDJAX_BENCH_RAW_ENCODING", "pal")
 RAW_CHUNK = int(os.environ.get("BLENDJAX_BENCH_RAW_CHUNK", "8"))
-# Tile geometry: "16" = square 16x16 (slot-scatter decode); "16x32" =
-# rectangular tiles whose rows span 128 lanes at C=4, so the consumer
-# decode takes the direct-spatial Pallas kernel (one pass: no slot
-# buffer, no ref-broadcast init, no transpose). Capacity pins the fleet
-# wire shape: the 32-aligned fit over the cube's measured max changed-
-# tile count (282 @16x16 -> 288; 154 @16x32 -> 160). Both geometries
-# decode bit-exactly (scripts/check_spatial_decode.py on real TPU).
-TILE_GEOM = os.environ.get("BLENDJAX_BENCH_TILE", "16")
+# Tile geometry: "16x32" (default since r4) = rectangular tiles whose
+# rows span 128 lanes at C=4, so the consumer decode takes the
+# direct-spatial Pallas kernel (one pass: no slot buffer, no
+# ref-broadcast init, no transpose); "16" = square 16x16 (slot-scatter
+# decode). The rect default is backed by bit-exactness on real TPU
+# (scripts/check_spatial_decode.py) plus two independent in-window
+# rankings — decode chain 1.85x (scripts/diagnose_decode.py) and
+# end-to-end 1.6x (scripts/ab_tile_geom.py 20.9 vs 12.9 img/s) — both
+# taken in the collapsed-tunnel mode (the only weather late r4 had);
+# its +9% wire cost is bounded while the decode win is structural
+# (two device ops vs ~5 HBM passes). Re-confirm with
+# scripts/ab_tile_geom.py when a fit-weather window appears.
+TILE_GEOM = os.environ.get("BLENDJAX_BENCH_TILE", "16x32")
 _TILE_ARGS = TILE_GEOM.split("x")
 
 
