@@ -17,9 +17,9 @@ Usage flags (passed via ``instance_args``):
                    the 32x32 tiles that changed vs the scene background
                    (lossless; decoded on-device by the consumer — see
                    blendjax.ops.tiles). Requires --batch > 1.
-  --tile T [TW]    tile side for --encoding tile (default 32); two values
-                   give rectangular (rows, cols) tiles — (16, 32) at
-                   C=4 unlocks the consumer's direct-spatial decode
+  --tile T [TW]    tile dims for --encoding tile (default 16 32); two
+                   values give rectangular (rows, cols) tiles — (16, 32)
+                   at C=4 unlocks the consumer's direct-spatial decode
 """
 
 from __future__ import annotations
@@ -43,9 +43,11 @@ def main() -> None:
     parser.add_argument(
         "--encoding", choices=["raw", "tile", "pal"], default="raw"
     )
-    # one value = square tiles; two = (rows, cols) — rectangular (16, 32)
-    # tiles at C=4 unlock the consumer's direct-spatial Pallas decode
-    parser.add_argument("--tile", nargs="+", type=int, default=[32])
+    # one value = square tiles; two = (rows, cols). Default (16, 32):
+    # finer granularity than 32x32 (fewer wasted pixels per changed
+    # tile) and, at C=4, rows span 128 lanes — the consumer's
+    # direct-spatial Pallas decode engages (docs/performance.md).
+    parser.add_argument("--tile", nargs="+", type=int, default=[16, 32])
     parser.add_argument(
         "--tile-rgba", action="store_true",
         help="ship full RGBA tiles (Pallas-decodable) even when alpha is "

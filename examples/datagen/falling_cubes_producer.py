@@ -38,7 +38,8 @@ def main() -> None:
         from blendjax.producer import TileBatchPublisher
 
         tiles = TileBatchPublisher(
-            pub, scene.background_image(), opts.batch, ref_interval=64
+            pub, scene.background_image(), opts.batch, tile=(16, 32),
+            ref_interval=64,
         )
 
         def publish(f: int) -> None:
