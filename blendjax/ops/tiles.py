@@ -1030,12 +1030,14 @@ def decode_tile_delta(ref_tiles, idx, tiles, shape, use_pallas=None,
     scatter ``mode='drop'``), batch-parallel (``vmap`` over B, so a batch
     sharded along ``data`` decodes shard-locally with a replicated ref).
 
-    ``use_pallas=None`` auto-selects a Pallas kernel on TPU for
-    full-channel tiles: the direct-spatial gather
-    (:func:`_pallas_decode_spatial` — one pass, no slot buffer, no
-    transpose) when the tile geometry is lane-aligned (``tw*C % 128 ==
-    0``, ``th % 8 == 0``; the (16, 32) tiles the flagship scene streams),
-    else the slot scatter (:func:`_pallas_decode_scatter`). On a
+    ``use_pallas=None`` auto-selects a Pallas kernel on TPU: the
+    direct-spatial gather (:func:`_pallas_decode_spatial` — one pass,
+    no slot buffer, no transpose) when the tile geometry is
+    lane-aligned (``tw*C % 128 == 0``, ``th % 8 == 0``; the (16, 32)
+    tiles the flagship scene streams), else the slot scatter
+    (:func:`_pallas_decode_scatter`). Channel-sliced tiles (``Ct < C``,
+    e.g. alpha slicing) stay kernel-eligible: the missing channels are
+    restored from the reference by one on-device gather first. On a
     multi-device mesh pass ``mesh`` (with ``data_axis`` naming its batch
     axis): the kernel is wrapped in ``shard_map`` over that axis — each
     device decodes its local batch shard against the replicated
@@ -1057,8 +1059,8 @@ def decode_tile_delta(ref_tiles, idx, tiles, shape, use_pallas=None,
         if mesh is not None and data_axis in getattr(mesh, "shape", {})
         else 1
     )
-    eligible_spatial = ct == c and (tw * c) % 128 == 0 and th % 8 == 0
-    eligible = eligible_spatial or (ct == c and (th * tw * ct) % 1024 == 0)
+    eligible_spatial = (tw * c) % 128 == 0 and th % 8 == 0
+    eligible = eligible_spatial or (th * tw * c) % 1024 == 0
     if use_pallas is None:
         use_pallas = (
             jax.default_backend() == "tpu"
@@ -1068,8 +1070,30 @@ def decode_tile_delta(ref_tiles, idx, tiles, shape, use_pallas=None,
                 or (mesh is not None and n_axis > 1 and b % n_axis == 0)
             )
         )
+    if use_pallas and not eligible:
+        # explicit request for a kernel that can't lower: fail loudly
+        # rather than silently measuring/testing the XLA path
+        raise ValueError(
+            f"use_pallas=True but tile geometry {th}x{tw}x{c} is not "
+            "kernel-eligible (needs tw*C % 128 == 0 and th % 8 == 0, "
+            "or th*tw*C % 1024 == 0)"
+        )
     if use_pallas:
         interpret = jax.default_backend() != "tpu"
+
+        if ct < c:
+            # Channel-sliced stream (e.g. alpha slicing): the producer
+            # verified the trailing channels match the reference on
+            # every changed tile, so restore them ON DEVICE from the
+            # reference with one small gather — the stream then rides
+            # the kernel path instead of silently dropping to the XLA
+            # scatter (sentinel rows clamp to a real tile; their
+            # content lands in the dropped slot either way).
+            import jax.numpy as jnp
+
+            rest = ref_tiles[..., ct:]  # (N, th, tw, C-Ct)
+            filled = rest[jnp.minimum(idx, gh * gw - 1)]
+            tiles = jnp.concatenate([tiles, filled], axis=-1)
 
         if eligible_spatial:
             def decode_fn(r, i, tl):

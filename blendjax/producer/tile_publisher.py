@@ -46,11 +46,14 @@ class TileBatchPublisher:
     (typically ``scene.background_image()``). ``field``: the image field
     name the consumer will see after on-device reconstruction.
 
-    ``alpha_slice=False`` keeps full RGBA tiles on the wire even when the
-    alpha channel is static: ~33% more bytes, but full-channel tiles are
-    eligible for the consumer's Pallas scatter decode (measured ~25x
-    faster than the XLA scatter on TPU) — the right trade when the
-    device link has bandwidth to spare.
+    ``alpha_slice=False`` keeps full RGBA tiles on the wire even when
+    the alpha channel is static (~33% more bytes on the raw-tile wire).
+    Since r4 channel-sliced tiles are ALSO Pallas-kernel-eligible (the
+    consumer restores the missing channels from the reference on
+    device), so the main reason to disable slicing is the fused
+    scan+palettize producer path, which needs full-channel tiles and
+    ships palette indices — making the channel count nearly free on
+    the wire.
 
     ``ref_interval=N`` re-attaches the reference image every N batches
     (video-keyframe style). With a single consumer the one-shot default

@@ -669,6 +669,41 @@ def test_sharded_spatial_decode_on_mesh():
         np.testing.assert_array_equal(sharded[i], f)
 
 
+def test_channel_sliced_tiles_take_kernel_paths():
+    """Alpha-sliced (RGB-of-RGBA) streams stay kernel-eligible: the
+    decode restores the missing channel from the reference on device
+    and runs the spatial (rect) or slot (square) kernel — bit-exact vs
+    the XLA path that handles Ct < C natively."""
+    for tile in ((16, 32), 16):
+        ref, frames = _frames(n=4, shape=(64, 64), seed=37)
+        # make alpha static so slicing is valid: frames share ref alpha
+        for f in frames:
+            f[..., 3] = ref[..., 3]
+        enc = TileDeltaEncoder(ref, tile=tile)
+        deltas = [tuple(a.copy() for a in enc.encode(f)) for f in frames]
+        idx, tiles = pack_batch(deltas, enc.num_tiles)
+        rgb = np.ascontiguousarray(tiles[..., :3])
+        rt = tile_ref(ref, tile)
+        xla = np.asarray(
+            decode_tile_delta(rt, idx, rgb, ref.shape, use_pallas=False)
+        )
+        kern = np.asarray(
+            decode_tile_delta(rt, idx, rgb, ref.shape, use_pallas=True)
+        )
+        np.testing.assert_array_equal(xla, kern)
+        for i, f in enumerate(frames):
+            np.testing.assert_array_equal(kern[i], f)
+    # forcing the kernel on an ineligible geometry fails loudly instead
+    # of silently measuring the XLA path
+    ref8 = np.zeros((64, 64, 4), np.uint8)
+    with pytest.raises(ValueError, match="kernel-eligible"):
+        decode_tile_delta(
+            tile_ref(ref8, 8), np.zeros((1, 1), np.int32),
+            np.zeros((1, 1, 8, 8, 4), np.uint8), ref8.shape,
+            use_pallas=True,
+        )
+
+
 def test_tileshape_wire_geom_roundtrip():
     """Wire-geometry helpers: the square v1 4-element form and the
     rectangular 5-element form round-trip through geom_tile."""
