@@ -85,6 +85,33 @@ TILE_CAPACITY = os.environ.get(
 )
 
 
+def probe_link_bandwidth(rtt: float) -> float | None:
+    """One-way h2d bandwidth in MB/s: two 8 MB incompressible puts
+    chained before ONE tiny d2h sync (fetching a buffer back would time
+    the return leg too and halve the number; zeros would sail through
+    any compressing tunnel hop at fantasy speed). ``rtt`` (a measured
+    d2h round trip) is subtracted as the sync constant. Shared by the
+    bench record (``link_h2d_MB_s``) and scripts/weather.py so the
+    preflight verdict and the recorded weather can't drift apart.
+    """
+    import jax
+
+    try:
+        buf = np.random.default_rng(0).integers(
+            0, 255, 8 << 20, dtype=np.uint8
+        )
+        np.asarray(jax.device_put(buf)[:1])  # warm transfer path/allocs
+        t0 = time.perf_counter()
+        jax.device_put(buf)
+        x = jax.device_put(buf)
+        np.asarray(x[:1])
+        dt = max(time.perf_counter() - t0 - rtt, 1e-9)
+        return 2 * buf.nbytes / dt / 1e6
+    except Exception as e:
+        print(f"bandwidth probe failed: {e!r}", file=sys.stderr)
+        return None
+
+
 def measure(encoding: str, chunk: int, items: int, time_cap: float,
             with_stages: bool = True) -> dict:
     """One full producer-fleet + pipeline + train measurement pass."""
@@ -597,6 +624,17 @@ def _build_record(progress: dict) -> dict:
     except Exception:
         pass
     degraded = rtt is not None and rtt > 1.0
+    # Bandwidth leg of the weather stamp: the collapsed mode keeps a
+    # healthy RTT, so only a sized transfer identifies the window the
+    # record was taken in (good ~43 MB/s; collapsed 5-15). Skipped when
+    # the link is already degraded: subtracting a multi-second noisy
+    # rtt from a similar-magnitude transfer yields garbage (and the
+    # probe would burn watchdog budget) — degraded_link already names
+    # that window.
+    h2d_mbs = (
+        probe_link_bandwidth(rtt)
+        if rtt is not None and not degraded else None
+    )
 
     # BLENDJAX_BENCH_PASSES measurement passes (default 4), best
     # sustained reported: the device link's throughput swings
@@ -644,6 +682,8 @@ def _build_record(progress: dict) -> dict:
     detail["backend"] = jax.default_backend()
     if rtt is not None:
         detail["link_rtt_s"] = round(rtt, 3)
+    if h2d_mbs is not None:
+        detail["link_h2d_MB_s"] = round(h2d_mbs, 1)
     if degraded:
         detail["degraded_link"] = True
     detail["passes"] = [

@@ -52,23 +52,17 @@ def main() -> int:
           f"({'ok' if rtt < 0.5 else 'DEGRADED'})")
     if rtt >= 0.5:
         return 2
-    # Bandwidth probe: the collapsed mode keeps a healthy RTT, so only a
-    # sized transfer exposes it (~43 MB/s good-weather h2d measured in
-    # BENCH_r03; collapsed windows sit at ~5-15 MB/s). Two 8 MB h2d
-    # puts chained before ONE tiny d2h sync (fetching the buffer back
-    # would time the d2h leg too and halve the number); incompressible
-    # bytes, in case any tunnel hop compresses (zeros would sail
-    # through a compressing hop at fantasy speed).
-    buf = np.random.default_rng(0).integers(
-        0, 255, 8 << 20, dtype=np.uint8
-    )
-    np.asarray(jax.device_put(buf)[:1])  # warm the transfer path/allocs
-    t0 = time.perf_counter()
-    jax.device_put(buf)
-    x = jax.device_put(buf)
-    np.asarray(x[:1])  # one-element d2h: ~rtt, subtracted below
-    dt = max(time.perf_counter() - t0 - rtt, 1e-9)
-    mbs = 2 * buf.nbytes / dt / 1e6
+    # Bandwidth probe: the collapsed mode keeps a healthy RTT, so only
+    # a sized transfer exposes it (~43 MB/s good-weather h2d measured
+    # in BENCH_r03; collapsed windows sit at ~5-15 MB/s). Same probe
+    # the bench stamps into its record as link_h2d_MB_s.
+    sys.path.insert(0, REPO_ROOT)
+    from bench import probe_link_bandwidth
+
+    mbs = probe_link_bandwidth(rtt)
+    if mbs is None:
+        print("h2d bandwidth: probe failed")
+        return 5
     print(f"h2d bandwidth: {mbs:.0f} MB/s "
           f"({'ok' if mbs >= 25 else 'BANDWIDTH-COLLAPSED'})")
     if mbs < 25:
@@ -76,7 +70,6 @@ def main() -> int:
     if "--pass" not in sys.argv:
         return 0
 
-    sys.path.insert(0, REPO_ROOT)
     import bench
 
     # Same config + floor the bench itself gates retries on, so the
