@@ -63,9 +63,13 @@ def main() -> int:
     if mbs is None:
         print("h2d bandwidth: probe failed")
         return 5
+    # 35 MB/s bar: good windows measure ~43; a 27-29 MB/s window passed
+    # a 25 bar once and still ran end-to-end passes at ~22 img/s (the
+    # tunnel flapped right after the probe), so the bar sits close to
+    # the good-weather figure. --pass remains the definitive check.
     print(f"h2d bandwidth: {mbs:.0f} MB/s "
-          f"({'ok' if mbs >= 25 else 'BANDWIDTH-COLLAPSED'})")
-    if mbs < 25:
+          f"({'ok' if mbs >= 35 else 'BANDWIDTH-COLLAPSED'})")
+    if mbs < 35:
         return 3
     if "--pass" not in sys.argv:
         return 0
