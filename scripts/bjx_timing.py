@@ -1,0 +1,36 @@
+"""Chained-reps device timing shared by the perf scripts.
+
+The only honest method on tunneled backends (docs/performance.md
+"Measurement hygiene"): chain ``reps`` calls between two d2h fetches
+and subtract a measured bare fetch, so the ~0.1 s sync constant
+divides out.
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+
+def sync(x) -> None:
+    """Block on (and fetch one element of) the result's last leaf."""
+    import jax
+
+    np.asarray(jax.tree_util.tree_leaves(x)[-1]).reshape(-1)[-1]
+
+
+def timed(fn, args, reps: int, sync=sync) -> float:
+    """Seconds per call of ``fn(*args)`` over ``reps`` chained calls
+    (first call untimed: compile/warm)."""
+    out = fn(*args)
+    sync(out)
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = fn(*args)
+    sync(out)
+    total = time.perf_counter() - t0
+    t1 = time.perf_counter()
+    sync(out)
+    bare = time.perf_counter() - t1
+    return max(total - bare, 1e-9) / reps

@@ -11,23 +11,10 @@ hygiene" (the only honest method on tunneled backends).
 from __future__ import annotations
 
 import argparse
-import time
 
 import numpy as np
 
-
-def timed(fn, args, reps: int, sync) -> float:
-    out = fn(*args)
-    sync(out)
-    t0 = time.perf_counter()
-    for _ in range(reps):
-        out = fn(*args)
-    sync(out)
-    total = time.perf_counter() - t0
-    t1 = time.perf_counter()
-    sync(out)
-    bare = time.perf_counter() - t1
-    return max(total - bare, 1e-9) / reps
+from bjx_timing import sync, timed
 
 
 def main() -> None:
@@ -52,9 +39,6 @@ def main() -> None:
     for f in range(1, 5):
         scene.step(f)
         frames.append(scene.render().copy())
-
-    def sync(x):
-        np.asarray(jax.tree_util.tree_leaves(x)[-1]).reshape(-1)[-1]
 
     results = {}
     for tag, tile, kcap in (("slot 16x16", 16, 288),

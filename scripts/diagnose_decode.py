@@ -15,24 +15,10 @@ per stage. Feeds the r4->r5 lever ranking in PARITY.md.
 from __future__ import annotations
 
 import argparse
-import time
 
 import numpy as np
 
-
-def timed(fn, args, reps: int, sync) -> float:
-    out = fn(*args)
-    sync(out)  # warm/compile
-    t0 = time.perf_counter()
-    for _ in range(reps):
-        out = fn(*args)
-    sync(out)
-    total = time.perf_counter() - t0
-    # one chained run has one sync; subtract a measured bare fetch
-    t1 = time.perf_counter()
-    sync(out)
-    bare = time.perf_counter() - t1
-    return max(total - bare, 1e-9) / reps
+from bjx_timing import sync, timed
 
 
 def main() -> None:
@@ -69,10 +55,6 @@ def main() -> None:
     raw_tiles = jax.device_put(
         rng.integers(0, 255, (B, K, t, t, C), np.uint8)
     )
-
-    def sync(x):
-        leaf = jax.tree_util.tree_leaves(x)[-1]
-        np.asarray(leaf).reshape(-1)[-1]
 
     expand = jax.jit(
         lambda p, q: T.expand_palette_tiles(p, q, 2, t, C)

@@ -16,23 +16,10 @@ Run: ``PYTHONPATH=.:$PYTHONPATH python scripts/exp_lut_expand.py``.
 from __future__ import annotations
 
 import argparse
-import time
 
 import numpy as np
 
-
-def timed(fn, args, reps: int, sync) -> float:
-    out = fn(*args)
-    sync(out)
-    t0 = time.perf_counter()
-    for _ in range(reps):
-        out = fn(*args)
-    sync(out)
-    total = time.perf_counter() - t0
-    t1 = time.perf_counter()
-    sync(out)
-    bare = time.perf_counter() - t1
-    return max(total - bare, 1e-9) / reps
+from bjx_timing import sync, timed
 
 
 def main() -> None:
@@ -52,9 +39,6 @@ def main() -> None:
     palidx = rng.integers(0, 4, (B, K, tt), np.uint8)
     packed = jax.device_put(T.pack_palette_indices(palidx, 2))
     pal = jax.device_put(rng.integers(0, 255, (B, 4, C)).astype(np.uint8))
-
-    def sync(x):
-        np.asarray(jax.tree_util.tree_leaves(x)[-1]).reshape(-1)[-1]
 
     # Baseline inlines the PRE-r4 unpack+gather chain (the library's
     # expand_palette_tiles now dispatches to the LUT itself, so calling
