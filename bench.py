@@ -73,26 +73,55 @@ TILE_GEOM = os.environ.get("BLENDJAX_BENCH_TILE", "16x32")
 _TILE_ARGS = TILE_GEOM.split("x")
 
 
-def tile_capacity_default(tile_args) -> str:
-    """32-aligned fit over the cube's measured max changed-tile count
-    (282 @16x16 -> 288; 154 @16x32 -> 160). Shared with the A/B script
-    so both always benchmark the capacity the bench would use."""
-    return "288" if len(tile_args) == 1 else "160"
+def tile_capacity_default(th: int, tw: int) -> str:
+    """Default ``--tile-capacity`` for the cube scene at 480x640.
+
+    The two benchmarked geometries get their measured max changed-tile
+    counts, 32-aligned (282 @16x16 -> 288; 154 @16x32 -> 160). Any other
+    geometry gets an estimate scaled from the 16x16 measurement by tile
+    area with a boundary margin, clamped to the grid size: oversizing
+    only pads the wire, while undersizing costs a mid-run capacity
+    growth + decode recompile. Shared with the A/B script so both always
+    benchmark the capacity the bench would use."""
+    measured = {(16, 16): 288, (16, 32): 160}
+    if (th, tw) in measured:
+        return str(measured[(th, tw)])
+    import math
+
+    grid = math.ceil(SHAPE[0] / th) * math.ceil(SHAPE[1] / tw)
+    changed_px = 282 * 256  # the 16x16 measurement, in pixels
+    est = math.ceil(changed_px / (th * tw) * 1.3 / 32) * 32
+    return str(max(1, min(est, grid)))  # grid can be < 32 for huge tiles
 
 
 TILE_CAPACITY = os.environ.get(
-    "BLENDJAX_BENCH_TILE_CAPACITY", tile_capacity_default(_TILE_ARGS)
+    "BLENDJAX_BENCH_TILE_CAPACITY",
+    tile_capacity_default(int(_TILE_ARGS[0]), int(_TILE_ARGS[-1])),
 )
+
+# Fit-weather bar for the h2d bandwidth probe (MB/s): good windows
+# measure ~43; the collapsed mode sits at 3-29. A 27-29 MB/s window once
+# passed a lower bar and still collapsed mid-run, so the bar sits close
+# to the good-weather figure. scripts/weather.py imports this same
+# constant, so the CLI preflight and the in-record gate cannot drift.
+FIT_H2D_MBS = 35.0
+# Default for BLENDJAX_BENCH_RETRY_FLOOR (img/s): the pass value below
+# which a sample reads "bad window", not "slow framework" — in-session
+# good windows measure ~500-590. Exported for scripts/weather.py's
+# --pass verdict (same no-drift rule as FIT_H2D_MBS).
+RETRY_FLOOR_DEFAULT = 400.0
 
 
 def probe_link_bandwidth(rtt: float) -> float | None:
-    """One-way h2d bandwidth in MB/s: two 8 MB incompressible puts
+    """One-way h2d bandwidth in MB/s: three 8 MB incompressible puts
     chained before ONE tiny d2h sync (fetching a buffer back would time
-    the return leg too and halve the number; zeros would sail through
+    the return leg too and skew the number low; zeros would sail through
     any compressing tunnel hop at fantasy speed). ``rtt`` (a measured
-    d2h round trip) is subtracted as the sync constant. Shared by the
-    bench record (``link_h2d_MB_s``) and scripts/weather.py so the
-    preflight verdict and the recorded weather can't drift apart.
+    d2h round trip) is subtracted as the sync constant; the third put
+    amortizes the remaining dispatch overhead (ADVICE r4: two puts read
+    a few percent optimistic against a 35 MB/s bar). Shared by the bench
+    record (``link_h2d_MB_s``) and scripts/weather.py so the preflight
+    verdict and the recorded weather can't drift apart.
     """
     import jax
 
@@ -103,18 +132,81 @@ def probe_link_bandwidth(rtt: float) -> float | None:
         np.asarray(jax.device_put(buf)[:1])  # warm transfer path/allocs
         t0 = time.perf_counter()
         jax.device_put(buf)
+        jax.device_put(buf)
         x = jax.device_put(buf)
         np.asarray(x[:1])
         dt = max(time.perf_counter() - t0 - rtt, 1e-9)
-        return 2 * buf.nbytes / dt / 1e6
+        return 3 * buf.nbytes / dt / 1e6
     except Exception as e:
         print(f"bandwidth probe failed: {e!r}", file=sys.stderr)
         return None
 
 
+def weather_probe() -> dict:
+    """One tunnel-weather sample: d2h RTT plus the sized h2d bandwidth
+    probe, with the fit verdict at :data:`FIT_H2D_MBS`.
+
+    Stamped before AND after every measurement pass (and every add-on
+    row) so each number in the record names the window it was taken in —
+    the tunnel flaps between ~5 and ~43 MB/s within minutes, and r4's
+    authoritative record was silently captured in a collapsed window.
+    """
+    import jax
+
+    out: dict = {"fit": False}
+    try:
+        np.asarray(jax.device_put(np.zeros(8, np.uint8)))  # warm path
+        t0 = time.perf_counter()
+        np.asarray(jax.device_put(np.zeros(8, np.uint8)))
+        rtt = time.perf_counter() - t0
+    except Exception as e:
+        out["error"] = repr(e)[:120]
+        return out
+    out["rtt_s"] = round(rtt, 3)
+    if rtt >= 0.5:
+        return out  # outage mode: a bandwidth figure would be RTT noise
+    mbs = probe_link_bandwidth(rtt)
+    if mbs is not None:
+        out["h2d_MB_s"] = round(mbs, 1)
+        out["fit"] = mbs >= FIT_H2D_MBS
+    return out
+
+
+def ceiling_ratio_row(ips: float, ceiling: dict, headline_fit: bool):
+    """How ``utilization_vs_ceiling`` publishes (pure, unit-tested).
+
+    The ratio is only meaningful when the headline pass and the ceiling
+    replay were measured in the same weather regime: both in fit
+    windows, ceiling uncapped, and live not "beating" the ceiling by
+    more than noise (r4's record published 1.577 from a cross-window
+    comparison). Anything else returns a dict naming why the ratio is
+    invalid, with the uncomparable number preserved for the archive.
+    """
+    img_s = ceiling.get("img_s")
+    if not img_s:
+        return {"invalid": "ceiling_failed"}
+    ratio = round(ips / img_s, 3)
+    comparable = (
+        headline_fit
+        and bool(ceiling.get("fit_window"))
+        and not ceiling.get("capped")
+    )
+    if comparable and ratio <= 1.05:
+        return ratio
+    return {
+        "invalid": "window_mismatch" if comparable else "weather",
+        "uncomparable_ratio": ratio,
+    }
+
+
 def measure(encoding: str, chunk: int, items: int, time_cap: float,
-            with_stages: bool = True) -> dict:
-    """One full producer-fleet + pipeline + train measurement pass."""
+            with_stages: bool = True, tile_args=None,
+            tile_capacity=None) -> dict:
+    """One full producer-fleet + pipeline + train measurement pass.
+
+    ``tile_args``/``tile_capacity`` default to the module-level bench
+    configuration; A/B scripts pass explicit values instead of mutating
+    module globals (ADVICE r4)."""
     import jax
 
     from blendjax.data import StreamDataPipeline
@@ -129,6 +221,13 @@ def measure(encoding: str, chunk: int, items: int, time_cap: float,
     )
     from blendjax.utils.metrics import metrics as reg
 
+    tile_args = (
+        list(_TILE_ARGS) if tile_args is None
+        else [str(a) for a in tile_args]  # subprocess argv must be str
+    )
+    tile_capacity = (
+        TILE_CAPACITY if tile_capacity is None else str(tile_capacity)
+    )
     cpu = os.cpu_count() or 1
     # Single-core hosts still run TWO producers: each spends a sizable
     # slice blocked on socket IO/HWM, and a second instance fills those
@@ -180,8 +279,8 @@ def measure(encoding: str, chunk: int, items: int, time_cap: float,
         # on overflow).
         instance_args=[
             ["--shape", str(SHAPE[0]), str(SHAPE[1]), "--batch", str(BATCH),
-             "--encoding", encoding, "--tile", *_TILE_ARGS, "--tile-rgba",
-             "--tile-capacity", TILE_CAPACITY]
+             "--encoding", encoding, "--tile", *tile_args, "--tile-rgba",
+             "--tile-capacity", tile_capacity]
         ] * instances,
     ) as launcher:
         def batch_images(sb):
@@ -606,99 +705,247 @@ def _build_record(progress: dict) -> dict:
     except Exception:
         pass  # older jax without these flags: compile per run
 
-    # Upfront link-health probe: the tunnel has multi-hour outage modes
-    # (observed: d2h round trips of 3-24 s vs ~0.1 s normal). In that
-    # state a full-size bench would grind past any reasonable driver
-    # timeout and record NOTHING — shrink the workload instead and
-    # stamp the probe into the JSON so the numbers read as what they
-    # are: a measurement of a degraded link, not of the framework.
-    rtt = None
-    try:
-        # one UNTIMED round trip first: the first device op pays PJRT
-        # backend init, which would misread as link latency
-        np.asarray(jax.device_put(np.zeros(8, np.uint8)))
-        t0 = time.perf_counter()
-        probe = jax.device_put(np.zeros(8, np.uint8))
-        np.asarray(probe)
-        rtt = time.perf_counter() - t0
-    except Exception:
-        pass
-    degraded = rtt is not None and rtt > 1.0
-    # Bandwidth leg of the weather stamp: the collapsed mode keeps a
-    # healthy RTT, so only a sized transfer identifies the window the
-    # record was taken in (good ~43 MB/s; collapsed 5-15). Skipped when
-    # the link is already degraded: subtracting a multi-second noisy
-    # rtt from a similar-magnitude transfer yields garbage (and the
-    # probe would burn watchdog budget) — degraded_link already names
-    # that window.
-    h2d_mbs = (
-        probe_link_bandwidth(rtt)
-        if rtt is not None and not degraded else None
-    )
+    # Upfront weather sample: RTT + sized bandwidth. The tunnel has
+    # multi-hour outage modes (d2h round trips of 3-58 s vs ~0.1 s
+    # normal) in which a full-size bench would grind past any driver
+    # timeout and record NOTHING — shrink the workload instead. The
+    # collapsed mode keeps a healthy RTT, so only the sized transfer
+    # identifies the window (good ~43 MB/s; collapsed 3-29).
+    w0 = weather_probe()
+    degraded = w0.get("rtt_s", 0.0) > 1.0
 
-    # BLENDJAX_BENCH_PASSES measurement passes (default 4), best
-    # sustained reported: the device link's throughput swings
-    # several-fold within minutes (tunnel weather), so a single sample
-    # under-reports the pipeline more often than not. Every pass lands
-    # in detail.passes for the full picture.
+    # BLENDJAX_BENCH_PASSES fit-window passes wanted (default 4), best
+    # reported. The r4 lesson: the authoritative record was captured in
+    # a collapsed window while the framework measured 2.5x faster in
+    # ordinary weather — so the bench now POLLS for a fit window with
+    # the cheap probe instead of burning full passes on known-bad
+    # windows, and stamps pre+post probes on every pass so each number
+    # names the window it was taken in.
     n_passes = max(1, int(os.environ.get("BLENDJAX_BENCH_PASSES", "4")))
     items = MEASURE_ITEMS
+    wait_budget = float(
+        os.environ.get("BLENDJAX_BENCH_WINDOW_WAIT_S", "480")
+    )
+    # The floor marks "a window this framework's ordinary weather can
+    # beat" (RETRY_FLOOR_DEFAULT): while the best FIT pass sits below
+    # it, keep rolling — a bandwidth probe at 35-40 MB/s sometimes
+    # fronts a window whose larger-op path still runs 10x slow
+    # (observed: fit probes, 66 img/s passes, decode dispatch 507
+    # ms/group vs ~75 good-weather), and only a real pass exposes that
+    # mode.
+    retry_floor = float(
+        os.environ.get("BLENDJAX_BENCH_RETRY_FLOOR", RETRY_FLOOR_DEFAULT)
+    )
+    poll_sleep = float(os.environ.get("BLENDJAX_BENCH_POLL_SLEEP_S", "12"))
     if degraded:
+        # Outage: every probe costs multiple RTTs (up to ~2 min at the
+        # observed 58 s RTTs) — skip polling AND per-pass probes
+        # entirely; `degraded_link` already names the window, and the
+        # watchdog budget belongs to the shrunken fallback passes.
         n_passes = min(n_passes, 2)
         items = min(items, 256)
+        wait_budget = 0.0
     passes = []
-
-    def one_pass():
-        passes.append(measure(ENCODING, CHUNK, items, TIME_CAP_S))
-        progress["passes"] = [
-            {"value": q["value"], "seconds": q["seconds"]} for q in passes
-        ]
-
     t_meas0 = time.perf_counter()
-    for _ in range(n_passes):
-        one_pass()
-    # Adaptive extra rolls: the tunnel flaps between ~20 and ~600 img/s
-    # within minutes. If every pass so far is far below this box's
-    # ordinary-weather range, the sample says "bad window", not
-    # "slow framework" — spend a bounded extra budget re-rolling for a
-    # better window (every pass stays recorded in detail.passes either
-    # way, so the record keeps its full honesty).
-    retry_floor = float(os.environ.get("BLENDJAX_BENCH_RETRY_FLOOR", "150"))
-    retry_budget = float(
-        os.environ.get("BLENDJAX_BENCH_RETRY_BUDGET_S", "360")
-    )
+    _SKIPPED_PROBE = {"fit": False, "skipped": "outage"}
+
+    def fit_passes():
+        return [p for p in passes if p.get("fit_window")]
+
+    def run_pass(pre):
+        q = measure(ENCODING, CHUNK, items, TIME_CAP_S)
+        post = _SKIPPED_PROBE if degraded else weather_probe()
+        q["weather"] = {"pre": pre, "post": post}
+        # fit only when the window HELD: the tunnel has flapped between
+        # a passing probe and the first pass before (PARITY lever 1).
+        q["fit_window"] = bool(pre.get("fit") and post.get("fit"))
+        passes.append(q)
+        progress["passes"] = [
+            {"value": p["value"], "seconds": p["seconds"],
+             "fit_window": p.get("fit_window", False)}
+            for p in passes
+        ]
+        return q
+
+    # Structurally-unfit streak: a probe with no bandwidth figure
+    # (device error, or RTT in the 0.5-1.0 s band where the bandwidth
+    # leg is skipped) can never turn fit by waiting — after a few in a
+    # row, stop polling and measure what exists instead of sleeping the
+    # watchdog budget away. Collapsed windows DO return a bandwidth
+    # figure, so the poll keeps waiting those out as intended.
+    blind_streak = 0
     while (
-        not degraded
-        and max(p["value"] for p in passes) < retry_floor
-        and time.perf_counter() - t_meas0 < retry_budget
-        and len(passes) < 12
+        time.perf_counter() - t_meas0 < wait_budget and len(passes) < 20
     ):
-        one_pass()
-    primary = max(passes, key=lambda r: r["value"])
+        fit = fit_passes()
+        if fit and len(fit) >= n_passes and max(
+            p["value"] for p in fit
+        ) >= retry_floor:
+            break
+        pre = weather_probe()
+        blind_streak = 0 if "h2d_MB_s" in pre else blind_streak + 1
+        if blind_streak >= 3:
+            break
+        if pre.get("fit"):
+            run_pass(pre)
+        else:
+            time.sleep(poll_sleep)
+    # Fallback: no fit window appeared inside the whole budget. The
+    # record must still carry measurements (weather-labeled), not be
+    # empty — run the passes in whatever window exists.
+    if not passes:
+        for i in range(n_passes):
+            if degraded:
+                # w0 already told the story; don't pay more outage RTTs
+                run_pass(w0 if i == 0 else _SKIPPED_PROBE)
+            else:
+                run_pass(weather_probe())
+
+    fit = fit_passes()
+    primary = max(fit or passes, key=lambda r: r["value"])
+    headline_fit = bool(primary.get("fit_window"))
     detail = dict(primary)
     progress["detail"] = detail  # live reference: add-on rows appear
     # in the watchdog's partial record as they land
     ips = detail.pop("value")
     detail["backend"] = jax.default_backend()
-    if rtt is not None:
-        detail["link_rtt_s"] = round(rtt, 3)
-    if h2d_mbs is not None:
-        detail["link_h2d_MB_s"] = round(h2d_mbs, 1)
+    detail["fit_weather"] = headline_fit
+    detail["fit_bar_MB_s"] = FIT_H2D_MBS
+    if "rtt_s" in w0:
+        detail["link_rtt_s"] = w0["rtt_s"]
+    # the headline's own window, not the run-start sample
+    pre_h2d = detail.get("weather", {}).get("pre", {}).get("h2d_MB_s")
+    if pre_h2d is not None:
+        detail["link_h2d_MB_s"] = pre_h2d
+    elif "h2d_MB_s" in w0:
+        detail["link_h2d_MB_s"] = w0["h2d_MB_s"]
     if degraded:
         detail["degraded_link"] = True
     detail["passes"] = [
-        {"value": p["value"], "seconds": p["seconds"]} for p in passes
+        {"value": p["value"], "seconds": p["seconds"],
+         "fit_window": p.get("fit_window", False),
+         "h2d_MB_s": [p["weather"]["pre"].get("h2d_MB_s"),
+                      p["weather"]["post"].get("h2d_MB_s")]}
+        for p in passes
     ]
+
+    def gated_row(fn, budget: float = 180.0, attempts: int = 2):
+        """Run an add-on measurement inside the same weather regime as
+        the headline: when the headline was fit, poll (bounded) for a
+        fit window first and retry once if the window collapsed mid-row;
+        when the headline itself never saw fit weather, run immediately
+        (polling again would just burn watchdog budget — and in outage
+        mode each probe costs multiple multi-second RTTs, so probes are
+        skipped wholesale). The returned row carries its own pre+post
+        probes + fit verdict."""
+        if degraded:
+            row = fn()
+            row["weather"] = {"pre": _SKIPPED_PROBE,
+                              "post": _SKIPPED_PROBE}
+            row["fit_window"] = False
+            return row
+        t0 = time.perf_counter()
+        row = None
+        for _ in range(attempts):
+            pre = weather_probe()
+            while (
+                headline_fit and not pre.get("fit")
+                and time.perf_counter() - t0 < budget
+            ):
+                time.sleep(poll_sleep)
+                pre = weather_probe()
+            row = fn()
+            post = weather_probe()
+            row["weather"] = {"pre": pre, "post": post}
+            row["fit_window"] = bool(pre.get("fit") and post.get("fit"))
+            if row["fit_window"] or not headline_fit or (
+                time.perf_counter() - t0 > budget
+            ):
+                break
+        return row
+
     # Add-on rows must never discard the collected pass data: a flake
     # here records an error string instead of losing the whole bench.
+    # Window-sensitive rows run FIRST (ceiling, then raw) so they share
+    # the headline's weather; the CPU-only RL row runs last.
+    if ENCODING == "tile" and not degraded:
+        # Only meaningful when the headline ran the tile stream the
+        # ceiling replays — comparing codecs would make the ratio lie.
+        try:
+            # Runtime ceiling (VERDICT r3 next #1): the same transfer ->
+            # decode -> step pipeline with every wire message pre-staged
+            # on the host (ingest free). utilization_vs_ceiling is the
+            # honest "how much of what this runtime could do does the
+            # live path achieve" — published ONLY when the ceiling and
+            # the headline were measured in fit windows (VERDICT r4 #1:
+            # the cross-window ratio is meaningless).
+            ceil = gated_row(
+                lambda: measure_pipelined_ceiling(primary["chunk"]),
+                budget=240.0,
+            )
+            detail["pipelined_ceiling"] = ceil
+            detail["utilization_vs_ceiling"] = ceiling_ratio_row(
+                ips, ceil, headline_fit
+            )
+        except Exception as e:  # pragma: no cover - device flake path
+            detail["pipelined_ceiling"] = {"error": repr(e)[:200]}
+    if ENCODING == "tile" and RAW_ROW and not degraded:
+        # Shorter full-frame row: tracks the non-sparse path (whole
+        # frames, no temporal-delta assumption) without doubling bench
+        # time. Default codec is the lossless full-frame palette
+        # (producer --encoding pal): 640x480x4 frames decode bit-exact
+        # from 4-8x fewer bytes across the wire AND the host->device
+        # link, which is what binds this row (r3: feed.throttle_wait =
+        # 89% of the raw wall at a measured 43 MB/s device link).
+        # Stage breakdown included so the row's bound is evidenced.
+        try:
+            raw = gated_row(
+                lambda: measure(
+                    RAW_ENCODING,
+                    RAW_CHUNK if RAW_ENCODING == "pal" else 1,
+                    256 if RAW_ENCODING == "pal" else 128,
+                    45.0,
+                    with_stages=True,
+                ),
+                budget=180.0,
+            )
+            raw["MB_per_image"] = round(SHAPE[0] * SHAPE[1] * 4 / 1e6, 3)
+            raw["MB_s"] = round(raw["value"] * raw["MB_per_image"], 1)
+            if RAW_ENCODING == "pal":
+                counters = raw.get("stages", {}).get("counters", {})
+                wire = counters.get("pal.wire_bytes", 0)
+                decoded = counters.get("pal.decoded_bytes", 0)
+                raw["codec"] = (
+                    "full-frame palette (lossless, device gather)"
+                )
+                if wire and decoded:
+                    raw["wire_MB_per_image"] = round(
+                        raw["MB_per_image"] * wire / decoded, 4
+                    )
+                    raw["compression"] = round(decoded / wire, 2)
+            detail["raw_row"] = raw
+        except Exception as e:  # pragma: no cover - device flake path
+            detail["raw_row"] = {"error": repr(e)[:200]}
     try:
         # Chip-utilization estimate: achieved throughput over the
-        # step-alone ceiling measured in the same process/weather
-        # window, at the chunk configuration the passes ACTUALLY ran
-        # (recorded in the pass result, not re-derived here).
-        alone = measure_step_alone(primary["chunk"])
+        # step-alone ceiling, at the chunk configuration the passes
+        # ACTUALLY ran (recorded in the pass result, not re-derived
+        # here). Pure device compute, but the collapsed mode slows
+        # per-op dispatch too — so this row is window-stamped as well.
+        alone = gated_row(
+            lambda: measure_step_alone(primary["chunk"]), budget=120.0
+        )
         detail["step_alone"] = alone
-        detail["utilization"] = round(ips / alone["img_s"], 3)
+        util = round(ips / alone["img_s"], 3)
+        if headline_fit and alone.get("fit_window"):
+            detail["utilization"] = util
+        else:
+            # same cross-window rule as utilization_vs_ceiling: a
+            # ratio of numbers from different weather regimes is not a
+            # chip-utilization figure
+            detail["utilization"] = {
+                "invalid": "weather", "uncomparable_ratio": util,
+            }
     except Exception as e:  # pragma: no cover - device flake path
         detail["step_alone"] = {"error": repr(e)[:200]}
     device_kind = (jax.devices()[0].device_kind or "").lower()
@@ -728,58 +975,12 @@ def _build_record(progress: dict) -> dict:
                 )
         except Exception as e:  # pragma: no cover - device flake path
             detail["model_flops"] = {"error": repr(e)[:200]}
-    if ENCODING == "tile" and not degraded:
-        # Only meaningful when the headline ran the tile stream the
-        # ceiling replays — comparing codecs would make the ratio lie.
-        try:
-            # Runtime ceiling (VERDICT r3 next #1): the same transfer ->
-            # decode -> step pipeline with every wire message pre-staged
-            # on the host (ingest free). utilization_vs_ceiling is the
-            # honest "how much of what this runtime could do does the
-            # live path achieve" — step_alone remains the transfers-free
-            # chip number.
-            ceil = measure_pipelined_ceiling(primary["chunk"])
-            detail["pipelined_ceiling"] = ceil
-            detail["utilization_vs_ceiling"] = round(
-                ips / ceil["img_s"], 3
-            )
-        except Exception as e:  # pragma: no cover - device flake path
-            detail["pipelined_ceiling"] = {"error": repr(e)[:200]}
     try:
         # RL stepping rate (REQ/REP rendezvous, rendering off) — CPU/IPC
         # only, so it is weather-independent.
         detail["rl_hz"] = measure_rl_hz()
     except Exception as e:  # pragma: no cover - producer flake path
         detail["rl_hz"] = {"error": repr(e)[:200]}
-    if ENCODING == "tile" and RAW_ROW and not degraded:
-        # Shorter full-frame row: tracks the non-sparse path (whole
-        # frames, no temporal-delta assumption) without doubling bench
-        # time. Default codec is the lossless full-frame palette
-        # (producer --encoding pal): 640x480x4 frames decode bit-exact
-        # from 4-8x fewer bytes across the wire AND the host->device
-        # link, which is what binds this row (r3: feed.throttle_wait =
-        # 89% of the raw wall at a measured 43 MB/s device link).
-        # Stage breakdown included so the row's bound is evidenced.
-        raw = measure(
-            RAW_ENCODING,
-            RAW_CHUNK if RAW_ENCODING == "pal" else 1,
-            256 if RAW_ENCODING == "pal" else 128,
-            45.0,
-            with_stages=True,
-        )
-        raw["MB_per_image"] = round(SHAPE[0] * SHAPE[1] * 4 / 1e6, 3)
-        raw["MB_s"] = round(raw["value"] * raw["MB_per_image"], 1)
-        if RAW_ENCODING == "pal":
-            counters = raw.get("stages", {}).get("counters", {})
-            wire = counters.get("pal.wire_bytes", 0)
-            decoded = counters.get("pal.decoded_bytes", 0)
-            raw["codec"] = "full-frame palette (lossless, device gather)"
-            if wire and decoded:
-                raw["wire_MB_per_image"] = round(
-                    raw["MB_per_image"] * wire / decoded, 4
-                )
-                raw["compression"] = round(decoded / wire, 2)
-        detail["raw_row"] = raw
     return _record(ips, detail)
 
 

@@ -28,13 +28,16 @@ Decoding (device side, consumer):
 Wire convention (understood by ``blendjax.data.StreamDataPipeline`` and
 the torch adapter; full table in ``docs/wire-protocol.md``): for an image
 field ``name`` a tile-encoded batch message carries ``name__tileidx``
-(B, K) int32, ``name__tileshape`` [H, W, C, t], and the tile payload —
-``name__tiles`` (B, K, t, t, C) uint8 raw, or the palette-compressed
-``name__tilepal4``/``name__tilepal8`` + ``name__palette`` when the
-batch's colors fit 4/8-bit indices. The reference image travels as
-``name__tileref`` (H, W, C) in the producer's first message — and, when
-``TileBatchPublisher(ref_interval=N)`` is set (default off), every Nth
-batch as a keyframe so late-joining consumers can sync.
+(B, K) int32, ``name__tileshape`` — the 5-element rectangular form
+[H, W, C, th, tw] (tiles are th x tw x C blocks, row-major over the
+ceil(H/th) x ceil(W/tw) grid; see ``geom_tile``; consumers also accept
+the legacy square v1 form [H, W, C, t] = th == tw == t) — and the tile
+payload: ``name__tiles`` (B, K, th, tw, C) uint8 raw, or the
+palette-compressed ``name__tilepal2``/``4``/``8`` + ``name__palette``
+when the batch's colors fit 2/4/8-bit indices. The reference image
+travels as ``name__tileref`` (H, W, C) in the producer's first message —
+and, when ``TileBatchPublisher(ref_interval=N)`` is set (default off),
+every Nth batch as a keyframe so late-joining consumers can sync.
 
 The changed-tile scan runs in C++ when the native helper builds
 (``blendjax/_native/tiledelta.cpp``); the numpy fallback is identical.

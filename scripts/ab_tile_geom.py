@@ -48,13 +48,13 @@ def main() -> int:
     results: dict = {g: [] for g in arms}
     for rep in range(args.reps):
         for geom in arms:
-            bench._TILE_ARGS = geom.split("x")
-            bench.TILE_CAPACITY = bench.tile_capacity_default(
-                bench._TILE_ARGS
-            )
+            tile_args = geom.split("x")
+            th, tw = int(tile_args[0]), int(tile_args[-1])
             r = bench.measure(
                 bench.ENCODING, bench.CHUNK, args.items,
                 bench.TIME_CAP_S, with_stages=False,
+                tile_args=tile_args,
+                tile_capacity=bench.tile_capacity_default(th, tw),
             )
             results[geom].append(round(r["value"], 1))
             print(f"pass {rep} tile={geom}: {r['value']:.1f} img/s "

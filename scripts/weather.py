@@ -57,19 +57,21 @@ def main() -> int:
     # in BENCH_r03; collapsed windows sit at ~5-15 MB/s). Same probe
     # the bench stamps into its record as link_h2d_MB_s.
     sys.path.insert(0, REPO_ROOT)
-    from bench import probe_link_bandwidth
+    from bench import FIT_H2D_MBS, probe_link_bandwidth
 
     mbs = probe_link_bandwidth(rtt)
     if mbs is None:
         print("h2d bandwidth: probe failed")
         return 5
-    # 35 MB/s bar: good windows measure ~43; a 27-29 MB/s window passed
-    # a 25 bar once and still ran end-to-end passes at ~22 img/s (the
-    # tunnel flapped right after the probe), so the bar sits close to
-    # the good-weather figure. --pass remains the definitive check.
+    # FIT_H2D_MBS bar (bench.py owns it — the bench's in-record per-pass
+    # gate and this preflight must agree): good windows measure ~43; a
+    # 27-29 MB/s window passed a 25 bar once and still ran end-to-end
+    # passes at ~22 img/s (the tunnel flapped right after the probe), so
+    # the bar sits close to the good-weather figure. --pass remains the
+    # definitive check.
     print(f"h2d bandwidth: {mbs:.0f} MB/s "
-          f"({'ok' if mbs >= 35 else 'BANDWIDTH-COLLAPSED'})")
-    if mbs < 35:
+          f"({'ok' if mbs >= FIT_H2D_MBS else 'BANDWIDTH-COLLAPSED'})")
+    if mbs < FIT_H2D_MBS:
         return 3
     if "--pass" not in sys.argv:
         return 0
@@ -78,7 +80,11 @@ def main() -> int:
 
     # Same config + floor the bench itself gates retries on, so the
     # preflight verdict can't drift from the run it predicts.
-    floor = float(os.environ.get("BLENDJAX_BENCH_RETRY_FLOOR", "150"))
+    floor = float(
+        os.environ.get(
+            "BLENDJAX_BENCH_RETRY_FLOOR", bench.RETRY_FLOOR_DEFAULT
+        )
+    )
     r = bench.measure(bench.ENCODING, bench.CHUNK, 512, 45.0,
                       with_stages=False)
     good = r["value"] > floor

@@ -30,6 +30,61 @@ def test_model_flops_matches_analytic_count():
     assert 0.7 * analytic < got < 1.3 * analytic, (got, analytic)
 
 
+def test_ceiling_ratio_row_publication_rules():
+    """utilization_vs_ceiling publishes a number ONLY when headline and
+    ceiling share fit windows and the ratio is sane — the r4 record
+    published 1.577 from a cross-window comparison (VERDICT r4 #1)."""
+    import bench
+
+    fitc = {"img_s": 600.0, "fit_window": True}
+    assert bench.ceiling_ratio_row(570.0, fitc, True) == 0.95
+    # live "beating" the ceiling beyond noise: windows weren't
+    # equivalent after all — invalid, uncomparable number preserved
+    r = bench.ceiling_ratio_row(700.0, fitc, True)
+    assert r["invalid"] == "window_mismatch"
+    assert r["uncomparable_ratio"] == 1.167
+    # unfit headline / unfit ceiling / capped ceiling -> weather-invalid
+    assert (
+        bench.ceiling_ratio_row(570.0, fitc, False)["invalid"] == "weather"
+    )
+    assert bench.ceiling_ratio_row(
+        570.0, {"img_s": 600.0, "fit_window": False}, True
+    )["invalid"] == "weather"
+    assert bench.ceiling_ratio_row(
+        570.0, {"img_s": 600.0, "fit_window": True, "capped": True}, True
+    )["invalid"] == "weather"
+    assert bench.ceiling_ratio_row(570.0, {}, True)["invalid"] == (
+        "ceiling_failed"
+    )
+
+
+def test_tile_capacity_default_derives_from_dims():
+    """Measured geometries keep their measured fits; any other geometry
+    gets an area-scaled estimate that covers the known changed-pixel
+    budget (ADVICE r4: a 32x32 override silently got the 16x16 fit)."""
+    import bench
+
+    assert bench.tile_capacity_default(16, 16) == "288"
+    assert bench.tile_capacity_default(16, 32) == "160"
+    cap = int(bench.tile_capacity_default(32, 32))
+    grid = 15 * 20  # 480/32 x 640/32
+    assert 32 <= cap <= grid and cap % 32 == 0
+    assert cap * 32 * 32 >= 282 * 256  # covers the measured budget
+    # tiny grids (huge tiles) clamp to the grid, not up to 32
+    assert int(bench.tile_capacity_default(240, 320)) == 4
+
+
+def test_weather_probe_reports_window():
+    """The per-pass weather stamp must always carry a fit verdict and,
+    absent device errors, the RTT it judged from."""
+    import bench
+
+    w = bench.weather_probe()
+    assert isinstance(w.get("fit"), bool)
+    if "error" not in w:
+        assert "rtt_s" in w
+
+
 def test_pipelined_ceiling_caps_and_flags(monkeypatch):
     """A ceiling run that exceeds its time cap must return what it
     measured, flagged 'capped' (a silently depressed ceiling would
