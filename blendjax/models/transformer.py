@@ -44,8 +44,6 @@ class MultiHeadAttention(nn.Module):
             name="qkv",
         )(x)
         q, k, v = (qkv[:, :, i] for i in range(3))  # (B, T, H, D)
-        # softmax math in f32 for stability
-        q, k, v = (a.astype(jnp.float32) for a in (q, k, v))
         assert self.sp_mode in ("ring", "ulysses"), (
             f"unknown sp_mode {self.sp_mode!r}; use 'ring' or 'ulysses'"
         )
@@ -53,6 +51,12 @@ class MultiHeadAttention(nn.Module):
         # requesting the non-default strategy also enables it.
         use_sp = self.use_ring or self.sp_mode == "ulysses"
         if use_sp:
+            # The SP kernels carry the streaming-softmax state (running
+            # max/sum) in the input dtype — keep those f32. The local
+            # path does its softmax in f32 internally, so its matmul
+            # inputs stay bf16 on the MXU (f32 matmuls run ~4x slower
+            # on v5e and halved the bench transformer row's MFU).
+            q, k, v = (a.astype(jnp.float32) for a in (q, k, v))
             assert self.mesh is not None, "sequence parallelism needs a mesh"
             sp_attn = (
                 ulysses_attention if self.sp_mode == "ulysses"

@@ -110,16 +110,28 @@ def ring_attention(
 
 
 def reference_attention(q, k, v, causal: bool = False, scale=None):
-    """Single-device exact attention for testing/fallback (B,T,H,D)."""
+    """Single-device exact attention for testing/fallback (B,T,H,D).
+
+    Mixed precision: both matmuls run in the INPUT dtype (bf16 inputs
+    keep the MXU at full rate — f32 matmuls cost ~4x on v5e and held
+    the bench transformer row at half its MFU) while scores accumulate
+    and the softmax computes in f32, which is where the numerical risk
+    actually lives. f32 inputs behave exactly as before.
+    """
     import jax.numpy as jnp
 
     d = q.shape[-1]
     scale = scale if scale is not None else d**-0.5
-    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    s = jnp.einsum(
+        "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
+    ) * scale
     if causal:
         t_q, t_k = q.shape[1], k.shape[1]
         mask = jnp.arange(t_q)[:, None] >= jnp.arange(t_k)[None, :]
         s = jnp.where(mask[None, None], s, NEG_INF)
     p = jnp.exp(s - s.max(axis=-1, keepdims=True))
     p = p / p.sum(axis=-1, keepdims=True)
-    return jnp.einsum("bhqk,bkhd->bqhd", p, v)
+    return jnp.einsum(
+        "bhqk,bkhd->bqhd", p.astype(v.dtype), v,
+        preferred_element_type=jnp.float32,
+    ).astype(v.dtype)
