@@ -51,12 +51,14 @@ class MultiHeadAttention(nn.Module):
         # requesting the non-default strategy also enables it.
         use_sp = self.use_ring or self.sp_mode == "ulysses"
         if use_sp:
-            # The SP kernels carry the streaming-softmax state (running
-            # max/sum) in the input dtype — keep those f32. The local
-            # path does its softmax in f32 internally, so its matmul
-            # inputs stay bf16 on the MXU (f32 matmuls run ~4x slower
-            # on v5e and halved the bench transformer row's MFU).
-            q, k, v = (a.astype(jnp.float32) for a in (q, k, v))
+            # Precision is the kernels' concern: reference_attention
+            # (the local path AND ulysses' per-device body) does f32
+            # score accumulation + f32 softmax internally with matmul
+            # inputs left in the compute dtype (bf16 on the MXU — f32
+            # matmuls run ~4x slower on v5e and halved the bench
+            # transformer row's MFU); ring_attention upcasts internally
+            # only when it actually rings, because its streaming
+            # softmax carries running max/sum in the input dtype.
             assert self.mesh is not None, "sequence parallelism needs a mesh"
             sp_attn = (
                 ulysses_attention if self.sp_mode == "ulysses"

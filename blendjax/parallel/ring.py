@@ -94,8 +94,17 @@ def ring_attention(
     scale = scale if scale is not None else d**-0.5
     if axis not in mesh.axis_names:
         # No sequence axis on this mesh: nothing to ring over — run plain
-        # exact attention (same math, zero collectives).
+        # exact attention (same math, zero collectives; it keeps bf16
+        # inputs on the MXU and does its softmax in f32 internally).
         return reference_attention(q, k, v, causal=causal, scale=scale)
+    # The streaming softmax carries its running max/sum (and the output
+    # accumulator) in the input dtype — bf16 carries would erode the
+    # exactness contract, so the RING path upcasts. This sits after the
+    # fallback check so the degraded path keeps bf16 MXU matmuls.
+    import jax.numpy as jnp
+
+    out_dtype = q.dtype
+    q, k, v = (a.astype(jnp.float32) for a in (q, k, v))
     b_ax = batch_axis if (batch_axis and batch_axis in mesh.axis_names) else None
     spec = P(b_ax, axis)
     vary_axes = tuple(a for a in (b_ax, axis) if a in mesh.axis_names)
@@ -106,7 +115,7 @@ def ring_attention(
     f = jax.shard_map(
         body, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec
     )
-    return f(q, k, v)
+    return f(q, k, v).astype(out_dtype)
 
 
 def reference_attention(q, k, v, causal: bool = False, scale=None):

@@ -27,7 +27,7 @@ import runpy
 import stat
 import sys
 
-VERSION = "4.2.0"
+VERSION = "3.6.5"
 
 
 def main(argv=None) -> int:

@@ -3,8 +3,12 @@
 Implements the exact API surface used by ``blendjax.producer.bpy_engine``,
 ``blendjax.producer.offscreen``, and the ``tests/blender/*.blend.py``
 fixtures (which mirror the reference's fixtures,
-``/root/reference/tests/blender/``). Semantics are modeled on Blender
-3.x/4.x behavior for that surface:
+``/root/reference/tests/blender/``). Semantics are pinned to the
+**Blender 3.6 LTS** API — the version the opt-in real-Blender tier
+installs (``scripts/install_blender.sh``) and the ground truth this
+stub is certified against; the member-by-member conformance table
+(documented behavior -> fake behavior -> known deviation) lives in
+``docs/architecture.md`` "Fake-bpy conformance". Highlights:
 
 - objects carry LOCAL mesh data; world placement lives in
   ``matrix_world`` composed from ``location`` + XYZ ``rotation_euler``,
@@ -775,7 +779,7 @@ def _build_bpy(background: bool, default_scene: bool) -> types.ModuleType:
     bpy.__doc__ = "blendjax fake bpy (see blendjax.testing.fake_bpy)"
 
     app = types.SimpleNamespace(
-        version=(4, 2, 0),
+        version=(3, 6, 5),
         background=background,
         handlers=types.SimpleNamespace(
             frame_change_pre=[], frame_change_post=[]

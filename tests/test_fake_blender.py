@@ -40,7 +40,7 @@ def test_finder_discovers_fake_blender(fake_dir):
     info = discover_blender(additional_blender_paths=[fake_dir])
     assert info is not None
     assert info["path"] == os.path.join(fake_dir, "blender")
-    assert (info["major"], info["minor"]) == (4, 2)
+    assert (info["major"], info["minor"]) == (3, 6)
     # this interpreter has zmq + msgpack -> tensor codec detected
     assert info["codec"] == "tensor"
 

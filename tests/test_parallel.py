@@ -92,6 +92,53 @@ def test_ring_attention_matches_reference(causal):
     assert out.sharding.spec == P(None, "seq")
 
 
+def test_reference_attention_bf16_inputs_keep_f32_accumulation():
+    """bf16 inputs must accumulate scores and softmax in f32
+    (``preferred_element_type``): dropping that roughly doubles the
+    error (calibrated at T=512: f32-accum 3.2e-3 vs bf16-accum 6.9e-3
+    on CPU; 1.5e-3 vs 5.7e-3 on the v5e) while every other test — all
+    f32 inputs — would keep passing. The 4.5e-3 bar sits between the
+    regimes on both backends."""
+    k = jax.random.key(0)
+    b, t, h, d = 2, 512, 4, 64
+    q = jax.random.normal(k, (b, t, h, d), jnp.float32)
+    kk = jax.random.normal(jax.random.key(1), (b, t, h, d), jnp.float32)
+    v = jax.random.normal(jax.random.key(2), (b, t, h, d), jnp.float32)
+    ref = np.asarray(reference_attention(q, kk, v))
+    got = np.asarray(
+        reference_attention(
+            q.astype(jnp.bfloat16), kk.astype(jnp.bfloat16),
+            v.astype(jnp.bfloat16),
+        ).astype(jnp.float32)
+    )
+    assert got.dtype == np.float32 and ref.shape == got.shape
+    assert np.max(np.abs(ref - got)) < 4.5e-3
+
+
+def test_ring_attention_bf16_inputs_ring_exactly():
+    """The ring path upcasts internally (streaming-softmax carries ride
+    the input dtype) and returns the input dtype — bf16 in, bf16 out,
+    matching the bf16 reference within bf16 resolution."""
+    mesh = create_mesh({"seq": 4}, devices=jax.devices()[:4])
+    rng = np.random.default_rng(5)
+    b, t, h, d = 2, 64, 2, 8
+    q, k, v = (
+        jnp.asarray(rng.normal(size=(b, t, h, d)).astype(np.float32))
+        for _ in range(3)
+    )
+    qb, kb, vb = (x.astype(jnp.bfloat16) for x in (q, k, v))
+    spec = NamedSharding(mesh, P(None, "seq"))
+    out = ring_attention(
+        *(jax.device_put(x, spec) for x in (qb, kb, vb)),
+        mesh, axis="seq", batch_axis=None,
+    )
+    assert out.dtype == jnp.bfloat16
+    ref = reference_attention(q, k, v)
+    np.testing.assert_allclose(
+        np.asarray(out.astype(jnp.float32)), np.asarray(ref), atol=2e-2
+    )
+
+
 def test_ring_attention_with_data_and_seq_axes():
     mesh = create_mesh({"data": 2, "seq": 4})
     rng = np.random.default_rng(1)
