@@ -780,6 +780,74 @@ def _record(value: float, detail: dict) -> dict:
     }
 
 
+_SKIPPED_PROBE = {"fit": False, "skipped": "outage"}
+
+
+def collect_passes(run_measure, probe, *, n_passes, retry_floor,
+                   wait_budget, poll_sleep, degraded, w0, on_pass=None,
+                   clock=time.perf_counter, sleep=time.sleep) -> list:
+    """Window-gated pass collection — the control flow that decides what
+    lands in the authoritative record, factored out so it is unit-tested
+    without a device (the r4 record was lost to exactly this logic being
+    untestable).
+
+    Polls ``probe()`` (a :func:`weather_probe`-style dict) for a fit
+    window and runs ``run_measure()`` passes inside fit windows only,
+    until ``n_passes`` fit passes exist with a best >= ``retry_floor`` —
+    all bounded by ``wait_budget`` seconds and a hard 20-pass cap.
+    Escapes early after 3 consecutive probes with no bandwidth figure
+    (device errors / outage-band RTTs can never turn fit by waiting).
+    If no pass ran inside the budget, measures anyway (weather-labeled;
+    the record must carry data). Each returned pass carries
+    ``weather.pre``/``weather.post`` and ``fit_window`` (both probes
+    fit — the window must HOLD across the pass; the tunnel has flapped
+    between a passing probe and the first pass). In ``degraded`` mode
+    probes are skipped wholesale (each costs multi-second RTTs);
+    ``w0`` — the run-start probe — stamps the first fallback pass.
+    """
+    passes: list = []
+    if degraded:
+        wait_budget = 0.0  # the docstring's promise: no probes at all
+    t0 = clock()
+
+    def fit_passes():
+        return [p for p in passes if p.get("fit_window")]
+
+    def run_pass(pre):
+        q = run_measure()
+        post = _SKIPPED_PROBE if degraded else probe()
+        q["weather"] = {"pre": pre, "post": post}
+        q["fit_window"] = bool(pre.get("fit") and post.get("fit"))
+        passes.append(q)
+        if on_pass is not None:
+            on_pass(passes)
+        return q
+
+    blind_streak = 0
+    while clock() - t0 < wait_budget and len(passes) < 20:
+        fit = fit_passes()
+        if fit and len(fit) >= n_passes and max(
+            p["value"] for p in fit
+        ) >= retry_floor:
+            break
+        pre = probe()
+        blind_streak = 0 if "h2d_MB_s" in pre else blind_streak + 1
+        if blind_streak >= 3:
+            break
+        if pre.get("fit"):
+            run_pass(pre)
+        else:
+            sleep(poll_sleep)
+    if not passes:
+        for i in range(n_passes):
+            if degraded:
+                # w0 already told the story; don't pay more outage RTTs
+                run_pass(w0 if i == 0 else _SKIPPED_PROBE)
+            else:
+                run_pass(probe())
+    return passes
+
+
 def _build_record(progress: dict) -> dict:
     """The whole measurement workload; ``progress`` is shared with the
     watchdog in :func:`main` so a hard device stall can still emit
@@ -838,63 +906,23 @@ def _build_record(progress: dict) -> dict:
         n_passes = min(n_passes, 2)
         items = min(items, 256)
         wait_budget = 0.0
-    passes = []
-    t_meas0 = time.perf_counter()
-    _SKIPPED_PROBE = {"fit": False, "skipped": "outage"}
 
-    def fit_passes():
-        return [p for p in passes if p.get("fit_window")]
-
-    def run_pass(pre):
-        q = measure(ENCODING, CHUNK, items, TIME_CAP_S)
-        post = _SKIPPED_PROBE if degraded else weather_probe()
-        q["weather"] = {"pre": pre, "post": post}
-        # fit only when the window HELD: the tunnel has flapped between
-        # a passing probe and the first pass before (PARITY lever 1).
-        q["fit_window"] = bool(pre.get("fit") and post.get("fit"))
-        passes.append(q)
+    def on_pass(passes):
         progress["passes"] = [
             {"value": p["value"], "seconds": p["seconds"],
              "fit_window": p.get("fit_window", False)}
             for p in passes
         ]
-        return q
 
-    # Structurally-unfit streak: a probe with no bandwidth figure
-    # (device error, or RTT in the 0.5-1.0 s band where the bandwidth
-    # leg is skipped) can never turn fit by waiting — after a few in a
-    # row, stop polling and measure what exists instead of sleeping the
-    # watchdog budget away. Collapsed windows DO return a bandwidth
-    # figure, so the poll keeps waiting those out as intended.
-    blind_streak = 0
-    while (
-        time.perf_counter() - t_meas0 < wait_budget and len(passes) < 20
-    ):
-        fit = fit_passes()
-        if fit and len(fit) >= n_passes and max(
-            p["value"] for p in fit
-        ) >= retry_floor:
-            break
-        pre = weather_probe()
-        blind_streak = 0 if "h2d_MB_s" in pre else blind_streak + 1
-        if blind_streak >= 3:
-            break
-        if pre.get("fit"):
-            run_pass(pre)
-        else:
-            time.sleep(poll_sleep)
-    # Fallback: no fit window appeared inside the whole budget. The
-    # record must still carry measurements (weather-labeled), not be
-    # empty — run the passes in whatever window exists.
-    if not passes:
-        for i in range(n_passes):
-            if degraded:
-                # w0 already told the story; don't pay more outage RTTs
-                run_pass(w0 if i == 0 else _SKIPPED_PROBE)
-            else:
-                run_pass(weather_probe())
+    passes = collect_passes(
+        lambda: measure(ENCODING, CHUNK, items, TIME_CAP_S),
+        weather_probe,
+        n_passes=n_passes, retry_floor=retry_floor,
+        wait_budget=wait_budget, poll_sleep=poll_sleep,
+        degraded=degraded, w0=w0, on_pass=on_pass,
+    )
 
-    fit = fit_passes()
+    fit = [p for p in passes if p.get("fit_window")]
     primary = max(fit or passes, key=lambda r: r["value"])
     headline_fit = bool(primary.get("fit_window"))
     detail = dict(primary)

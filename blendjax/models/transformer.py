@@ -19,8 +19,9 @@ from __future__ import annotations
 import flax.linen as nn
 import jax.numpy as jnp
 
+from blendjax.ops.attention import local_attention
 from blendjax.ops.image import maybe_normalize_uint8
-from blendjax.parallel.ring import reference_attention, ring_attention
+from blendjax.parallel.ring import ring_attention
 from blendjax.parallel.ulysses import ulysses_attention
 
 
@@ -33,6 +34,7 @@ class MultiHeadAttention(nn.Module):
     batch_axis: str = "data"
     causal: bool = False
     sp_mode: str = "ring"  # 'ring' | 'ulysses' (when use_ring=True)
+    attn_backend: str = "auto"  # local path: 'auto' | 'flash' | 'xla'
 
     @nn.compact
     def __call__(self, x):
@@ -69,7 +71,8 @@ class MultiHeadAttention(nn.Module):
                 causal=self.causal, batch_axis=self.batch_axis,
             )
         else:
-            o = reference_attention(q, k, v, causal=self.causal)
+            o = local_attention(q, k, v, causal=self.causal,
+                                backend=self.attn_backend)
         o = o.astype(self.dtype).reshape(b, t, c)
         return nn.Dense(c, dtype=self.dtype, param_dtype=jnp.float32,
                         name="proj")(o)
@@ -86,6 +89,7 @@ class Block(nn.Module):
     causal: bool = False
     num_experts: int = 0  # >0: Switch-style MoE MLP (expert parallelism)
     sp_mode: str = "ring"
+    attn_backend: str = "auto"
 
     @nn.compact
     def __call__(self, x):
@@ -95,7 +99,7 @@ class Block(nn.Module):
             self.num_heads, dtype=self.dtype, use_ring=self.use_ring,
             mesh=self.mesh, seq_axis=self.seq_axis,
             batch_axis=self.batch_axis, causal=self.causal,
-            sp_mode=self.sp_mode,
+            sp_mode=self.sp_mode, attn_backend=self.attn_backend,
         )(y)
         y = nn.LayerNorm(dtype=jnp.float32)(x)
         if self.num_experts > 0:
@@ -138,6 +142,9 @@ class StreamFormer(nn.Module):
     num_experts: int = 0
     moe_every: int = 2  # MoE MLP in every nth block (others stay dense)
     sp_mode: str = "ring"  # sequence-parallel strategy: 'ring' | 'ulysses'
+    attn_backend: str = "auto"  # local attention: Pallas flash kernel for
+    # long sequences on TPU, materialized-scores XLA path otherwise
+    # (measured crossover ~1k tokens; blendjax.ops.attention)
     remat: bool = False  # rematerialize blocks: ~O(sqrt) activation
     # memory in backprop for long sequences/deep stacks, recompute on the
     # backward pass (jax.checkpoint via nn.remat — HBM for FLOPs)
@@ -172,7 +179,8 @@ class StreamFormer(nn.Module):
                 self.num_heads, dtype=self.dtype, use_ring=self.use_ring,
                 mesh=self.mesh, seq_axis=self.seq_axis,
                 batch_axis=self.batch_axis, num_experts=moe,
-                sp_mode=self.sp_mode, name=f"block{i}",
+                sp_mode=self.sp_mode, attn_backend=self.attn_backend,
+                name=f"block{i}",
             )(x)
         x = nn.LayerNorm(dtype=jnp.float32)(x)
         x = x.mean(axis=1)

@@ -85,6 +85,155 @@ def test_weather_probe_reports_window():
         assert "rtt_s" in w
 
 
+class _Clock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+    def sleep(self, s):
+        self.t += s
+
+
+def _probe_seq(probes, clock, probe_cost=1.0):
+    """Iterator-backed fake probe; repeats the last element forever and
+    advances the fake clock per call (probes aren't free)."""
+    it = iter(probes)
+    last = probes[-1]
+
+    def probe():
+        nonlocal last
+        clock.t += probe_cost
+        last = next(it, last)
+        return dict(last)
+
+    return probe
+
+
+FIT = {"fit": True, "rtt_s": 0.1, "h2d_MB_s": 43.0}
+COLLAPSED = {"fit": False, "rtt_s": 0.1, "h2d_MB_s": 12.0}
+BLIND = {"fit": False, "error": "boom"}
+
+
+def _measure_seq(values, clock, cost=5.0):
+    it = iter(values)
+    last = values[-1]
+
+    def run():
+        nonlocal last
+        clock.t += cost
+        last = next(it, last)
+        return {"value": last, "seconds": cost}
+
+    return run
+
+
+def test_collect_passes_stops_at_n_fit_passes_over_floor():
+    import bench
+
+    clock = _Clock()
+    passes = bench.collect_passes(
+        _measure_seq([500.0, 520.0], clock),
+        _probe_seq([FIT], clock),
+        n_passes=2, retry_floor=400.0, wait_budget=480.0, poll_sleep=12.0,
+        degraded=False, w0=FIT, clock=clock, sleep=clock.sleep,
+    )
+    assert [p["value"] for p in passes] == [500.0, 520.0]
+    assert all(p["fit_window"] for p in passes)
+    # stopped as soon as the goal was met — no budget-burning extras
+    assert clock.t < 60
+
+
+def test_collect_passes_keeps_rolling_below_floor():
+    """Fit-probe windows whose passes run slow (the 38 MB/s + stalled
+    dispatch mode) must not satisfy the bench — it keeps rolling until
+    the budget or the 20-pass cap."""
+    import bench
+
+    clock = _Clock()
+    passes = bench.collect_passes(
+        _measure_seq([60.0], clock),
+        _probe_seq([FIT], clock),
+        n_passes=2, retry_floor=400.0, wait_budget=200.0, poll_sleep=12.0,
+        degraded=False, w0=FIT, clock=clock, sleep=clock.sleep,
+    )
+    assert len(passes) >= 3  # kept retrying
+    assert clock.t >= 200.0 or len(passes) == 20
+
+
+def test_collect_passes_fallback_when_never_fit():
+    """No fit window in the whole budget -> measure anyway, labeled."""
+    import bench
+
+    clock = _Clock()
+    passes = bench.collect_passes(
+        _measure_seq([20.0], clock),
+        _probe_seq([COLLAPSED], clock),
+        n_passes=3, retry_floor=400.0, wait_budget=60.0, poll_sleep=12.0,
+        degraded=False, w0=COLLAPSED, clock=clock, sleep=clock.sleep,
+    )
+    assert len(passes) == 3
+    assert not any(p["fit_window"] for p in passes)
+
+
+def test_collect_passes_blind_probe_escape():
+    """Probes with no bandwidth figure can never turn fit — escape to
+    the fallback after 3 instead of sleeping the budget away."""
+    import bench
+
+    clock = _Clock()
+    passes = bench.collect_passes(
+        _measure_seq([20.0], clock),
+        _probe_seq([BLIND], clock),
+        n_passes=2, retry_floor=400.0, wait_budget=480.0, poll_sleep=12.0,
+        degraded=False, w0=BLIND, clock=clock, sleep=clock.sleep,
+    )
+    assert len(passes) == 2
+    # 3 blind polls (2 sleeps between) + fallback probes; far under budget
+    assert clock.t < 100
+
+
+def test_collect_passes_degraded_skips_probes():
+    """Outage mode: zero probe calls (each costs multi-second RTTs);
+    w0 stamps the first pass, the skip marker the rest."""
+    import bench
+
+    clock = _Clock()
+    calls = {"probes": 0}
+
+    def probe():
+        calls["probes"] += 1
+        return dict(BLIND)
+
+    w0 = {"fit": False, "rtt_s": 24.0}
+    passes = bench.collect_passes(
+        _measure_seq([5.0], clock), probe,
+        n_passes=2, retry_floor=400.0, wait_budget=0.0, poll_sleep=12.0,
+        degraded=True, w0=w0, clock=clock, sleep=clock.sleep,
+    )
+    assert calls["probes"] == 0
+    assert len(passes) == 2
+    assert passes[0]["weather"]["pre"] == w0
+    assert passes[1]["weather"]["pre"].get("skipped") == "outage"
+
+
+def test_collect_passes_flap_mid_pass_is_not_fit():
+    """pre fit, post collapsed -> the window didn't hold; the pass is
+    recorded but not fit (the r4 lesson: pre-only gating was defeated
+    by mid-run flaps)."""
+    import bench
+
+    clock = _Clock()
+    passes = bench.collect_passes(
+        _measure_seq([300.0], clock),
+        _probe_seq([FIT, COLLAPSED], clock),  # pre fit, post collapsed
+        n_passes=1, retry_floor=150.0, wait_budget=30.0, poll_sleep=12.0,
+        degraded=False, w0=FIT, clock=clock, sleep=clock.sleep,
+    )
+    assert passes[0]["fit_window"] is False
+
+
 def test_pipelined_ceiling_caps_and_flags(monkeypatch):
     """A ceiling run that exceeds its time cap must return what it
     measured, flagged 'capped' (a silently depressed ceiling would
