@@ -44,6 +44,11 @@ def flash_supported(q, k=None) -> bool:
         return False
     if not (q.ndim == 4 and q.shape[1] % FLASH_BLOCK == 0):
         return False
+    d = q.shape[-1]
+    if d > 128 and d % 128:
+        # the kernel pads head_dim UP to 128 but requires multiples of
+        # 128 above it (its own NotImplementedError otherwise)
+        return False
     return k is None or (
         k.ndim == 4 and k.shape[1] % FLASH_BLOCK == 0
     )

@@ -10,6 +10,17 @@ the slope, so the ~0.1s sync constant divides out.
 
 Run: ``python scripts/diagnose_decode.py [--reps 8]``. Prints one line
 per stage. Feeds the r4->r5 lever ranking in PARITY.md.
+
+``--one-dispatch`` re-times every device stage with ALL reps inside one
+jitted ``fori_loop`` (``bjx_timing.timed_one_dispatch``): a single host
+dispatch, so the figures are pure device compute and remain honest in
+the tunnel's stall modes, where the default per-rep dispatching
+measures the stall, not the op (observed: the same chain ranked 1.85x
+FASTER in a fit window and ~2x SLOWER in a collapsed one under per-rep
+dispatch). The loop perturbs each stage's input with the carried
+output bit, so XLA cannot hoist the loop-invariant stage; the xor pass
+over the input is the method's (small) overhead. The host->device
+transfer row is inherently per-dispatch and is skipped in this mode.
 """
 
 from __future__ import annotations
@@ -18,7 +29,7 @@ import argparse
 
 import numpy as np
 
-from bjx_timing import sync, timed
+from bjx_timing import sync, timed, timed_one_dispatch
 
 
 def main() -> None:
@@ -26,6 +37,9 @@ def main() -> None:
     ap.add_argument("--reps", type=int, default=8)
     ap.add_argument("--batch", type=int, default=128,
                     help="frames per chunk group (chunk*B)")
+    ap.add_argument("--one-dispatch", action="store_true",
+                    help="reps inside one fori_loop: pure device "
+                    "compute, honest in tunnel stall modes")
     args = ap.parse_args()
     if args.batch % 8:
         ap.error("--batch must be a multiple of 8 (the step's B)")
@@ -108,38 +122,118 @@ def main() -> None:
         )
     )
 
-    results = {
-        "transfer (pal2-sized buffer)": timed(
-            jax.device_put, (host_buf,), args.reps, sync
-        ),
-        "palette expand (pal2)": timed(
-            expand, (packed2, pal_d), args.reps, sync
-        ),
-        "base init (ref broadcast+concat)": timed(
-            base_init, (ref_tiles,), args.reps, sync
-        ),
-        "scatter+transpose (raw tiles)": timed(
-            scatter, (idx_d, raw_tiles, ref_tiles), args.reps, sync
-        ),
-        "full decode (expand+scatter)": timed(
-            full_decode, (packed2, pal_d, idx_d, ref_tiles),
-            args.reps, sync,
-        ),
-        "full decode (expand+spatial 16x32)": timed(
-            full_decode_r, (packed2_r, pal_d, idx_r, ref_tiles_r),
-            args.reps, sync,
-        ),
-    }
+    if args.one_dispatch:
+        def xor8(buf, c):
+            return buf ^ c.astype(jnp.uint8)
 
-    cell = {"state": state}  # the step donates its state buffers
+        def dec(geom, packed, idx, ref_t, c):
+            """The ONE definition of the pal2 expand+decode chain; both
+            geometries and both (standalone / step-fed) timings use it."""
+            return T.decode_tile_delta(
+                ref_t, idx,
+                T.expand_palette_tiles(xor8(packed, c), pal_d, 2, geom,
+                                       C),
+                (H, W, C),
+            )
 
-    def run_step(fr, xy_):
-        cell["state"], m = step(cell["state"], {"image": fr, "xy": xy_})
-        return m["loss"]
+        results = {
+            "palette expand (pal2)": timed_one_dispatch(
+                lambda c: T.expand_palette_tiles(
+                    xor8(packed2, c), pal_d, 2, t, C
+                ), args.reps,
+            ),
+            "base init (ref broadcast+concat)": timed_one_dispatch(
+                lambda c: base_init(xor8(ref_tiles, c)), args.reps,
+            ),
+            "scatter+transpose (raw tiles)": timed_one_dispatch(
+                lambda c: T.decode_tile_delta(
+                    ref_tiles, idx_d, xor8(raw_tiles, c), (H, W, C)
+                ), args.reps,
+            ),
+            "full decode (expand+scatter)": timed_one_dispatch(
+                lambda c: dec(t, packed2, idx_d, ref_tiles, c),
+                args.reps,
+            ),
+            "full decode (expand+spatial 16x32)": timed_one_dispatch(
+                lambda c: dec(ttr, packed2_r, idx_r, ref_tiles_r, c),
+                args.reps,
+            ),
+        }
 
-    results["train step (chunked)"] = timed(
-        run_step, (frames, xy), args.reps, sync
-    )
+        # No donation for the loop-wrapped step: every iteration reuses
+        # the same captured state, so its buffers must survive.
+        step_nodonate = make_chunked_supervised_step(donate=False)
+
+        def step_stage(c):
+            _, m = step_nodonate(
+                state, {"image": xor8(frames, c), "xy": xy}
+            )
+            return m["loss"]
+
+        step_reps = max(2, args.reps // 4)
+        step_dt = timed_one_dispatch(step_stage, step_reps)
+        results["train step (chunked)"] = step_dt
+
+        # Decode feeding its REAL consumer: the sum-carry rows above
+        # under-measure XLA stages whose tails the reducer can
+        # algebraically skip (sum(transpose(x)) drops the transpose;
+        # sum(broadcast(x)) folds to a scalar multiply — the 0.0 ms
+        # base-init row). The train step consumes every decoded pixel
+        # through convs, so decode+step MINUS the step row is the
+        # honest marginal device cost of each variant (slightly
+        # optimistic vs production's separate jits: here XLA may fuse
+        # across the decode/step boundary).
+        def dstep(geom, packed, idx, ref_t):
+            def stage(c):
+                fr = dec(geom, packed, idx, ref_t, c).reshape(
+                    B // 8, 8, H, W, C
+                )
+                _, m = step_nodonate(state, {"image": fr, "xy": xy})
+                return m["loss"]
+
+            return timed_one_dispatch(stage, step_reps)
+
+        results["decode 16x16 marginal (via step consumer)"] = max(
+            dstep(t, packed2, idx_d, ref_tiles) - step_dt, 1e-9
+        )
+        results["decode 16x32 marginal (via step consumer)"] = max(
+            dstep(ttr, packed2_r, idx_r, ref_tiles_r) - step_dt, 1e-9
+        )
+    else:
+        results = {
+            "transfer (pal2-sized buffer)": timed(
+                jax.device_put, (host_buf,), args.reps, sync
+            ),
+            "palette expand (pal2)": timed(
+                expand, (packed2, pal_d), args.reps, sync
+            ),
+            "base init (ref broadcast+concat)": timed(
+                base_init, (ref_tiles,), args.reps, sync
+            ),
+            "scatter+transpose (raw tiles)": timed(
+                scatter, (idx_d, raw_tiles, ref_tiles), args.reps, sync
+            ),
+            "full decode (expand+scatter)": timed(
+                full_decode, (packed2, pal_d, idx_d, ref_tiles),
+                args.reps, sync,
+            ),
+            "full decode (expand+spatial 16x32)": timed(
+                full_decode_r, (packed2_r, pal_d, idx_r, ref_tiles_r),
+                args.reps, sync,
+            ),
+        }
+
+        cell = {"state": state}  # the step donates its state buffers
+
+        def run_step(fr, xy_):
+            cell["state"], m = step(
+                cell["state"], {"image": fr, "xy": xy_}
+            )
+            return m["loss"]
+
+        results["train step (chunked)"] = timed(
+            run_step, (frames, xy), args.reps, sync
+        )
 
     for name, dt in results.items():
         print(f"{name}: {dt * 1000:8.1f} ms/group  "
