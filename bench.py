@@ -848,6 +848,43 @@ def collect_passes(run_measure, probe, *, n_passes, retry_floor,
     return passes
 
 
+def run_gated_row(fn, probe, *, headline_fit, degraded,
+                  budget: float = 180.0, attempts: int = 2,
+                  poll_sleep: float = 12.0, clock=time.perf_counter,
+                  sleep=time.sleep) -> dict:
+    """Run an add-on measurement inside the same weather regime as the
+    headline (pure control flow; unit-tested like
+    :func:`collect_passes`): when the headline was fit, poll (bounded)
+    for a fit window first and retry once if the window collapsed
+    mid-row; when the headline itself never saw fit weather, run
+    immediately (polling again would just burn watchdog budget — and
+    in outage mode each probe costs multiple multi-second RTTs, so
+    probes are skipped wholesale). The returned row carries its own
+    pre+post probes + fit verdict."""
+    if degraded:
+        row = fn()
+        row["weather"] = {"pre": _SKIPPED_PROBE, "post": _SKIPPED_PROBE}
+        row["fit_window"] = False
+        return row
+    t0 = clock()
+    row = None
+    for _ in range(attempts):
+        pre = probe()
+        while (
+            headline_fit and not pre.get("fit")
+            and clock() - t0 < budget
+        ):
+            sleep(poll_sleep)
+            pre = probe()
+        row = fn()
+        post = probe()
+        row["weather"] = {"pre": pre, "post": post}
+        row["fit_window"] = bool(pre.get("fit") and post.get("fit"))
+        if row["fit_window"] or not headline_fit or clock() - t0 > budget:
+            break
+    return row
+
+
 def _build_record(progress: dict) -> dict:
     """The whole measurement workload; ``progress`` is shared with the
     watchdog in :func:`main` so a hard device stall can still emit
@@ -951,39 +988,11 @@ def _build_record(progress: dict) -> dict:
     ]
 
     def gated_row(fn, budget: float = 180.0, attempts: int = 2):
-        """Run an add-on measurement inside the same weather regime as
-        the headline: when the headline was fit, poll (bounded) for a
-        fit window first and retry once if the window collapsed mid-row;
-        when the headline itself never saw fit weather, run immediately
-        (polling again would just burn watchdog budget — and in outage
-        mode each probe costs multiple multi-second RTTs, so probes are
-        skipped wholesale). The returned row carries its own pre+post
-        probes + fit verdict."""
-        if degraded:
-            row = fn()
-            row["weather"] = {"pre": _SKIPPED_PROBE,
-                              "post": _SKIPPED_PROBE}
-            row["fit_window"] = False
-            return row
-        t0 = time.perf_counter()
-        row = None
-        for _ in range(attempts):
-            pre = weather_probe()
-            while (
-                headline_fit and not pre.get("fit")
-                and time.perf_counter() - t0 < budget
-            ):
-                time.sleep(poll_sleep)
-                pre = weather_probe()
-            row = fn()
-            post = weather_probe()
-            row["weather"] = {"pre": pre, "post": post}
-            row["fit_window"] = bool(pre.get("fit") and post.get("fit"))
-            if row["fit_window"] or not headline_fit or (
-                time.perf_counter() - t0 > budget
-            ):
-                break
-        return row
+        return run_gated_row(
+            fn, weather_probe, headline_fit=headline_fit,
+            degraded=degraded, budget=budget, attempts=attempts,
+            poll_sleep=poll_sleep,
+        )
 
     # Add-on rows must never discard the collected pass data: a flake
     # here records an error string instead of losing the whole bench.

@@ -53,23 +53,29 @@ class MultiHeadAttention(nn.Module):
         # requesting the non-default strategy also enables it.
         use_sp = self.use_ring or self.sp_mode == "ulysses"
         if use_sp:
-            # Precision is the kernels' concern: reference_attention
-            # (the local path AND ulysses' per-device body) does f32
-            # score accumulation + f32 softmax internally with matmul
-            # inputs left in the compute dtype (bf16 on the MXU — f32
-            # matmuls run ~4x slower on v5e and halved the bench
-            # transformer row's MFU); ring_attention upcasts internally
-            # only when it actually rings, because its streaming
-            # softmax carries running max/sum in the input dtype.
+            # Precision is the kernels' concern: the local path and
+            # ulysses' per-device body go through local_attention,
+            # whose xla backend does f32 score accumulation + f32
+            # softmax with matmul inputs left in the compute dtype
+            # (bf16 on the MXU — f32 matmuls run ~4x slower on v5e and
+            # halved the bench transformer row's MFU) and whose flash
+            # backend (TPU, past the crossover) is the Pallas
+            # streaming-softmax kernel; ring_attention upcasts
+            # internally only when it actually rings, because its
+            # streaming softmax carries running max/sum in the input
+            # dtype.
             assert self.mesh is not None, "sequence parallelism needs a mesh"
-            sp_attn = (
-                ulysses_attention if self.sp_mode == "ulysses"
-                else ring_attention
-            )
-            o = sp_attn(
-                q, k, v, self.mesh, axis=self.seq_axis,
-                causal=self.causal, batch_axis=self.batch_axis,
-            )
+            if self.sp_mode == "ulysses":
+                o = ulysses_attention(
+                    q, k, v, self.mesh, axis=self.seq_axis,
+                    causal=self.causal, batch_axis=self.batch_axis,
+                    backend=self.attn_backend,
+                )
+            else:
+                o = ring_attention(
+                    q, k, v, self.mesh, axis=self.seq_axis,
+                    causal=self.causal, batch_axis=self.batch_axis,
+                )
         else:
             o = local_attention(q, k, v, causal=self.causal,
                                 backend=self.attn_backend)

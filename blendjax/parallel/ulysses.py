@@ -27,19 +27,24 @@ from __future__ import annotations
 
 import functools
 
-from blendjax.parallel.ring import reference_attention
 
-
-def _ulysses_local(q, k, v, *, axis_name: str, causal: bool, scale):
+def _ulysses_local(q, k, v, *, axis_name: str, causal: bool, scale,
+                   backend: str):
     """Per-device body (inside shard_map). Local shapes (B, T/n, H, D)."""
     import jax
+
+    from blendjax.ops.attention import local_attention
 
     # Head-scatter / sequence-gather: split the head axis n ways, deliver
     # chunk j to device j, concatenate the received sequence blocks in
     # device (= global sequence) order -> (B, T, H/n, D).
     a2a = functools.partial(jax.lax.all_to_all, axis_name=axis_name, tiled=True)
     qg, kg, vg = (a2a(x, split_axis=2, concat_axis=1) for x in (q, k, v))
-    o = reference_attention(qg, kg, vg, causal=causal, scale=scale)
+    # The local attention here sees the FULL sequence (for its head
+    # slice) — exactly the regime where the flash backend pays: long-T
+    # Ulysses composes all-to-alls with the Pallas kernel under 'auto'.
+    o = local_attention(qg, kg, vg, causal=causal, scale=scale,
+                        backend=backend)
     # Inverse: sequence-scatter / head-gather back to (B, T/n, H, D).
     return a2a(o, split_axis=1, concat_axis=2)
 
@@ -53,13 +58,18 @@ def ulysses_attention(
     causal: bool = False,
     scale: float | None = None,
     batch_axis: str | None = "data",
+    backend: str = "auto",
 ):
     """Exact multi-head attention with the sequence dim sharded on
     ``axis``, via head-scatter/sequence-gather all-to-alls.
 
     Inputs/outputs are (B, T, H, D) global arrays with T sharded on
     ``axis`` (same contract as :func:`~blendjax.parallel.ring_attention`);
-    requires ``H % mesh.shape[axis] == 0``.
+    requires ``H % mesh.shape[axis] == 0``. ``backend`` selects the
+    per-device local attention after the all-to-all
+    (:func:`blendjax.ops.attention.local_attention`): ``auto`` takes
+    the Pallas flash kernel past its crossover on TPU, so long-context
+    Ulysses never materializes the (T, T) scores.
     """
     import jax
     from jax.sharding import PartitionSpec as P
@@ -67,7 +77,10 @@ def ulysses_attention(
     d = q.shape[-1]
     scale = scale if scale is not None else d**-0.5
     if axis not in mesh.axis_names:
-        return reference_attention(q, k, v, causal=causal, scale=scale)
+        from blendjax.ops.attention import local_attention
+
+        return local_attention(q, k, v, causal=causal, scale=scale,
+                               backend=backend)
     n = mesh.shape[axis]
     h = q.shape[2]
     assert h % n == 0, (
@@ -77,7 +90,8 @@ def ulysses_attention(
     b_ax = batch_axis if (batch_axis and batch_axis in mesh.axis_names) else None
     spec = P(b_ax, axis)
     body = functools.partial(
-        _ulysses_local, axis_name=axis, causal=causal, scale=scale
+        _ulysses_local, axis_name=axis, causal=causal, scale=scale,
+        backend=backend,
     )
     f = jax.shard_map(
         body, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec

@@ -234,6 +234,86 @@ def test_collect_passes_flap_mid_pass_is_not_fit():
     assert passes[0]["fit_window"] is False
 
 
+def _row_fn(values, clock, cost=5.0):
+    it = iter(values)
+    last = values[-1]
+
+    def fn():
+        nonlocal last
+        clock.t += cost
+        last = next(it, last)
+        return {"img_s": last}
+
+    return fn
+
+
+def test_gated_row_polls_for_fit_when_headline_fit():
+    import bench
+
+    clock = _Clock()
+    row = bench.run_gated_row(
+        _row_fn([600.0], clock),
+        _probe_seq([COLLAPSED, COLLAPSED, FIT, FIT], clock),
+        headline_fit=True, degraded=False, budget=180.0,
+        poll_sleep=12.0, clock=clock, sleep=clock.sleep,
+    )
+    assert row["fit_window"] is True
+    assert row["weather"]["pre"]["h2d_MB_s"] == 43.0
+
+
+def test_gated_row_runs_immediately_when_headline_unfit():
+    import bench
+
+    clock = _Clock()
+    probes = {"n": 0}
+
+    def probe():
+        probes["n"] += 1
+        clock.t += 1.0
+        return dict(COLLAPSED)
+
+    row = bench.run_gated_row(
+        _row_fn([100.0], clock), probe,
+        headline_fit=False, degraded=False, budget=180.0,
+        poll_sleep=12.0, clock=clock, sleep=clock.sleep,
+    )
+    assert row["fit_window"] is False
+    assert probes["n"] == 2  # pre + post only: no polling, no retry
+    assert clock.t < 10
+
+
+def test_gated_row_retries_once_after_midrow_collapse():
+    import bench
+
+    clock = _Clock()
+    # attempt 1: pre fit, post collapsed (flap); attempt 2: fit holds
+    row = bench.run_gated_row(
+        _row_fn([500.0, 510.0], clock),
+        _probe_seq([FIT, COLLAPSED, FIT, FIT], clock),
+        headline_fit=True, degraded=False, budget=180.0,
+        poll_sleep=12.0, clock=clock, sleep=clock.sleep,
+    )
+    assert row["fit_window"] is True
+    assert row["img_s"] == 510.0  # the retry's measurement
+
+
+def test_gated_row_degraded_skips_probes_entirely():
+    import bench
+
+    clock = _Clock()
+
+    def probe():  # pragma: no cover - must not be called
+        raise AssertionError("probe called in degraded mode")
+
+    row = bench.run_gated_row(
+        _row_fn([5.0], clock), probe,
+        headline_fit=False, degraded=True,
+        clock=clock, sleep=clock.sleep,
+    )
+    assert row["fit_window"] is False
+    assert row["weather"]["pre"].get("skipped") == "outage"
+
+
 def test_pipelined_ceiling_caps_and_flags(monkeypatch):
     """A ceiling run that exceeds its time cap must return what it
     measured, flagged 'capped' (a silently depressed ceiling would
