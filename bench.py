@@ -422,10 +422,12 @@ def measure(encoding: str, chunk: int, items: int, time_cap: float,
 
 
 def measure_step_alone(chunk: int, calls: int = 8, model=None,
-                       loss_fn=None) -> dict:
+                       loss_fn=None, shape=None, batch=None) -> dict:
     """Chip-side ceiling: the chunked train step on an already-on-device
     superbatch, no pipeline — the denominator of the utilization figure
-    (VERDICT r2 item 1: achieved img/s / step-alone img/s)."""
+    (VERDICT r2 item 1: achieved img/s / step-alone img/s).
+    ``shape``/``batch`` default to the bench frame geometry; the
+    long-sequence transformer sub-row passes larger frames."""
     import jax
 
     from blendjax.models import CubeRegressor
@@ -436,6 +438,8 @@ def measure_step_alone(chunk: int, calls: int = 8, model=None,
         make_train_state,
     )
 
+    shape = SHAPE if shape is None else shape
+    batch = BATCH if batch is None else batch
     mesh = create_mesh({"data": -1})
     sharding = batch_sharding(mesh)
     rng = np.random.default_rng(0)
@@ -443,16 +447,16 @@ def measure_step_alone(chunk: int, calls: int = 8, model=None,
     # utilization ratio must compare identical programs.
     state = make_train_state(
         CubeRegressor() if model is None else model,
-        np.zeros((BATCH, *SHAPE, 4), np.uint8), mesh=mesh,
+        np.zeros((batch, *shape, 4), np.uint8), mesh=mesh,
     )
     if chunk > 1:
         step = make_chunked_supervised_step(loss_fn=loss_fn)
-        lead = (chunk, BATCH)
+        lead = (chunk, batch)
     else:
         step = make_supervised_step(
             mesh=mesh, batch_sharding=sharding, loss_fn=loss_fn
         )
-        lead = (BATCH,)
+        lead = (batch,)
     # Chunked fields carry the chunk axis replicated; per-batch fields
     # take the batch sharding directly — matching what the pipeline
     # feeds measure() (layouts ride the arrays; the step jit infers).
@@ -464,7 +468,7 @@ def measure_step_alone(chunk: int, calls: int = 8, model=None,
         )
     sb = {
         "image": jax.device_put(
-            rng.integers(0, 255, (*lead, *SHAPE, 4), np.uint8), sharding
+            rng.integers(0, 255, (*lead, *shape, 4), np.uint8), sharding
         ),
         "xy": jax.device_put(
             (rng.random((*lead, 8, 2)) * 64).astype(np.float32), sharding
@@ -480,7 +484,7 @@ def measure_step_alone(chunk: int, calls: int = 8, model=None,
             state, m = step(state, sb)
         float(np.asarray(m["loss"]).reshape(-1)[-1])  # honest d2h sync
         dt = time.perf_counter() - t0
-        best = max(best, calls * chunk * BATCH / dt)
+        best = max(best, calls * chunk * batch / dt)
     return {"img_s": round(best, 1), "chunk": chunk, "calls": calls}
 
 
@@ -631,7 +635,8 @@ def _is_v5e() -> bool:
 
 
 def measure_model_flops(model=None, loss_fn=None,
-                        label: str = "CubeRegressor fwd+bwd") -> dict:
+                        label: str = "CubeRegressor fwd+bwd",
+                        shape=None, batch=None) -> dict:
     """Fwd+bwd FLOPs per image of the benchmark step, from the compiled
     executable's own cost analysis (XLA's count, not a hand estimate).
 
@@ -644,23 +649,25 @@ def measure_model_flops(model=None, loss_fn=None,
     from blendjax.parallel import batch_sharding, create_mesh
     from blendjax.train import make_supervised_step, make_train_state
 
+    shape = SHAPE if shape is None else shape
+    batch = BATCH if batch is None else batch
     mesh = create_mesh({"data": -1})
     state = make_train_state(
         CubeRegressor() if model is None else model,
-        np.zeros((BATCH, *SHAPE, 4), np.uint8), mesh=mesh,
+        np.zeros((batch, *shape, 4), np.uint8), mesh=mesh,
     )
     step = make_supervised_step(
         mesh=mesh, batch_sharding=batch_sharding(mesh), loss_fn=loss_fn
     )
     sb = {
-        "image": np.zeros((BATCH, *SHAPE, 4), np.uint8),
-        "xy": np.zeros((BATCH, 8, 2), np.float32),
+        "image": np.zeros((batch, *shape, 4), np.uint8),
+        "xy": np.zeros((batch, 8, 2), np.float32),
     }
     ca = step.lower(state, sb).compile().cost_analysis()
     ca = ca[0] if isinstance(ca, (list, tuple)) else ca
     flops = float(ca["flops"])
     return {
-        "flops_per_image": round(flops / BATCH),
+        "flops_per_image": round(flops / batch),
         "model": label,
         "source": "compiled.cost_analysis() (unchunked step)",
         "chip": "TPU v5e",
@@ -730,6 +737,64 @@ def measure_transformer_row(chunk: int) -> dict:
         row["mfu_step_alone"] = round(
             alone["img_s"] * fl["flops_per_image"] / V5E_PEAK_FLOPS, 4
         )
+    # Long-sequence sub-row: the same model on 960x1280 frames -> 3072
+    # patch tokens (4x the headline row), step-alone only (the live
+    # stream is 480x640) — evidences the long-context train path on
+    # real hardware in the driver record. attn_backend='auto' resolves
+    # by blendjax.ops.attention's memory-driven policy (measured: the
+    # materialized path stays faster in-model until its saved score
+    # tensors threaten HBM; flash is the enabler beyond, not a
+    # mid-length speedup). remat off: activations fit at this size and
+    # remat measured 31.3 -> 24.8 img/s.
+    try:
+        import jax.numpy as jnp
+
+        from blendjax.models import StreamFormer
+        from blendjax.ops.attention import auto_picks_flash
+
+        long_model = StreamFormer(
+            patch=20, dim=512, depth=8, num_heads=4, num_outputs=16,
+            attn_backend="auto",
+        )
+        long_shape, long_batch = (960, 1280), 4
+        tokens = (
+            (long_shape[0] // long_model.patch)
+            * (long_shape[1] // long_model.patch)
+        )
+        long_alone = measure_step_alone(
+            chunk=4, calls=4, model=long_model, loss_fn=loss_fn,
+            shape=long_shape, batch=long_batch,
+        )
+        # derived from the measured model's own geometry, so the
+        # reported backend cannot diverge from what actually dispatched
+        probe_q = jax.ShapeDtypeStruct(
+            (long_batch, tokens, long_model.num_heads,
+             long_model.dim // long_model.num_heads),
+            jnp.bfloat16,
+        )
+        ls = {
+            "tokens": tokens,
+            "frame": list(long_shape),
+            "attn_backend": (
+                "flash(auto)" if auto_picks_flash(probe_q)
+                else "xla(auto)"
+            ),
+            "step_alone": long_alone,
+        }
+        if _is_v5e():
+            lfl = measure_model_flops(
+                model=long_model, loss_fn=loss_fn,
+                label="StreamFormer longseq fwd+bwd",
+                shape=long_shape, batch=long_batch,
+            )
+            ls["flops_per_image"] = lfl["flops_per_image"]
+            ls["mfu_step_alone"] = round(
+                long_alone["img_s"] * lfl["flops_per_image"]
+                / V5E_PEAK_FLOPS, 4
+            )
+        row["longseq"] = ls
+    except Exception as e:  # pragma: no cover - device flake path
+        row["longseq"] = {"error": repr(e)[:200]}
     return row
 
 

@@ -59,7 +59,7 @@ class MultiHeadAttention(nn.Module):
             # softmax with matmul inputs left in the compute dtype
             # (bf16 on the MXU — f32 matmuls run ~4x slower on v5e and
             # halved the bench transformer row's MFU) and whose flash
-            # backend (TPU, past the crossover) is the Pallas
+            # backend (TPU, long-context regime) is the Pallas
             # streaming-softmax kernel; ring_attention upcasts
             # internally only when it actually rings, because its
             # streaming softmax carries running max/sum in the input
@@ -148,9 +148,10 @@ class StreamFormer(nn.Module):
     num_experts: int = 0
     moe_every: int = 2  # MoE MLP in every nth block (others stay dense)
     sp_mode: str = "ring"  # sequence-parallel strategy: 'ring' | 'ulysses'
-    attn_backend: str = "auto"  # local attention: Pallas flash kernel for
-    # long sequences on TPU, materialized-scores XLA path otherwise
-    # (measured crossover ~1k tokens; blendjax.ops.attention)
+    attn_backend: str = "auto"  # local attention: materialized-scores
+    # XLA path until a call's saved score tensors threaten HBM, Pallas
+    # flash kernel beyond (memory-driven policy, measured in
+    # blendjax.ops.attention)
     remat: bool = False  # rematerialize blocks: ~O(sqrt) activation
     # memory in backprop for long sequences/deep stacks, recompute on the
     # backward pass (jax.checkpoint via nn.remat — HBM for FLOPs)

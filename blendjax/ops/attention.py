@@ -11,12 +11,23 @@ Net-new vs the reference (blendtorch has no sequence models, SURVEY.md
   softmax in VMEM, never materializing the score tensor. fwd+bwd via
   the kernel's own custom VJP.
 
-``auto`` picks by measured crossover on the v5e: the materialized path
-wins slightly at short sequences (T=768: 0.57 vs 0.68 ms fwd+bwd —
-kernel launch overhead beats one small score tensor) while flash wins
-past ~1k tokens and scales: at T=3072 flash measures 2.43 vs 3.33 ms
-fwd+bwd (1.37x) and saves the O(T^2) f32 residuals (~600 MB at that
-size) that backprop would otherwise hold in HBM.
+``auto`` policy (v5e measurements, full train steps — StreamFormer
+dim 512 depth 8 heads 4):
+
+- ISOLATED attention fwd+bwd favors flash past ~1k tokens (T=3072:
+  2.43 vs 3.33 ms, 1.37x), but IN-MODEL the materialized path keeps
+  winning well beyond that — T=3072: 39.4 vs 31.3 img/s; T=6144
+  (1.2 GB/layer transient scores): 9.7 vs 7.8 img/s — the kernel's
+  separate bwd passes cost more than XLA's fused attention backward
+  while HBM still absorbs the score tensors.
+- What the materialized path cannot do is run when the saved-for-
+  backward score tensors stop fitting (e.g. T=16k at B=1, H=4: ~4.3
+  GB/layer of f32 probs — a couple of layers exhaust a 16 GB chip).
+
+So ``auto`` defers to ``xla`` until a single call's score residual
+would exceed :data:`FLASH_RESIDUAL_BYTES`, and takes ``flash`` beyond
+— flash is the long-context enabler, not a mid-length speedup, on
+this hardware. Explicit ``backend="flash"`` always takes the kernel.
 
 The sequence-parallel kernels (:mod:`blendjax.parallel.ring`,
 :mod:`blendjax.parallel.ulysses`) shard T across devices *before* any
@@ -27,17 +38,37 @@ from __future__ import annotations
 
 from blendjax.parallel.ring import reference_attention
 
-# Measured v5e crossover (docstring): flash wins from ~1k tokens.
-FLASH_MIN_TOKENS = 1024
-# The kernel's default block sizes divide 128; eligibility keyed on it.
+# Per-call score-residual budget (bytes of f32 probs saved for the
+# backward pass) above which `auto` switches to the flash kernel: at
+# 2 GiB/call even a handful of layers threatens a 16 GB chip, and the
+# measured in-model xla advantage (see module docstring) no longer
+# applies because xla can no longer run at all. (T=16k at B=1, H=4 is
+# ~4.3 GB/call — comfortably over.)
+FLASH_RESIDUAL_BYTES = 2 << 30
+# The kernel's block constraints: sequence lengths must tile 128-wide
+# blocks; head_dim is padded up to 128 but must be a multiple of 128
+# above it.
 FLASH_BLOCK = 128
+
+
+def scores_residual_bytes(q, k=None) -> int:
+    """Bytes of attention probabilities one call saves for its backward
+    pass — the term that makes materialized attention infeasible at
+    long context. f32: ``reference_attention`` computes and normalizes
+    the probs in f32 and only casts at the output matmul, so the
+    saved-for-backward tensor is f32 (confirmed by the measured ~600 MB
+    at B=4, H=4, T=3072 — exactly 4*4*3072^2*4 bytes)."""
+    b, tq, h, _ = q.shape
+    tk = q.shape[1] if k is None else k.shape[1]
+    return b * h * tq * tk * 4
 
 
 def flash_supported(q, k=None) -> bool:
     """Whether the Pallas TPU flash kernel can take these (B, T, H, D)
     inputs: TPU backend and sequence lengths the kernel's 128-wide
     blocks tile exactly — the KV length too, for cross-attention (the
-    kernel pads head_dim internally)."""
+    kernel pads head_dim up to 128; above that it requires multiples
+    of 128, its own constraint)."""
     import jax
 
     if jax.default_backend() != "tpu":
@@ -46,11 +77,18 @@ def flash_supported(q, k=None) -> bool:
         return False
     d = q.shape[-1]
     if d > 128 and d % 128:
-        # the kernel pads head_dim UP to 128 but requires multiples of
-        # 128 above it (its own NotImplementedError otherwise)
         return False
     return k is None or (
         k.ndim == 4 and k.shape[1] % FLASH_BLOCK == 0
+    )
+
+
+def auto_picks_flash(q, k=None) -> bool:
+    """The ``auto`` policy, exposed so callers (the bench's longseq
+    row) can report which backend a shape resolves to."""
+    return (
+        flash_supported(q, k)
+        and scores_residual_bytes(q, k) > FLASH_RESIDUAL_BYTES
     )
 
 
@@ -58,10 +96,10 @@ def local_attention(q, k, v, causal: bool = False, scale=None,
                     backend: str = "auto"):
     """Exact multi-head attention over (B, T, H, D) tensors.
 
-    ``backend``: ``"xla"`` | ``"flash"`` | ``"auto"`` (flash on TPU for
-    T >= ``FLASH_MIN_TOKENS`` when eligible, else xla). ``"flash"``
-    raises on an ineligible input instead of silently measuring xla —
-    same explicitness contract as the tile decode's ``use_pallas``.
+    ``backend``: ``"xla"`` | ``"flash"`` | ``"auto"`` (the
+    memory-driven policy above). ``"flash"`` raises on an ineligible
+    input instead of silently measuring xla — same explicitness
+    contract as the tile decode's ``use_pallas``.
     """
     if backend not in ("auto", "flash", "xla"):
         # ValueError, not assert: a typo'd backend under `python -O`
@@ -74,9 +112,7 @@ def local_attention(q, k, v, causal: bool = False, scale=None,
             f"{k.shape[1]}) must be multiples of {FLASH_BLOCK}"
         )
     use_flash = backend == "flash" or (
-        backend == "auto"
-        and q.shape[1] >= FLASH_MIN_TOKENS
-        and flash_supported(q, k)
+        backend == "auto" and auto_picks_flash(q, k)
     )
     if not use_flash:
         return reference_attention(q, k, v, causal=causal, scale=scale)

@@ -67,9 +67,13 @@ def ulysses_attention(
     ``axis`` (same contract as :func:`~blendjax.parallel.ring_attention`);
     requires ``H % mesh.shape[axis] == 0``. ``backend`` selects the
     per-device local attention after the all-to-all
-    (:func:`blendjax.ops.attention.local_attention`): ``auto`` takes
-    the Pallas flash kernel past its crossover on TPU, so long-context
-    Ulysses never materializes the (T, T) scores.
+    (:func:`blendjax.ops.attention.local_attention`). Note the policy
+    input there is the POST-all-to-all shape — each device attends the
+    full sequence for H/n heads, so the per-call score residual
+    shrinks by the axis size: ``auto`` (memory-driven) keeps the
+    materialized path until even that per-head-subset residual
+    threatens HBM, and takes the Pallas flash kernel beyond (pass
+    ``backend="flash"`` to force it).
     """
     import jax
     from jax.sharding import PartitionSpec as P

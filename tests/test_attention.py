@@ -1,8 +1,8 @@
 """Local-attention backend dispatch (blendjax.ops.attention).
 
 The flash kernel itself is TPU hardware (`-m tpu` tier); the dispatch
-contract — explicit-request failures, auto fallback, crossover policy —
-is hermetic.
+contract — explicit-request failures, auto fallback, the memory-driven
+auto policy — is hermetic.
 """
 
 import numpy as np
@@ -12,9 +12,11 @@ jax = pytest.importorskip("jax")
 import jax.numpy as jnp  # noqa: E402
 
 from blendjax.ops.attention import (  # noqa: E402
-    FLASH_MIN_TOKENS,
+    FLASH_RESIDUAL_BYTES,
+    auto_picks_flash,
     flash_supported,
     local_attention,
+    scores_residual_bytes,
 )
 from blendjax.parallel.ring import reference_attention  # noqa: E402
 
@@ -31,6 +33,7 @@ def test_flash_unsupported_off_tpu():
     q, _, _ = _qkv()
     if jax.default_backend() != "tpu":
         assert not flash_supported(q)
+        assert not auto_picks_flash(q)
 
 
 def test_explicit_flash_raises_when_unsupported():
@@ -52,11 +55,29 @@ def test_unknown_backend_rejected():
 def test_flash_support_checks_kv_length_too():
     """Cross-attention with an un-tileable KV length must not dispatch
     to the kernel (auto falls back; explicit flash raises)."""
-    from blendjax.ops.attention import flash_supported
-
     q, _, _ = _qkv(t=128)
     k_bad, _, _ = _qkv(t=120)
     assert not flash_supported(q, k_bad)
+
+
+def test_scores_residual_bytes_and_auto_threshold():
+    """The auto policy is memory-driven: f32 prob-residual bytes per
+    call against FLASH_RESIDUAL_BYTES (in-model, the materialized path
+    measured FASTER than the kernel at every length HBM absorbs —
+    docs in the module header — so flash engages only where xla
+    becomes infeasible)."""
+    class Q:
+        ndim = 4
+
+        def __init__(self, b, t, h, d):
+            self.shape = (b, t, h, d)
+
+    # f32 probs saved for backward (measured ~600 MB at this shape)
+    assert scores_residual_bytes(Q(4, 3072, 4, 128)) == 4 * 4 * 3072**2 * 4
+    # ~604 MB at the bench longseq shape: under the 2 GiB bar
+    assert scores_residual_bytes(Q(4, 3072, 4, 128)) < FLASH_RESIDUAL_BYTES
+    # T=16k at B=1, H=4 (the module docstring's OOM example): ~4.3 GB
+    assert scores_residual_bytes(Q(1, 16384, 4, 128)) > FLASH_RESIDUAL_BYTES
 
 
 @pytest.mark.parametrize("backend", ["auto", "xla"])
@@ -72,10 +93,9 @@ def test_dispatch_matches_reference_off_tpu(backend):
 
 @pytest.mark.tpu
 def test_flash_matches_reference_on_tpu():
-    """Kernel parity on real hardware, above the auto crossover
+    """Kernel parity on real hardware
     (run with BLENDJAX_TEST_TPU=1 pytest -m tpu)."""
-    t = max(FLASH_MIN_TOKENS, 1024)
-    q, k, v = _qkv(t=t, h=4, d=128, dtype=jnp.bfloat16)
+    q, k, v = _qkv(t=1024, h=4, d=128, dtype=jnp.bfloat16)
     assert flash_supported(q)
     for causal in (False, True):
         out = local_attention(q, k, v, causal=causal, backend="flash")
@@ -87,10 +107,11 @@ def test_flash_matches_reference_on_tpu():
         # bar is a few bf16 ulps at the output magnitudes (~2-4 on the
         # causal path's early rows, where one ulp is 2^-6)
         assert diff < 2e-2, (causal, diff)
-    # and auto picks flash at this length without changing results
+    # auto at this (small-residual) shape takes the xla path — the
+    # memory-driven policy — and still matches
     out_auto = local_attention(q, k, v, backend="auto")
     np.testing.assert_allclose(
         np.asarray(out_auto.astype(jnp.float32)),
-        np.asarray(local_attention(q, k, v, backend="flash")
-                   .astype(jnp.float32)),
+        np.asarray(reference_attention(q, k, v).astype(jnp.float32)),
+        atol=2e-2,
     )
