@@ -1,0 +1,54 @@
+"""blendjax.analysis — a JAX-aware static analyzer (``bjx-lint``).
+
+The pipeline's performance and safety contract rests on invariants that
+no runtime test can cheaply cover: no host side effects under ``jit``
+trace, no host synchronization inside the streaming hot loop, pickle
+only behind explicit ``allow_pickle`` gates, ZMQ sockets used only on
+the thread that created them, and every socket/context closed on every
+path. This package turns those conventions into an AST-level CI gate::
+
+    python -m blendjax.analysis blendjax/
+
+Rules (see ``docs/static-analysis.md``):
+
+- ``BJX101`` jit-purity: host side effects reachable from jit/pjit/
+  shard_map tracing.
+- ``BJX102`` host-sync-in-hot-path: device synchronization inside the
+  streaming loop modules.
+- ``BJX103`` unsafe-deserialization: ungated ``pickle`` decode paths.
+- ``BJX104`` zmq-thread-affinity: a socket created on one thread,
+  used from another.
+- ``BJX105`` socket-leak: socket/context creation with no ``close``/
+  ``term`` on some path.
+
+Suppress one finding with an inline ``# bjx: ignore[BJX101]`` (or a
+bare ``# bjx: ignore`` for all rules); grandfather existing findings
+with the committed ``.bjx-baseline.json`` (regenerate via
+``--write-baseline``).
+"""
+
+from __future__ import annotations
+
+from blendjax.analysis.core import (
+    Finding,
+    ModuleContext,
+    Rule,
+    all_rules,
+    analyze_paths,
+    analyze_source,
+    load_baseline,
+    register,
+    write_baseline,
+)
+
+__all__ = [
+    "Finding",
+    "ModuleContext",
+    "Rule",
+    "all_rules",
+    "analyze_paths",
+    "analyze_source",
+    "load_baseline",
+    "register",
+    "write_baseline",
+]

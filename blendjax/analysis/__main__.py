@@ -1,0 +1,104 @@
+"""CLI: ``python -m blendjax.analysis [paths...]``.
+
+Exit status: 0 when every finding is inline-suppressed or baselined,
+1 when unsuppressed findings remain, 2 on usage errors. Runs with no
+third-party imports so it works offline and inside Blender's Python.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from blendjax.analysis.core import (
+    BASELINE_DEFAULT,
+    all_rules,
+    analyze_paths,
+    apply_baseline,
+    load_baseline,
+    write_baseline,
+)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        prog="python -m blendjax.analysis",
+        description="bjx-lint: JAX/ZMQ invariant checks for blendjax",
+    )
+    parser.add_argument(
+        "paths", nargs="*", default=["blendjax"],
+        help="files or directories to analyze (default: blendjax)",
+    )
+    parser.add_argument(
+        "--select", default=None,
+        help="comma-separated rule ids to run (default: all)",
+    )
+    parser.add_argument(
+        "--baseline", default=BASELINE_DEFAULT,
+        help=f"baseline file (default: {BASELINE_DEFAULT})",
+    )
+    parser.add_argument(
+        "--no-baseline", action="store_true",
+        help="report baselined findings too",
+    )
+    parser.add_argument(
+        "--write-baseline", action="store_true",
+        help="grandfather all current findings into the baseline file",
+    )
+    parser.add_argument(
+        "--format", choices=("text", "json"), default="text",
+    )
+    parser.add_argument(
+        "--list-rules", action="store_true", help="print rule ids and exit",
+    )
+    return parser
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+    rules = all_rules()
+    if args.list_rules:
+        for rule_id, rule in sorted(rules.items()):
+            print(f"{rule_id} {rule.name}: {rule.description}")
+        return 0
+    select = None
+    if args.select:
+        select = {r.strip().upper() for r in args.select.split(",") if r.strip()}
+        unknown = select - set(rules)
+        if unknown:
+            print(f"unknown rule ids: {sorted(unknown)}", file=sys.stderr)
+            return 2
+    missing = [p for p in args.paths if not os.path.exists(p)]
+    if missing:
+        print(f"no such path: {missing}", file=sys.stderr)
+        return 2
+
+    root = os.getcwd()
+    findings = analyze_paths(args.paths, select=select, root=root)
+    if args.write_baseline:
+        n = write_baseline(args.baseline, findings, root)
+        print(f"wrote {n} finding(s) to {args.baseline}")
+        return 0
+    if not args.no_baseline:
+        findings = apply_baseline(
+            findings, load_baseline(args.baseline), root
+        )
+
+    if args.format == "json":
+        print(json.dumps([f.__dict__ for f in findings], indent=2))
+    else:
+        for f in findings:
+            print(f.render())
+        if findings:
+            print(
+                f"\n{len(findings)} finding(s). Suppress one site with "
+                "'# bjx: ignore[RULE]' or grandfather all with "
+                "--write-baseline (see docs/static-analysis.md)."
+            )
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,367 @@
+"""Rule framework: findings, registry, suppressions, baseline.
+
+Design constraints:
+
+- stdlib only (``ast`` + ``json``): the linter must run on the producer
+  side (Blender's Python) and in CI with ``JAX_PLATFORMS=cpu`` without
+  importing jax, zmq, or numpy.
+- line-number independent baseline: entries are fingerprinted by
+  (rule, path, normalized source line, occurrence index) so unrelated
+  edits above a grandfathered finding don't invalidate the baseline.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import hashlib
+import json
+import os
+import re
+from collections import defaultdict
+from typing import Callable, Iterable, Iterator
+
+BASELINE_DEFAULT = ".bjx-baseline.json"
+
+FunctionNode = ast.FunctionDef | ast.AsyncFunctionDef
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*bjx:\s*ignore(?:\[(?P<rules>[A-Za-z0-9_,\s]+)\])?"
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One diagnostic: ``path:line:col RULE message``."""
+
+    rule: str
+    path: str
+    line: int
+    col: int
+    message: str
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}:{self.col + 1}: {self.rule} {self.message}"
+
+
+class Rule:
+    """Base class: subclasses set ``id``/``name``/``description`` and
+    implement ``check(module) -> iterable of Finding``."""
+
+    id: str = ""
+    name: str = ""
+    description: str = ""
+
+    def check(self, module: "ModuleContext") -> Iterable[Finding]:
+        raise NotImplementedError
+
+    def finding(self, module: "ModuleContext", node: ast.AST, message: str) -> Finding:
+        return Finding(
+            rule=self.id,
+            path=module.relpath,
+            line=getattr(node, "lineno", 1),
+            col=getattr(node, "col_offset", 0),
+            message=message,
+        )
+
+
+_REGISTRY: dict[str, Rule] = {}
+
+
+def register(cls: type[Rule]) -> type[Rule]:
+    """Class decorator: instantiate and add to the rule registry."""
+    rule = cls()
+    assert rule.id and rule.id not in _REGISTRY, f"bad rule id {rule.id!r}"
+    _REGISTRY[rule.id] = rule
+    return cls
+
+
+def all_rules() -> dict[str, Rule]:
+    """Registry, importing the built-in rule modules on first use."""
+    import blendjax.analysis.rules  # noqa: F401  (registration side effect)
+
+    return dict(_REGISTRY)
+
+
+def walk_shallow(fn: ast.AST) -> Iterator[ast.AST]:
+    """Walk a function's nodes WITHOUT descending into nested function/
+    class definitions (those are separate ``iter_functions`` entries, so
+    crossing the boundary double-reports their findings). Lambdas are
+    NOT a boundary: they have no ``iter_functions`` entry of their own,
+    so their bodies belong to the enclosing function's scan."""
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        yield node
+        if not isinstance(
+            node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+        ):
+            stack.extend(ast.iter_child_nodes(node))
+
+
+def dotted_name(node: ast.AST) -> str | None:
+    """``a.b.c`` for a Name/Attribute chain, else None."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+class ModuleContext:
+    """Parsed module plus the lookup tables every rule needs."""
+
+    def __init__(self, source: str, relpath: str) -> None:
+        self.source = source
+        self.relpath = relpath.replace(os.sep, "/")
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source, filename=relpath)
+        self.parents: dict[ast.AST, ast.AST] = {}
+        for parent in ast.walk(self.tree):
+            for child in ast.iter_child_nodes(parent):
+                self.parents[child] = parent
+        self.imports = self._import_table()
+        self.suppressions = self._suppression_table()
+
+    # -- imports ------------------------------------------------------------
+
+    def _import_table(self) -> dict[str, str]:
+        table: dict[str, str] = {}
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    table[alias.asname or alias.name.split(".")[0]] = (
+                        alias.name if alias.asname else alias.name.split(".")[0]
+                    )
+            elif isinstance(node, ast.ImportFrom) and node.module:
+                for alias in node.names:
+                    table[alias.asname or alias.name] = (
+                        f"{node.module}.{alias.name}"
+                    )
+        return table
+
+    def resolve(self, node: ast.AST) -> str | None:
+        """Dotted name with the leading alias expanded through the import
+        table (``np.random.rand`` -> ``numpy.random.rand``)."""
+        name = dotted_name(node)
+        if name is None:
+            return None
+        head, _, rest = name.partition(".")
+        expanded = self.imports.get(head, head)
+        return f"{expanded}.{rest}" if rest else expanded
+
+    # -- suppressions -------------------------------------------------------
+
+    def _suppression_table(self) -> dict[int, set[str] | None]:
+        """line -> suppressed rule ids (None = all rules)."""
+        table: dict[int, set[str] | None] = {}
+        for i, text in enumerate(self.lines, start=1):
+            for m in _SUPPRESS_RE.finditer(text):
+                rules = m.group("rules")
+                if rules is None:
+                    table[i] = None
+                    break
+                ids = {r.strip().upper() for r in rules.split(",") if r.strip()}
+                prev = table.get(i)
+                if prev is not None:
+                    ids |= prev
+                table[i] = ids
+        return table
+
+    def suppressed(self, finding: Finding) -> bool:
+        """Inline-suppressed: marker on the finding's line, or on a
+        directly preceding comment-only line."""
+        for line in (finding.line, finding.line - 1):
+            if line not in self.suppressions:
+                continue
+            if line == finding.line - 1 and not self.lines[
+                line - 1
+            ].lstrip().startswith("#"):
+                continue
+            rules = self.suppressions[line]
+            if rules is None or finding.rule in rules:
+                return True
+        return False
+
+    def line_text(self, line: int) -> str:
+        if 1 <= line <= len(self.lines):
+            return self.lines[line - 1].strip()
+        return ""
+
+    # -- function table -----------------------------------------------------
+
+    def iter_functions(
+        self,
+    ) -> Iterator[tuple[str, FunctionNode, ast.ClassDef | None]]:
+        """Yield ``(qualname, def-node, enclosing class or None)`` for every
+        function/method (nested functions get dotted qualnames)."""
+
+        def walk(
+            node: ast.AST, prefix: str, cls: ast.ClassDef | None
+        ) -> Iterator[tuple[str, FunctionNode, ast.ClassDef | None]]:
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    qual = f"{prefix}{child.name}"
+                    yield qual, child, cls
+                    yield from walk(child, qual + ".", cls)
+                elif isinstance(child, ast.ClassDef):
+                    yield from walk(child, f"{prefix}{child.name}.", child)
+                else:
+                    yield from walk(child, prefix, cls)
+
+        yield from walk(self.tree, "", None)
+
+
+# -- running ----------------------------------------------------------------
+
+
+def analyze_source(
+    source: str,
+    relpath: str,
+    select: set[str] | None = None,
+) -> list[Finding]:
+    """All non-inline-suppressed findings for one module's source."""
+    try:
+        module = ModuleContext(source, relpath)
+    except SyntaxError as e:
+        return [
+            Finding(
+                rule="BJX000",
+                path=relpath.replace(os.sep, "/"),
+                line=e.lineno or 1,
+                col=(e.offset or 1) - 1,
+                message=f"syntax error: {e.msg}",
+            )
+        ]
+    findings: list[Finding] = []
+    for rule_id, rule in sorted(all_rules().items()):
+        if select and rule_id not in select:
+            continue
+        for f in rule.check(module):
+            if not module.suppressed(f):
+                findings.append(f)
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return findings
+
+
+def iter_py_files(paths: Iterable[str]) -> Iterator[str]:
+    for path in paths:
+        if os.path.isdir(path):
+            for root, dirs, files in os.walk(path):
+                dirs[:] = sorted(
+                    d for d in dirs
+                    if d not in {"__pycache__", ".git", ".venv", "node_modules"}
+                )
+                for name in sorted(files):
+                    if name.endswith(".py"):
+                        yield os.path.join(root, name)
+        elif path.endswith(".py"):
+            yield path
+
+
+def analyze_paths(
+    paths: Iterable[str],
+    select: set[str] | None = None,
+    root: str | None = None,
+) -> list[Finding]:
+    """Findings over files/directories, paths reported relative to ``root``
+    (default: cwd) so baselines are machine-independent."""
+    root = os.path.abspath(root or os.getcwd())
+    findings: list[Finding] = []
+    seen: set[str] = set()
+    for path in iter_py_files(paths):
+        abspath = os.path.abspath(path)
+        if abspath in seen:  # overlapping path arguments
+            continue
+        seen.add(abspath)
+        rel = os.path.relpath(abspath, root)
+        with open(path, "r", encoding="utf-8") as f:
+            source = f.read()
+        findings.extend(analyze_source(source, rel, select=select))
+    return findings
+
+
+# -- baseline ---------------------------------------------------------------
+
+
+def _fingerprints(
+    findings: Iterable[Finding],
+    line_text: Callable[[Finding], str],
+) -> list[tuple[Finding, str]]:
+    """Stable per-finding fingerprints: hash of (rule, path, message,
+    normalized line text, occurrence index) — immune to pure
+    line-number shifts. The message embeds the enclosing function's
+    qualname for most rules, so an identical violation added in a
+    DIFFERENT function cannot alias a grandfathered fingerprint."""
+    by_key: dict[tuple[str, str, str, str], int] = defaultdict(int)
+    out: list[tuple[Finding, str]] = []
+    for f in sorted(findings, key=lambda f: (f.path, f.line, f.col, f.rule)):
+        key = (f.rule, f.path, f.message, line_text(f))
+        k = by_key[key]
+        by_key[key] += 1
+        digest = hashlib.sha1(
+            "|".join([*key, str(k)]).encode("utf-8")
+        ).hexdigest()[:16]
+        out.append((f, digest))
+    return out
+
+
+def _default_line_text(root: str) -> Callable[[Finding], str]:
+    cache: dict[str, list[str]] = {}
+
+    def text(f: Finding) -> str:
+        if f.path not in cache:
+            try:
+                with open(
+                    os.path.join(root, f.path), "r", encoding="utf-8"
+                ) as fh:
+                    cache[f.path] = fh.read().splitlines()
+            except OSError:
+                cache[f.path] = []
+        lines = cache[f.path]
+        return lines[f.line - 1].strip() if 1 <= f.line <= len(lines) else ""
+
+    return text
+
+
+def load_baseline(path: str) -> set[str]:
+    """Fingerprints grandfathered by a committed baseline file."""
+    if not os.path.exists(path):
+        return set()
+    with open(path, "r", encoding="utf-8") as f:
+        data = json.load(f)
+    if data.get("version") != 1:
+        raise ValueError(f"{path}: unsupported baseline version")
+    return {e["fingerprint"] for e in data.get("entries", [])}
+
+
+def write_baseline(path: str, findings: Iterable[Finding], root: str) -> int:
+    """Write all current findings as the new baseline; returns count."""
+    entries = [
+        {
+            "fingerprint": fp,
+            "rule": f.rule,
+            "path": f.path,
+            "line": f.line,
+            "message": f.message,
+        }
+        for f, fp in _fingerprints(findings, _default_line_text(root))
+    ]
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump({"version": 1, "entries": entries}, fh, indent=2, sort_keys=True)
+        fh.write("\n")
+    return len(entries)
+
+
+def apply_baseline(
+    findings: Iterable[Finding], baseline: set[str], root: str
+) -> list[Finding]:
+    """Drop findings whose fingerprint the baseline grandfathers."""
+    return [
+        f
+        for f, fp in _fingerprints(findings, _default_line_text(root))
+        if fp not in baseline
+    ]

@@ -1,0 +1,11 @@
+"""Built-in bjx-lint rules; importing this package registers them."""
+
+from __future__ import annotations
+
+from blendjax.analysis.rules import (  # noqa: F401  (registration side effects)
+    deserialization,
+    hotpath,
+    purity,
+    resource_leak,
+    zmq_affinity,
+)

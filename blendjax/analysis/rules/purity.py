@@ -1,0 +1,189 @@
+"""BJX101 jit-purity: host side effects reachable from jit tracing.
+
+``jax.jit``/``pjit``/``shard_map`` trace a function ONCE and replay the
+captured computation; any Python side effect inside — ``print``,
+``time.time``, ``np.random`` draws, file I/O, module-global mutation —
+runs at trace time only (or worse, bakes a host value into the compiled
+graph) and silently disappears from subsequent steps. The rule marks
+functions decorated with (or passed to) a jit wrapper, walks the
+same-module call graph, and flags impure constructs anywhere reachable.
+"""
+
+from __future__ import annotations
+
+import ast
+from collections import defaultdict
+from typing import Iterator
+
+from blendjax.analysis.core import (
+    Finding,
+    ModuleContext,
+    Rule,
+    dotted_name,
+    register,
+)
+
+JIT_WRAPPERS = {"jit", "pjit", "shard_map"}
+PARTIAL_NAMES = {"partial", "functools.partial"}
+
+TIME_FUNCS = {
+    "time.time",
+    "time.perf_counter",
+    "time.monotonic",
+    "time.process_time",
+    "time.sleep",
+    "time.time_ns",
+    "time.perf_counter_ns",
+}
+
+
+def _last(name: str | None) -> str:
+    return (name or "").rsplit(".", 1)[-1]
+
+
+def _is_jit_wrapper(module: ModuleContext, node: ast.AST) -> bool:
+    return _last(module.resolve(node)) in JIT_WRAPPERS
+
+
+def _jit_decorated(module: ModuleContext, fn: ast.AST) -> bool:
+    for dec in getattr(fn, "decorator_list", []):
+        if _is_jit_wrapper(module, dec):
+            return True
+        if isinstance(dec, ast.Call):
+            if _is_jit_wrapper(module, dec.func):
+                return True  # @jit(static_argnums=...) style
+            if module.resolve(dec.func) in PARTIAL_NAMES and dec.args:
+                if _is_jit_wrapper(module, dec.args[0]):
+                    return True  # @partial(jax.jit, ...)
+    return False
+
+
+@register
+class JitPurityRule(Rule):
+    id = "BJX101"
+    name = "jit-purity"
+    description = (
+        "host side effect (print/time/np.random/open/global mutation) in a "
+        "function reachable from jax.jit, pjit, or shard_map"
+    )
+
+    def check(self, module: ModuleContext) -> Iterator[Finding]:
+        functions = list(module.iter_functions())
+        by_name: dict[str, list[str]] = defaultdict(list)
+        nodes: dict[str, ast.AST] = {}
+        for qual, fn, _cls in functions:
+            nodes[qual] = fn
+            by_name[fn.name].append(qual)
+
+        roots: set[str] = set()
+        lambdas: list[ast.Lambda] = []
+        for qual, fn, _cls in functions:
+            if _jit_decorated(module, fn):
+                roots.add(qual)
+        for node in ast.walk(module.tree):
+            if not (isinstance(node, ast.Call) and node.args):
+                continue
+            callee = node.func
+            # jit(f) and partial(jit, ...)(f)-style wrapping
+            wrapped = None
+            if _is_jit_wrapper(module, callee):
+                wrapped = node.args[0]
+            elif (
+                isinstance(callee, ast.Call)
+                and module.resolve(callee.func) in PARTIAL_NAMES
+                and callee.args
+                and _is_jit_wrapper(module, callee.args[0])
+            ):
+                wrapped = node.args[0]
+            if wrapped is None:
+                continue
+            if isinstance(wrapped, ast.Lambda):
+                lambdas.append(wrapped)
+            else:
+                name = _last(dotted_name(wrapped))
+                roots.update(by_name.get(name, []))
+
+        # Same-module call graph: edges by simple callee name (covers
+        # both helper(x) and self.helper(x)).
+        edges: dict[str, set[str]] = defaultdict(set)
+        for qual, fn, _cls in functions:
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Call):
+                    callee = _last(dotted_name(node.func))
+                    for target in by_name.get(callee, []):
+                        edges[qual].add(target)
+
+        reachable: set[str] = set()
+        frontier = list(roots)
+        while frontier:
+            qual = frontier.pop()
+            if qual in reachable:
+                continue
+            reachable.add(qual)
+            frontier.extend(edges[qual])
+
+        seen: set[tuple[int, int]] = set()
+        for qual in sorted(reachable):
+            yield from self._scan(module, nodes[qual], qual, seen)
+        for lam in lambdas:
+            yield from self._scan(module, lam, "<lambda>", seen)
+
+    def _scan(
+        self,
+        module: ModuleContext,
+        fn: ast.AST,
+        qual: str,
+        seen: set[tuple[int, int]],
+    ) -> Iterator[Finding]:
+        assigned: set[str] = set()
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Assign):
+                targets: list[ast.expr] = list(node.targets)
+            elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+                targets = [node.target]
+            else:
+                continue
+            assigned.update(
+                t.id for t in targets if isinstance(t, ast.Name)
+            )
+        for node in ast.walk(fn):
+            key = (getattr(node, "lineno", 0), getattr(node, "col_offset", 0))
+            msg = None
+            if isinstance(node, ast.Call):
+                resolved = module.resolve(node.func)
+                simple = dotted_name(node.func)
+                if simple == "print":
+                    msg = (
+                        f"print() in jit-reachable '{qual}' runs at trace "
+                        "time only (use jax.debug.print)"
+                    )
+                elif resolved in TIME_FUNCS:
+                    msg = (
+                        f"{resolved}() in jit-reachable '{qual}' is read "
+                        "once at trace time and baked into the graph"
+                    )
+                elif resolved is not None and resolved.startswith("numpy.random."):
+                    msg = (
+                        f"{resolved}() in jit-reachable '{qual}' draws host "
+                        "randomness at trace time (use jax.random with an "
+                        "explicit key)"
+                    )
+                elif simple == "open":
+                    msg = (
+                        f"open() in jit-reachable '{qual}' performs I/O "
+                        "under trace (hoist it out or use io_callback)"
+                    )
+            elif isinstance(node, ast.Global):
+                # Only a `global` name the function actually assigns is
+                # a mutation (a read-only declaration is pointless but
+                # harmless under trace).
+                mutated = [n for n in node.names if n in assigned]
+                if mutated:
+                    msg = (
+                        f"global mutation of {', '.join(mutated)} in "
+                        f"jit-reachable '{qual}' is a trace-time side "
+                        "effect"
+                    )
+            if msg and key not in seen:
+                seen.add(key)
+                yield self.finding(module, node, msg)

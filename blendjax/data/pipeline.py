@@ -1068,11 +1068,15 @@ class StreamDataPipeline:
 
     @classmethod
     def from_recording(cls, source, batch_size: int, loop: bool = False,
-                       allow_pickle: bool = True, **kwargs):
+                       allow_pickle: bool = False, **kwargs):
         """Replay a ``.bjr`` recording (path, path list, or prefix)
         through the full device pipeline — tile-delta recordings decode
         to bit-exact frames exactly like live traffic (the reference can
-        only replay into torch datasets, ``dataset.py:119-153``)."""
+        only replay into torch datasets, ``dataset.py:119-153``).
+
+        Untrusted-safe by default: pickle-bearing recordings (legacy
+        ``.btr``, or ``.bjr`` teed from pickle-codec producers) need an
+        explicit ``allow_pickle=True``."""
         from blendjax.data.replay import ReplayStream
 
         return cls(

@@ -106,9 +106,15 @@ class FileReader:
     Lazily opens the file handle on first read so instances can be shipped
     to worker processes (the reference reopens per worker for
     multiprocessing compatibility, ``file.py:102-108``).
+
+    ``allow_pickle`` defaults to ``False``: native ``.bjr`` recordings
+    are tensor-codec (pickle-free) and replay fully without it. Pass
+    ``allow_pickle=True`` only for recordings teed from trusted legacy
+    producers whose frames embed pickle (``PickleCodec`` wire frames or
+    ``pkl`` fallback entries) — unpickling is code execution.
     """
 
-    def __init__(self, path: str, allow_pickle: bool = True):
+    def __init__(self, path: str, allow_pickle: bool = False):
         self.path = path
         self.allow_pickle = allow_pickle
         self._offsets = _load_index(path)
@@ -191,12 +197,14 @@ class LegacyBtrReader:
     makes random access safe by warming the memo sequentially up to the
     highest index requested.
 
-    Pickle-gated: the format IS pickle, so constructing with
-    ``allow_pickle=False`` raises — recordings from untrusted sources
-    should be re-recorded to ``.bjr`` (tensor codec, pickle-free).
+    Pickle-gated: the format IS pickle, so the trust decision cannot be
+    implicit — ``allow_pickle`` defaults to ``False`` and constructing
+    without an explicit ``allow_pickle=True`` raises. Recordings from
+    untrusted sources should be re-recorded to ``.bjr`` (tensor codec,
+    pickle-free).
     """
 
-    def __init__(self, path: str, allow_pickle: bool = True):
+    def __init__(self, path: str, allow_pickle: bool = False):
         if not allow_pickle:
             raise ValueError(
                 f"{path}: legacy .btr recordings are pickle streams; "
@@ -277,9 +285,14 @@ class LegacyBtrReader:
             self._file = None
 
 
-def open_reader(path: str, allow_pickle: bool = True):
+def open_reader(path: str, allow_pickle: bool = False):
     """Reader for one recording: ``.bjr`` (blendjax wire container) or a
-    reference ``.btr`` (legacy pickle, see :class:`LegacyBtrReader`)."""
+    reference ``.btr`` (legacy pickle, see :class:`LegacyBtrReader`).
+
+    Untrusted-safe by default: ``allow_pickle=False`` replays native
+    tensor-codec ``.bjr`` files fully and refuses pickle everywhere
+    (``.btr`` construction raises). Opt in per call site for trusted
+    legacy recordings."""
     if str(path).endswith(".btr"):
         return LegacyBtrReader(path, allow_pickle=allow_pickle)
     return FileReader(path, allow_pickle=allow_pickle)
@@ -304,11 +317,12 @@ class ReplayStream:
 
     ``source`` may be one recording path (``.bjr``, or a reference
     ``.btr`` — legacy pickle recordings replay through the same
-    pipeline), a list of paths, or a recording prefix (globs
-    ``{prefix}_*.bjr`` + ``{prefix}_*.btr`` like :class:`FileDataset`).
+    pipeline, behind an explicit ``allow_pickle=True``), a list of
+    paths, or a recording prefix (globs ``{prefix}_*.bjr`` +
+    ``{prefix}_*.btr`` like :class:`FileDataset`).
     """
 
-    def __init__(self, source, allow_pickle: bool = True, loop: bool = False):
+    def __init__(self, source, allow_pickle: bool = False, loop: bool = False):
         if isinstance(source, str):
             if os.path.exists(source):
                 paths = [source]
@@ -341,7 +355,8 @@ class ReplayStream:
 class SingleFileDataset:
     """Map-style dataset over one recording (reference ``dataset.py:119-132``)."""
 
-    def __init__(self, path: str, item_transform=None, allow_pickle: bool = True):
+    def __init__(self, path: str, item_transform=None,
+                 allow_pickle: bool = False):
         self.reader = open_reader(path, allow_pickle=allow_pickle)
         self.item_transform = item_transform or (lambda x: x)
 
@@ -358,7 +373,7 @@ class FileDataset:
     no producers running."""
 
     def __init__(self, record_path_prefix: str, item_transform=None,
-                 allow_pickle: bool = True):
+                 allow_pickle: bool = False):
         paths = _glob_recordings(record_path_prefix)
         if not paths:
             raise FileNotFoundError(

@@ -10,6 +10,9 @@ shape via ``blendjax.data.torch_compat``.
 
 from __future__ import annotations
 
+# bjx: hot-path (the live receive loop: BJX102 flags any blocking
+# device sync added to this module)
+
 from blendjax import constants
 from blendjax.data.replay import FileRecorder
 from blendjax.transport import DataReceiverSocket, ReceiveTimeoutError

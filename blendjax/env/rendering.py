@@ -29,7 +29,7 @@ class ArrayRenderer:
 RENDER_BACKENDS["array"] = ArrayRenderer
 
 try:  # pragma: no cover - depends on env
-    import matplotlib
+    import matplotlib  # noqa: F401  (availability probe for the backend)
 
     class MatplotlibRenderer:
         """Interactive imshow window (reference ``env_rendering.py:29-57``)."""

@@ -2,14 +2,12 @@
 
 from __future__ import annotations
 
-import functools
-
 import jax
 import jax.numpy as jnp
 import optax
 from flax.training.train_state import TrainState
 
-from blendjax.parallel.sharding import param_sharding_rules, replicated
+from blendjax.parallel.sharding import param_sharding_rules
 
 
 def make_train_state(

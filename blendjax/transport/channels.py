@@ -19,6 +19,9 @@ Failure semantics are fail-fast: a poll timeout raises
 
 from __future__ import annotations
 
+# bjx: hot-path (recv/decode sits on the ingest critical path: BJX102
+# flags any blocking device sync added to this module)
+
 import os
 import threading
 

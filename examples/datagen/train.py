@@ -27,6 +27,10 @@ def main():
     ap.add_argument("--record", default=None, help="record stream to PREFIX")
     ap.add_argument("--replay", default=None, help="replay from PREFIX (no producers)")
     ap.add_argument(
+        "--allow-pickle", action="store_true",
+        help="trust pickle-bearing recordings (legacy .btr) on --replay",
+    )
+    ap.add_argument(
         "--encoding", choices=["raw", "tile", "pal"], default="raw",
         help="'tile' streams only changed tiles (decoded on device); "
         "'pal' palette-compresses whole frames (the lossless non-sparse "
@@ -112,7 +116,7 @@ def main():
         # traffic (tile-delta recordings included), looping like epochs.
         pipe = StreamDataPipeline.from_recording(
             args.replay, batch_size=args.batch, sharding=sharding, loop=True,
-            chunk=chunk,
+            chunk=chunk, allow_pickle=args.allow_pickle,
         )
         with pipe:
             run_steps(iter(pipe))

@@ -1,0 +1,32 @@
+#!/usr/bin/env python
+"""Shim so CI and pre-commit hooks can run bjx-lint without installing
+the package: ``python scripts/bjx_lint.py [args...]`` == ``python -m
+blendjax.analysis [args...]`` run from the repo root (relative path
+arguments are resolved against the INVOKER's cwd first, so the shim
+really is runnable from anywhere)."""
+
+import os
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+VALUE_OPTS = {"--select", "--baseline", "--format"}
+
+if __name__ == "__main__":
+    # Pin positional path args to the invoker's cwd before we chdir to
+    # the repo root (where the default targets and baseline live).
+    # Option VALUES (--format json, --select BJX101) are never
+    # rewritten, even if a same-named file happens to exist here.
+    argv = []
+    expect_value = False
+    for a in sys.argv[1:]:
+        if expect_value or a.startswith("-"):
+            argv.append(a)
+            expect_value = not expect_value and a in VALUE_OPTS
+        else:
+            argv.append(os.path.abspath(a) if os.path.exists(a) else a)
+    sys.path.insert(0, REPO_ROOT)
+    os.chdir(REPO_ROOT)
+    from blendjax.analysis.__main__ import main
+
+    sys.exit(main(argv))

@@ -1,0 +1,601 @@
+"""bjx-lint (blendjax.analysis) tests: one true positive AND one true
+negative per rule, inline-suppression and baseline mechanics, CLI exit
+codes, and the self-gate (the repo itself stays clean)."""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+from blendjax.analysis import (
+    analyze_paths,
+    analyze_source,
+    load_baseline,
+    write_baseline,
+)
+from blendjax.analysis.core import all_rules, apply_baseline
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def findings(source, relpath="mod.py", select=None):
+    return analyze_source(
+        textwrap.dedent(source), relpath, select=set(select) if select else None
+    )
+
+
+def rule_ids(source, relpath="mod.py", select=None):
+    return [f.rule for f in findings(source, relpath, select)]
+
+
+# -- BJX101 jit-purity ------------------------------------------------------
+
+
+def test_bjx101_flags_side_effects_in_jit_decorated_function():
+    got = findings(
+        """
+        import time
+
+        import jax
+        import numpy as np
+
+        @jax.jit
+        def step(x):
+            print("x =", x)
+            t = time.time()
+            noise = np.random.rand(4)
+            return x + noise + t
+        """
+    )
+    assert [f.rule for f in got] == ["BJX101"] * 3
+    assert "print()" in got[0].message
+    assert "time.time" in got[1].message
+    assert "numpy.random" in got[2].message
+
+
+def test_bjx101_reaches_through_call_graph_and_partial_and_lambda():
+    got = findings(
+        """
+        import functools
+
+        import jax
+
+        def helper(x):
+            print(x)
+            return x
+
+        @functools.partial(jax.jit, static_argnames=("k",))
+        def outer(x, k=1):
+            return helper(x) * k
+
+        def wrap(x):
+            return jax.jit(lambda y: print(y))(x)
+        """
+    )
+    quals = {f.message.split("'")[1] for f in got}
+    assert quals == {"helper", "<lambda>"}
+
+
+def test_bjx101_negative_host_side_code_and_jax_random():
+    assert (
+        rule_ids(
+            """
+            import jax
+
+            def host_loop(batches):
+                for b in batches:
+                    print("host logging is fine outside jit", b)
+
+            @jax.jit
+            def step(x, key):
+                noise = jax.random.normal(key, x.shape)
+                jax.debug.print("traced-safe {x}", x=x)
+                return x + noise
+            """
+        )
+        == []
+    )
+
+
+def test_bjx101_global_mutation_flagged_but_readonly_global_is_not():
+    got = findings(
+        """
+        import jax
+
+        _step_count = 0
+        _config = {}
+
+        @jax.jit
+        def counted(x):
+            global _step_count
+            _step_count = _step_count + 1
+            return x
+
+        @jax.jit
+        def reader(x):
+            global _config
+            return x * len(_config)
+        """
+    )
+    assert [f.rule for f in got] == ["BJX101"]
+    assert "_step_count" in got[0].message
+
+
+# -- BJX102 host-sync-in-hot-path -------------------------------------------
+
+HOT_SYNC = """
+    import jax
+    import numpy as np
+
+    def feed(batches):
+        for b in batches:
+            db = jax.device_put(b)
+            db.block_until_ready()
+            x = float(np.asarray(db))
+            yield x
+"""
+
+
+def test_bjx102_flags_sync_in_hot_module():
+    got = findings(HOT_SYNC, relpath="blendjax/data/pipeline.py")
+    assert [f.rule for f in got] == ["BJX102"] * 3
+
+
+def test_bjx102_hot_marker_opts_a_module_in():
+    marked = "# bjx: hot-path\n" + textwrap.dedent(HOT_SYNC)
+    assert all(
+        f.rule == "BJX102" for f in analyze_source(marked, "anywhere.py")
+    )
+    assert len(analyze_source(marked, "anywhere.py")) == 3
+
+
+def test_bjx102_marker_in_docstring_does_not_opt_in():
+    doc = '"""Module that merely DOCUMENTS the bjx: hot-path marker."""\n'
+    assert analyze_source(doc + textwrap.dedent(HOT_SYNC), "anywhere.py") == []
+
+
+def test_bjx102_negative_outside_hot_path_and_benign_hot_code():
+    # same sync code in a non-hot module: silent
+    assert rule_ids(HOT_SYNC, relpath="blendjax/train/bench_tool.py") == []
+    # hot module doing async placement only: silent
+    assert (
+        rule_ids(
+            """
+            import jax
+
+            def feed(batches):
+                for b in batches:
+                    yield jax.device_put(b)
+            """,
+            relpath="blendjax/data/pipeline.py",
+        )
+        == []
+    )
+
+
+# -- BJX103 unsafe-deserialization ------------------------------------------
+
+
+def test_bjx103_flags_ungated_pickle():
+    got = findings(
+        """
+        import pickle
+
+        def load(blob):
+            return pickle.loads(blob)
+        """
+    )
+    assert [f.rule for f in got] == ["BJX103"]
+
+
+def test_bjx103_negatives_gated_and_trusted_and_dumps():
+    assert (
+        rule_ids(
+            """
+            import pickle
+
+            def load(blob, allow_pickle=False):
+                if not allow_pickle:
+                    raise ValueError("untrusted")
+                return pickle.loads(blob)
+
+            class Reader:
+                def __init__(self, path, allow_pickle=False):
+                    self.allow_pickle = allow_pickle
+
+                def _open(self, f):
+                    return pickle.Unpickler(f)
+
+            def save(obj):
+                return pickle.dumps(obj)
+
+            def load_cache(blob):
+                # bjx: trusted-source (bytes we wrote ourselves above)
+                return pickle.loads(blob)
+            """
+        )
+        == []
+    )
+
+
+# -- BJX104 zmq-thread-affinity ---------------------------------------------
+
+
+def test_bjx104_flags_socket_crossing_thread_boundary():
+    got = findings(
+        """
+        import threading
+
+        import zmq
+
+        class Pump:
+            def __init__(self, ctx):
+                self.sock = ctx.socket(zmq.PULL)
+                self._thread = threading.Thread(target=self._run)
+
+            def _run(self):
+                while True:
+                    self._drain()
+
+            def _drain(self):
+                self.sock.recv()
+        """
+    )
+    assert [f.rule for f in got] == ["BJX104"]
+    assert "self.sock" in got[0].message and "_run" in got[0].message
+
+
+def test_bjx104_flags_positional_thread_target():
+    got = findings(
+        """
+        import threading
+
+        import zmq
+
+        class Pump:
+            def __init__(self, ctx):
+                self.sock = ctx.socket(zmq.PULL)
+                self._thread = threading.Thread(None, self._run)
+
+            def _run(self):
+                self.sock.recv()
+        """
+    )
+    assert [f.rule for f in got] == ["BJX104"]
+
+
+def test_bjx104_negatives_same_thread_and_annotated():
+    # socket created inside the thread target itself: correct affinity
+    assert (
+        rule_ids(
+            """
+            import threading
+
+            import zmq
+
+            class Pump:
+                def __init__(self, ctx):
+                    self.ctx = ctx
+                    self._thread = threading.Thread(target=self._run)
+
+                def _run(self):
+                    self.sock = self.ctx.socket(zmq.PULL)
+                    self.sock.recv()
+            """
+        )
+        == []
+    )
+    # explicit ownership-transfer annotation
+    assert (
+        rule_ids(
+            """
+            import threading
+
+            import zmq
+
+            class Pump:
+                def __init__(self, ctx):
+                    self.sock = ctx.socket(zmq.PULL)  # bjx: thread-owner
+                    self._thread = threading.Thread(target=self._run)
+
+                def _run(self):
+                    self.sock.recv()
+            """
+        )
+        == []
+    )
+
+
+# -- BJX105 socket-leak -----------------------------------------------------
+
+
+def test_bjx105_flags_leak_and_partial_close():
+    got = findings(
+        """
+        import zmq
+
+        def leaky(ctx):
+            sock = ctx.socket(zmq.PUSH)
+            sock.send(b"x")
+
+        def conditional(ctx, flag):
+            sock = ctx.socket(zmq.PULL)
+            if flag:
+                sock.close()
+        """
+    )
+    assert [f.rule for f in got] == ["BJX105"] * 2
+    assert "never closed" in got[0].message
+    assert "some paths" in got[1].message
+
+
+def test_bjx105_using_the_socket_is_not_an_ownership_transfer():
+    got = findings(
+        """
+        import zmq
+
+        def recv_leak(ctx):
+            sock = ctx.socket(zmq.PULL)
+            msg = sock.recv()
+            return msg
+
+        def print_leak(ctx):
+            sock = ctx.socket(zmq.PULL)
+            print(sock.recv())
+        """
+    )
+    assert [f.rule for f in got] == ["BJX105"] * 2
+
+
+def test_bjx105_container_store_is_a_transfer():
+    assert (
+        rule_ids(
+            """
+            import zmq
+
+            def pooled(ctx, pool):
+                sock = ctx.socket(zmq.PUSH)
+                pool.append(sock)
+
+            def listed(ctx):
+                socks = [ctx.socket(zmq.PUSH) for _ in range(2)]
+                extra = ctx.socket(zmq.PULL)
+                bundle = (extra, socks)
+                return bundle
+            """
+        )
+        == []
+    )
+
+
+def test_bjx105_negatives_finally_with_transfer():
+    assert (
+        rule_ids(
+            """
+            import zmq
+
+            def closed(ctx):
+                sock = ctx.socket(zmq.PULL)
+                try:
+                    sock.recv()
+                finally:
+                    sock.close()
+
+            def managed(ctx):
+                with ctx.socket(zmq.PUB) as sock:
+                    sock.send(b"x")
+
+            def handed_off(ctx):
+                sock = ctx.socket(zmq.PUSH)
+                return sock
+
+            class Holder:
+                def __init__(self, ctx):
+                    self.sock = ctx.socket(zmq.PAIR)
+            """
+        )
+        == []
+    )
+
+
+def test_bjx105_negative_create_and_close_inside_branch_or_loop():
+    assert (
+        rule_ids(
+            """
+            import zmq
+
+            def branch(ctx, flag):
+                if flag:
+                    sock = ctx.socket(zmq.PULL)
+                    sock.recv()
+                    sock.close()
+
+            def loop(ctx, addrs):
+                for a in addrs:
+                    sock = ctx.socket(zmq.PUSH)
+                    try:
+                        sock.connect(a)
+                    finally:
+                        sock.close()
+            """
+        )
+        == []
+    )
+
+
+def test_bjx102_lambda_body_is_scanned_in_hot_module():
+    got = findings(
+        """
+        def make_waiter():
+            return lambda arr: arr.block_until_ready()
+        """,
+        relpath="blendjax/data/pipeline.py",
+    )
+    assert [f.rule for f in got] == ["BJX102"]
+
+
+# -- suppression / baseline / CLI -------------------------------------------
+
+LEAKY = """
+    import zmq
+
+    def leaky(ctx):
+        sock = ctx.socket(zmq.PUSH)
+        sock.send(b"x")
+"""
+
+
+def test_inline_ignore_suppresses_by_rule_and_bare():
+    src = """
+        import zmq
+
+        def leaky(ctx):
+            sock = ctx.socket(zmq.PUSH)  # bjx: ignore[BJX105]
+            sock.send(b"x")
+
+        def leaky2(ctx):
+            # bjx: ignore
+            sock = ctx.socket(zmq.PUSH)
+            sock.send(b"x")
+    """
+    assert rule_ids(src) == []
+    # wrong rule id in the marker does NOT suppress
+    assert (
+        rule_ids(
+            """
+            import zmq
+
+            def leaky(ctx):
+                sock = ctx.socket(zmq.PUSH)  # bjx: ignore[BJX101]
+                sock.send(b"x")
+            """
+        )
+        == ["BJX105"]
+    )
+
+
+def test_baseline_roundtrip_suppresses_and_survives_line_shifts(tmp_path):
+    mod = tmp_path / "leak.py"
+    mod.write_text(textwrap.dedent(LEAKY))
+    base = str(tmp_path / "baseline.json")
+    got = analyze_paths([str(mod)], root=str(tmp_path))
+    assert [f.rule for f in got] == ["BJX105"]
+    assert write_baseline(base, got, str(tmp_path)) == 1
+    # baselined: nothing reported
+    assert apply_baseline(got, load_baseline(base), str(tmp_path)) == []
+    # unrelated lines added above: fingerprint (line-content keyed) holds
+    mod.write_text("# a new header comment\nX = 1\n" + textwrap.dedent(LEAKY))
+    shifted = analyze_paths([str(mod)], root=str(tmp_path))
+    assert [f.rule for f in shifted] == ["BJX105"]
+    assert apply_baseline(shifted, load_baseline(base), str(tmp_path)) == []
+    # a NEW finding is still reported alongside the baselined one
+    mod.write_text(
+        textwrap.dedent(LEAKY)
+        + textwrap.dedent(
+            """
+            def leaky_b(ctx):
+                s2 = ctx.socket(zmq.PULL)
+                s2.recv()
+            """
+        )
+    )
+    both = analyze_paths([str(mod)], root=str(tmp_path))
+    left = apply_baseline(both, load_baseline(base), str(tmp_path))
+    assert len(both) == 2 and len(left) == 1
+    assert "s2" in left[0].message
+
+
+def test_baseline_does_not_alias_identical_line_in_new_function(tmp_path):
+    """A brand-new violation textually identical to a grandfathered one
+    (same source line, earlier in the file, different function) must NOT
+    inherit the baselined fingerprint."""
+    mod = tmp_path / "leak.py"
+    mod.write_text(textwrap.dedent(LEAKY))
+    base = str(tmp_path / "baseline.json")
+    write_baseline(
+        base, analyze_paths([str(mod)], root=str(tmp_path)), str(tmp_path)
+    )
+    mod.write_text(
+        textwrap.dedent(
+            """
+            import zmq
+
+            def newer(ctx):
+                sock = ctx.socket(zmq.PUSH)
+                sock.send(b"y")
+            """
+        )
+        + textwrap.dedent(LEAKY)
+    )
+    left = apply_baseline(
+        analyze_paths([str(mod)], root=str(tmp_path)),
+        load_baseline(base),
+        str(tmp_path),
+    )
+    assert [f.rule for f in left] == ["BJX105"]
+    assert "'newer'" in left[0].message
+
+
+def test_cli_exit_codes_and_json(tmp_path):
+    mod = tmp_path / "fixture.py"
+    mod.write_text(textwrap.dedent(LEAKY))
+    env = {**os.environ, "PYTHONPATH": REPO_ROOT, "JAX_PLATFORMS": "cpu"}
+
+    def run(*args):
+        return subprocess.run(
+            [sys.executable, "-m", "blendjax.analysis", *args],
+            capture_output=True, text=True, cwd=str(tmp_path), env=env,
+        )
+
+    bad = run(str(mod), "--format", "json")
+    assert bad.returncode == 1
+    data = json.loads(bad.stdout)
+    assert data[0]["rule"] == "BJX105"
+
+    wrote = run(str(mod), "--write-baseline")
+    assert wrote.returncode == 0
+    clean = run(str(mod))
+    assert clean.returncode == 0, clean.stdout + clean.stderr
+
+    ok = run("--list-rules")
+    assert ok.returncode == 0
+    for rule_id in ("BJX101", "BJX102", "BJX103", "BJX104", "BJX105"):
+        assert rule_id in ok.stdout
+
+
+def test_select_restricts_rules():
+    src = """
+        import pickle
+        import zmq
+
+        def both(ctx, blob):
+            sock = ctx.socket(zmq.PUSH)
+            return pickle.loads(blob)
+    """
+    assert sorted(rule_ids(src)) == ["BJX103", "BJX105"]
+    assert rule_ids(src, select=["BJX103"]) == ["BJX103"]
+
+
+def test_syntax_error_reports_bjx000():
+    got = analyze_source("def broken(:\n", "bad.py")
+    assert [f.rule for f in got] == ["BJX000"]
+
+
+def test_every_rule_registered():
+    assert set(all_rules()) == {
+        "BJX101", "BJX102", "BJX103", "BJX104", "BJX105",
+    }
+
+
+# -- self-gate ---------------------------------------------------------------
+
+
+def test_repo_is_clean_under_baseline():
+    """The CI contract: ``python -m blendjax.analysis blendjax/`` exits 0."""
+    baseline = load_baseline(os.path.join(REPO_ROOT, ".bjx-baseline.json"))
+    got = analyze_paths(
+        [os.path.join(REPO_ROOT, "blendjax")], root=REPO_ROOT
+    )
+    left = apply_baseline(got, baseline, REPO_ROOT)
+    assert left == [], "\n".join(f.render() for f in left)

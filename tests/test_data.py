@@ -128,7 +128,7 @@ def test_legacy_btr_reader_roundtrip(tmp_path):
     msgs = [_item(i) for i in range(6)]
     _write_reference_btr(path, msgs)
 
-    r = LegacyBtrReader(path)
+    r = LegacyBtrReader(path, allow_pickle=True)
     assert len(r) == 6
     for i in (4, 0, 5, 2, 2, 1):  # out-of-order on purpose
         got = r[i]
@@ -136,9 +136,12 @@ def test_legacy_btr_reader_roundtrip(tmp_path):
         np.testing.assert_array_equal(got["image"], msgs[i]["image"])
         np.testing.assert_array_equal(got["xy"], msgs[i]["xy"])
     r.close()
-    # pickle gate: the format IS pickle, refuse allow_pickle=False
+    # pickle gate: the format IS pickle, and the gate defaults closed —
+    # both the explicit refusal and the untrusted default raise
     with pytest.raises(ValueError, match="pickle"):
         LegacyBtrReader(path, allow_pickle=False)
+    with pytest.raises(ValueError, match="pickle"):
+        LegacyBtrReader(path)
 
 
 def test_legacy_btr_through_pipeline_and_datasets(tmp_path):
@@ -155,7 +158,7 @@ def test_legacy_btr_through_pipeline_and_datasets(tmp_path):
             rec.save(encode_message(_item(10 + i)))
 
     with StreamDataPipeline.from_recording(
-        f"{prefix}_00.btr", batch_size=2
+        f"{prefix}_00.btr", batch_size=2, allow_pickle=True
     ) as pipe:
         batches = list(pipe)
     assert len(batches) == 2
@@ -166,9 +169,16 @@ def test_legacy_btr_through_pipeline_and_datasets(tmp_path):
         _item(int(np.asarray(batches[0]["frameid"])[0]))["image"],
     )
 
-    ds = FileDataset(prefix)  # globs *.bjr AND *.btr
+    # globs *.bjr AND *.btr; the .btr half is pickle, so the mixed glob
+    # needs the explicit trust opt-in (the default refuses to construct)
+    with pytest.raises(ValueError, match="pickle"):
+        FileDataset(prefix)
+    ds = FileDataset(prefix, allow_pickle=True)
     assert len(ds) == 6
-    assert SingleFileDataset(f"{prefix}_00.btr")[3]["frameid"] == 3
+    assert (
+        SingleFileDataset(f"{prefix}_00.btr", allow_pickle=True)[3]["frameid"]
+        == 3
+    )
 
 
 def test_file_dataset_glob_concat(tmp_path):
