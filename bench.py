@@ -33,9 +33,13 @@ import numpy as np
 BATCH = 8
 SHAPE = (480, 640)
 WARMUP_BATCHES = 4
-MEASURE_ITEMS = 512
+# Workload size / cap are env-tunable so the CI bench-smoke job can run
+# the WHOLE harness (producers, pipeline, record assembly) in seconds on
+# a CPU runner — the knobs shrink the measurement, never change its
+# shape, so the smoke record stays structurally identical to a real one.
+MEASURE_ITEMS = int(os.environ.get("BLENDJAX_BENCH_MEASURE_ITEMS", "512"))
 BASELINE_IMG_PER_SEC = 1.0 / 0.012  # Readme.md:92, 4 instances
-TIME_CAP_S = 120.0
+TIME_CAP_S = float(os.environ.get("BLENDJAX_BENCH_TIME_CAP_S", "120"))
 ENCODING = os.environ.get("BLENDJAX_BENCH_ENCODING", "tile")
 # chunk=16 beat 8 in every interleaved A/B pair (r3): fewer queued ops
 # per image matters most exactly when the tunnel adds per-op stalls.
@@ -55,6 +59,11 @@ TRANSFORMER_ROW = (
 # the next group's wait) measured neutral-to-negative on the serialized
 # tunnel runtime — off by default, kept for direct-attached hosts.
 OVERLAP = os.environ.get("BLENDJAX_BENCH_OVERLAP", "0") == "1"
+# Ingest worker pool A/B row (docs/performance.md "choosing
+# ingest_workers"): measures the tile stream at ingest_workers=1 vs 2 so
+# the sharded recv/decode pool's win (or non-win, on 1-core hosts) is
+# re-evidenced every round. Off in degraded windows like the other rows.
+INGEST_AB = os.environ.get("BLENDJAX_BENCH_INGEST_AB", "1") == "1"
 # The non-sparse row's codec: 'pal' (lossless full-frame palette; 4-8x
 # fewer bytes across socket AND host->device, decoded by a device
 # gather) or 'raw' (uncompressed frames). pal chunk-groups 8 batches
@@ -206,14 +215,18 @@ def ceiling_ratio_row(ips: float, ceiling: dict, headline_fit: bool):
 
 def measure(encoding: str, chunk: int, items: int, time_cap: float,
             with_stages: bool = True, tile_args=None,
-            tile_capacity=None, model=None, loss_fn=None) -> dict:
+            tile_capacity=None, model=None, loss_fn=None,
+            ingest_workers: int = 1) -> dict:
     """One full producer-fleet + pipeline + train measurement pass.
 
     ``tile_args``/``tile_capacity`` default to the module-level bench
     configuration; A/B scripts pass explicit values instead of mutating
     module globals (ADVICE r4). ``model``/``loss_fn`` default to the
     headline CubeRegressor with the corner loss; the transformer row
-    passes a StreamFormer + reshaping loss instead."""
+    passes a StreamFormer + reshaping loss instead. ``ingest_workers``
+    feeds straight through to ``StreamDataPipeline`` (>=2 shards the
+    consumer's receive/decode across threads; the per-shard
+    ``ingest.recv.shard*`` spans land in the stage breakdown)."""
     import jax
 
     from blendjax.data import StreamDataPipeline
@@ -323,6 +336,7 @@ def measure(encoding: str, chunk: int, items: int, time_cap: float,
             sharding=sharding,
             chunk=chunk,
             emit_packed=chunk > 1 and FUSED,
+            ingest_workers=ingest_workers,
             timeoutms=60_000,
         ) as pipe:
             it = iter(pipe)
@@ -415,7 +429,7 @@ def measure(encoding: str, chunk: int, items: int, time_cap: float,
             },
             "counters": {
                 k: int(v) for k, v in reg.counters.items()
-                if k.startswith(("tiles.", "ingest.", "pal."))
+                if k.startswith(("tiles.", "ingest.", "pal.", "wire."))
             },
         }
     return result
@@ -798,6 +812,43 @@ def measure_transformer_row(chunk: int) -> dict:
     return row
 
 
+def measure_ingest_workers_ab(chunk: int, items: int | None = None,
+                              time_cap: float = 30.0) -> dict:
+    """Interleaved ingest_workers=1 vs 2 A/B on the live tile stream.
+
+    Each leg keeps its stage breakdown's ingest slice: the per-shard
+    ``ingest.recv.shard*`` spans evidence whether the second worker
+    actually overlapped receive+decode (two busy shards) or just idled
+    behind one hot producer, and the ``wire.*`` byte pair rides along
+    for the compression accounting. ``value`` is the workers-2 /
+    workers-1 throughput ratio (>1 means the pool wins on this host)."""
+    items = min(192, MEASURE_ITEMS) if items is None else items
+    row: dict = {}
+    for workers in (1, 2):
+        leg = measure(
+            ENCODING, chunk, items, time_cap,
+            with_stages=True, ingest_workers=workers,
+        )
+        stages = leg.get("stages", {})
+        row[f"workers{workers}"] = {
+            "img_s": leg["value"],
+            "images": leg["images"],
+            "seconds": leg["seconds"],
+            "recv_spans": {
+                k: v for k, v in stages.get("spans", {}).items()
+                if k.startswith("ingest.recv")
+            },
+            "wire": {
+                k: v for k, v in stages.get("counters", {}).items()
+                if k.startswith("wire.")
+            },
+        }
+    row["value"] = round(
+        row["workers2"]["img_s"] / max(row["workers1"]["img_s"], 1e-9), 3
+    )
+    return row
+
+
 def measure_rl_hz(seconds: float = 3.0) -> dict:
     """Full REQ/REP rendezvous stepping rate, rendering off (the
     reference's '2000 Hz are easily achieved' row, ``Readme.md:95``;
@@ -1075,7 +1126,9 @@ def _build_record(progress: dict) -> dict:
             # the headline were measured in fit windows (VERDICT r4 #1:
             # the cross-window ratio is meaningless).
             ceil = gated_row(
-                lambda: measure_pipelined_ceiling(primary["chunk"]),
+                lambda: measure_pipelined_ceiling(
+                    primary["chunk"], items=min(512, MEASURE_ITEMS)
+                ),
                 budget=240.0,
             )
             detail["pipelined_ceiling"] = ceil
@@ -1098,7 +1151,8 @@ def _build_record(progress: dict) -> dict:
                 lambda: measure(
                     RAW_ENCODING,
                     RAW_CHUNK if RAW_ENCODING == "pal" else 1,
-                    256 if RAW_ENCODING == "pal" else 128,
+                    min(256 if RAW_ENCODING == "pal" else 128,
+                        MEASURE_ITEMS),
                     45.0,
                     with_stages=True,
                 ),
@@ -1121,6 +1175,19 @@ def _build_record(progress: dict) -> dict:
             detail["raw_row"] = raw
         except Exception as e:  # pragma: no cover - device flake path
             detail["raw_row"] = {"error": repr(e)[:200]}
+    if ENCODING == "tile" and INGEST_AB and not degraded:
+        # Sharded-ingest A/B (same weather regime as the headline): does
+        # a second recv/decode worker raise end-to-end img/s on THIS
+        # host? On the 1-core dev box the expected answer is ~1.0 (the
+        # workers share the core); the row exists so multi-core consumer
+        # hosts get a measured answer instead of a doc claim.
+        try:
+            detail["ingest_workers_ab"] = gated_row(
+                lambda: measure_ingest_workers_ab(primary["chunk"]),
+                budget=150.0, attempts=1,
+            )
+        except Exception as e:  # pragma: no cover - device flake path
+            detail["ingest_workers_ab"] = {"error": repr(e)[:200]}
     if (
         ENCODING == "tile" and TRANSFORMER_ROW and not degraded
         and jax.default_backend() == "tpu"

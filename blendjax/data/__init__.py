@@ -24,8 +24,12 @@ from blendjax.data.replay import (
     SingleFileDataset,
 )
 from blendjax.data.schema import StreamSchema
-from blendjax.data.stream import RemoteStream
+from blendjax.data.stream import RemoteStream, partition_addresses
 from blendjax.data.batcher import BatchAssembler, HostIngest
+from blendjax.data.shard_ingest import (
+    ParallelBatchAssembler,
+    ShardedHostIngest,
+)
 from blendjax.data.pipeline import (
     DeviceFeeder,
     StreamDataPipeline,
@@ -35,8 +39,11 @@ from blendjax.data.pipeline import (
 __all__ = [
     "StreamSchema",
     "RemoteStream",
+    "partition_addresses",
     "BatchAssembler",
     "HostIngest",
+    "ParallelBatchAssembler",
+    "ShardedHostIngest",
     "DeviceFeeder",
     "StreamDataPipeline",
     "TileStreamDecoder",

@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import queue
 import threading
+import time
 
 import numpy as np
 
@@ -20,6 +21,83 @@ from blendjax.utils.logging import get_logger
 from blendjax.utils.metrics import metrics
 
 logger = get_logger("data")
+
+
+def batched_views(item: dict):
+    """Per-item views of a producer-batched message (``_batched=True``:
+    every ndarray field carries a leading batch dim). Fields whose
+    leading dim doesn't match — scalar sidecars, shared per-batch
+    arrays — are replicated as-is into every item."""
+    lead = next(
+        (
+            v.shape[0]
+            for v in item.values()
+            if isinstance(v, np.ndarray) and v.ndim > 0
+        ),
+        0,
+    )
+    for i in range(lead):
+        yield {
+            k: v[i]
+            if isinstance(v, np.ndarray) and v.shape[:1] == (lead,)
+            else v
+            for k, v in item.items()
+        }
+
+
+def passthrough_batch(item: dict, schema: StreamSchema, batch_size: int):
+    """A producer-batched item whose leading dim equals ``batch_size``
+    and whose fields match the schema is already a batch: hand it on
+    with zero copies (the batch-publishing producer's fast path).
+    Returns None when any field mismatches (caller splits instead)."""
+    for k, spec in schema.fields.items():
+        v = item.get(k)
+        if not (
+            isinstance(v, np.ndarray)
+            and v.shape == (batch_size, *spec.shape)
+            and v.dtype == spec.dtype
+        ):
+            return None
+    batch = {k: item[k] for k in schema.fields}
+    meta = {k: item[k] for k in schema.meta_keys if k in item}
+    batch["_meta"] = [
+        {
+            k: v[i]
+            if isinstance(v, np.ndarray) and len(v) == batch_size
+            else v
+            for k, v in meta.items()
+        }
+        for i in range(batch_size)
+    ]
+    return batch
+
+
+def prebatched_lead(item: dict) -> int | None:
+    """Leading dim of an opaque producer-assembled (``_prebatched``)
+    message: a ``*__tileidx`` field's is authoritative for tile messages
+    (sidecar palette/keyframe arrays carry unrelated leading dims); the
+    first array field covers other prebatched producers."""
+    from blendjax.ops.tiles import TILEIDX_SUFFIX
+
+    lead = next(
+        (
+            v.shape[0]
+            for k, v in item.items()
+            if k.endswith(TILEIDX_SUFFIX)
+            and isinstance(v, np.ndarray) and v.ndim > 0
+        ),
+        None,
+    )
+    if lead is None:
+        lead = next(
+            (
+                v.shape[0]
+                for v in item.values()
+                if isinstance(v, np.ndarray) and v.ndim > 0
+            ),
+            0,
+        )
+    return lead
 
 
 class BatchAssembler:
@@ -62,6 +140,23 @@ class BatchAssembler:
         self._active = (self._active + 1) % len(self._pool)
         return batch
 
+    def flush(self):
+        """Emit the partial final batch (fields sliced to the filled
+        count, tagged ``_partial=True``), or None when nothing is
+        pending. Without this, a finite stream silently drops up to
+        ``batch_size - 1`` tail items — fatal for eval passes that must
+        see every example exactly once."""
+        if self._cursor == 0:
+            return None
+        buf = self._pool[self._active]
+        batch = {k: buf[k][: self._cursor] for k in self.schema.fields}
+        batch["_meta"] = self._meta
+        batch["_partial"] = True
+        self._meta = []
+        self._cursor = 0
+        self._active = (self._active + 1) % len(self._pool)
+        return batch
+
 
 class HostIngest:
     """Background thread: stream -> validate -> assemble -> bounded queue.
@@ -81,12 +176,18 @@ class HostIngest:
         schema: StreamSchema | None = None,
         prefetch: int = 2,
         validate_every: int = 1,
+        emit_partial_final: bool = False,
     ):
         self.stream = stream
         self.batch_size = batch_size
         self.schema = schema
         self.prefetch = prefetch
         self.validate_every = max(1, int(validate_every))
+        # Opt-in: when a finite stream ends mid-batch, emit the tail as a
+        # `_partial=True` batch instead of dropping it. Off by default —
+        # a ragged final batch recompiles a jitted train step, so only
+        # consumers that handle variable leading dims should ask for it.
+        self.emit_partial_final = bool(emit_partial_final)
         self._queue: queue.Queue = queue.Queue(maxsize=prefetch)
         self._warned_prebatch = False
         self._error: BaseException | None = None
@@ -99,48 +200,10 @@ class HostIngest:
 
     @staticmethod
     def _batched_views(item: dict):
-        """Per-item views of a producer-batched message (``_batched=True``:
-        every ndarray field carries a leading batch dim)."""
-        lead = next(
-            (
-                v.shape[0]
-                for v in item.values()
-                if isinstance(v, np.ndarray) and v.ndim > 0
-            ),
-            0,
-        )
-        for i in range(lead):
-            yield {
-                k: v[i]
-                if isinstance(v, np.ndarray) and v.shape[:1] == (lead,)
-                else v
-                for k, v in item.items()
-            }
+        return batched_views(item)
 
     def _passthrough(self, item: dict):
-        """A producer-batched item whose leading dim equals ``batch_size``
-        and whose fields match the schema is already a batch: hand it on
-        with zero copies (the batch-publishing producer's fast path)."""
-        for k, spec in self.schema.fields.items():
-            v = item.get(k)
-            if not (
-                isinstance(v, np.ndarray)
-                and v.shape == (self.batch_size, *spec.shape)
-                and v.dtype == spec.dtype
-            ):
-                return None
-        batch = {k: item[k] for k in self.schema.fields}
-        meta = {k: item[k] for k in self.schema.meta_keys if k in item}
-        batch["_meta"] = [
-            {
-                k: v[i]
-                if isinstance(v, np.ndarray) and len(v) == self.batch_size
-                else v
-                for k, v in meta.items()
-            }
-            for i in range(self.batch_size)
-        ]
-        return batch
+        return passthrough_batch(item, self.schema, self.batch_size)
 
     def _emit(self, batch) -> None:
         metrics.gauge("ingest.queue_depth", self._queue.qsize())
@@ -157,6 +220,7 @@ class HostIngest:
     def _run(self):
         try:
             assembler = None
+            exhausted = False
             stream_it = iter(self.stream)
             while True:
                 # span: time blocked on the socket/decode (vs assembly
@@ -165,6 +229,7 @@ class HostIngest:
                     try:
                         item = next(stream_it)
                     except StopIteration:
+                        exhausted = True
                         break
                 if self._stop.is_set():
                     break
@@ -177,30 +242,7 @@ class HostIngest:
                     # allowed (ragged tails from a producer flush) but
                     # flagged once, since a jitted train step will
                     # recompile for the odd shape.
-                    # A `*__tileidx` field's leading dim is authoritative
-                    # for tile messages (sidecar palette/keyframe arrays
-                    # carry unrelated leading dims); fall back to the
-                    # first array field for other prebatched producers.
-                    from blendjax.ops.tiles import TILEIDX_SUFFIX
-
-                    lead = next(
-                        (
-                            v.shape[0]
-                            for k, v in item.items()
-                            if k.endswith(TILEIDX_SUFFIX)
-                            and isinstance(v, np.ndarray) and v.ndim > 0
-                        ),
-                        None,
-                    )
-                    if lead is None:
-                        lead = next(
-                            (
-                                v.shape[0]
-                                for v in item.values()
-                                if isinstance(v, np.ndarray) and v.ndim > 0
-                            ),
-                            0,
-                        )
+                    lead = prebatched_lead(item)
                     if lead != self.batch_size and not self._warned_prebatch:
                         self._warned_prebatch = True
                         logger.warning(
@@ -251,18 +293,38 @@ class HostIngest:
                     batch = assembler.add(one)
                     if batch is not None:
                         self._emit(batch)
+            if exhausted and self.emit_partial_final and assembler is not None:
+                tail = assembler.flush()
+                if tail is not None:
+                    self._emit(tail)
         except BaseException as e:  # propagate into the consumer thread
             self._error = e
         finally:
-            try:
-                self._queue.put(self._DONE, timeout=5)
-            except queue.Full:
-                pass
+            # Undroppable sentinel: a fixed timeout could expire while
+            # the consumer sits in a long train step with the queue
+            # full, leaving it blocked forever in get(). Retry until
+            # delivered; bail only on stop() (consumer gone, and
+            # stop()'s drain loop frees a slot for this put anyway).
+            while True:
+                try:
+                    self._queue.put(self._DONE, timeout=0.25)
+                    break
+                except queue.Full:
+                    if self._stop.is_set():
+                        break
+                    continue
 
     # -- consumer side ------------------------------------------------------
 
     def start(self) -> "HostIngest":
         assert self._thread is None, "already started"
+        # A reused stream may carry a sticky stop request from a prior
+        # ingest's stop(); clear it BEFORE the thread spawns (clearing
+        # inside the stream iterator would race a stop requested while
+        # the thread is still warming up).
+        clear = getattr(self.stream, "clear_stop_request", None)
+        if clear is not None:
+            clear()
         self._thread = threading.Thread(
             target=self._run, name="blendjax-ingest", daemon=True
         )
@@ -287,19 +349,45 @@ class HostIngest:
                 return
             yield batch
 
-    def stop(self):
+    def stop(self, timeout: float = 10.0):
         self._stop.set()
-        if self._thread is not None:
-            # Drain so the thread isn't stuck on a full queue.
+        # A stream blocked in a long recv can't see our event — ask it
+        # to bail at its next poll slice (RemoteStream.request_stop).
+        request_stop = getattr(self.stream, "request_stop", None)
+        if request_stop is not None:
+            request_stop()
+        if self._thread is None:
+            return
+        # Drain-then-join must LOOP: a single drain races the thread —
+        # it can emit (or park on a freshly re-filled queue, or put
+        # ``_DONE``) after the drain swallowed everything, and the
+        # subsequent join then burns its whole timeout on a thread
+        # that only needs one more slot freed.
+        deadline = time.monotonic() + timeout
+        while self._thread.is_alive():
             try:
                 while True:
                     self._queue.get_nowait()
             except queue.Empty:
                 pass
-            self._thread.join(timeout=10)
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                break
+            self._thread.join(timeout=min(0.05, remaining))
+        if self._thread.is_alive():
+            raise RuntimeError(
+                f"ingest thread did not exit within {timeout:.1f}s of "
+                "stop(): the stream iterator is blocked somewhere that "
+                "ignores the stop signal (e.g. a recv with no timeout)"
+            )
 
     def __enter__(self):
         return self.start()
 
     def __exit__(self, *exc):
-        self.stop()
+        try:
+            self.stop()
+        except RuntimeError:
+            # never mask the with-body exception with a teardown error
+            # (the thread is a daemon; log the diagnosis and move on)
+            logger.exception("ingest thread did not shut down cleanly")

@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import collections
 import hashlib
+import threading
 
 import numpy as np
 
@@ -1005,6 +1006,8 @@ class StreamDataPipeline:
         chunk: int = 1,
         chunk_strict: bool = False,
         emit_packed: bool = False,
+        ingest_workers: int = 1,
+        emit_partial_final: bool = False,
         **stream_kwargs,
     ):
         from blendjax.data.stream import RemoteStream
@@ -1014,17 +1017,20 @@ class StreamDataPipeline:
         # respawned when the launcher has respawn=True) instead of an
         # opaque timeout (SURVEY.md §5 failure detection).
         self.launcher = launcher
-        if launcher is not None and "on_timeout" not in stream_kwargs:
-            retries = {"left": 3}
-
-            def on_timeout():
-                launcher.assert_alive()  # raises (or respawns) as configured
-                # All producers alive but silent: retry a bounded number of
-                # times (covers slow startup/respawn), then fail fast.
-                retries["left"] -= 1
-                return retries["left"] >= 0
-
-            stream_kwargs["on_timeout"] = on_timeout
+        self._auto_timeout = (
+            launcher is not None and "on_timeout" not in stream_kwargs
+        )
+        self._launcher_lock = threading.Lock()
+        if self._auto_timeout:
+            stream_kwargs["on_timeout"] = self._launcher_on_timeout()
+        # ingest_workers > 1 shards the producer fleet across a pool of
+        # receive/decode threads (blendjax.data.shard_ingest); 1 — the
+        # default — is the existing single-thread HostIngest, ordering
+        # and recording-tee semantics unchanged.
+        self.ingest_workers = max(1, int(ingest_workers))
+        self.emit_partial_final = bool(emit_partial_final)
+        self._addresses = None
+        self._stream_kwargs = dict(stream_kwargs)
         if hasattr(addresses, "__iter__") and not isinstance(
             addresses, (list, tuple, str)
         ):
@@ -1032,7 +1038,21 @@ class StreamDataPipeline:
             # ReplayStream replaying a recording with no producers).
             self.stream = addresses
         else:
-            self.stream = RemoteStream(addresses, **stream_kwargs)
+            self._addresses = (
+                [addresses] if isinstance(addresses, str) else list(addresses)
+            )
+            if self.ingest_workers > 1 and (
+                "worker_index" in stream_kwargs
+                or "num_workers" in stream_kwargs
+            ):
+                # Both features split max_items/recording files by
+                # worker slot; combined they'd double-split silently.
+                raise ValueError(
+                    "ingest_workers > 1 cannot be combined with explicit "
+                    "worker_index/num_workers stream kwargs: the shard "
+                    "pool owns the worker slots"
+                )
+            self.stream = RemoteStream(self._addresses, **stream_kwargs)
         self.ingest = None
         self.batch_size = batch_size
         self.schema = schema
@@ -1066,6 +1086,25 @@ class StreamDataPipeline:
             chunk_strict=chunk_strict, emit_packed=emit_packed,
         )
 
+    def _launcher_on_timeout(self):
+        """One launcher-health timeout hook with its OWN retry budget —
+        the sharded pool hands a fresh closure to every shard so one
+        slow producer can't burn its peers' retries, and assert_alive
+        (not written for concurrent callers) is serialized across the
+        worker threads."""
+        launcher = self.launcher
+        retries = {"left": 3}
+
+        def on_timeout():
+            with self._launcher_lock:
+                launcher.assert_alive()  # raises (or respawns) as configured
+            # All producers alive but silent: retry a bounded number of
+            # times (covers slow startup/respawn), then fail fast.
+            retries["left"] -= 1
+            return retries["left"] >= 0
+
+        return on_timeout
+
     @classmethod
     def from_recording(cls, source, batch_size: int, loop: bool = False,
                        allow_pickle: bool = False, **kwargs):
@@ -1088,12 +1127,73 @@ class StreamDataPipeline:
     def __iter__(self):
         from blendjax.data.batcher import HostIngest
 
-        self.ingest = HostIngest(
-            self.stream,
-            batch_size=self.batch_size,
-            schema=self.schema,
-            prefetch=self.prefetch,
-        )
+        shards = None
+        if self.ingest_workers > 1:
+            from blendjax.data.stream import partition_addresses
+
+            if self._addresses is None:
+                logger.warning(
+                    "ingest_workers=%d requested but the source is an "
+                    "opaque iterable (not producer addresses): falling "
+                    "back to single-threaded ingest",
+                    self.ingest_workers,
+                )
+            else:
+                shards = partition_addresses(
+                    self._addresses, self.ingest_workers
+                )
+                if len(shards) < 2:
+                    shards = None  # one producer: nothing to parallelize
+                    logger.warning(
+                        "ingest_workers=%d requested but only one "
+                        "producer address is available: falling back to "
+                        "single-threaded ingest",
+                        self.ingest_workers,
+                    )
+        if shards is not None:
+            from blendjax.data.shard_ingest import ShardedHostIngest
+            from blendjax.data.stream import RemoteStream
+
+            def shard_stream(i, shard):
+                kwargs = dict(self._stream_kwargs)
+                # max_items is enforced GLOBALLY by the pool (shards see
+                # disjoint producer subsets — an even per-shard split
+                # would block one shard on messages only another shard's
+                # producers hold).
+                kwargs.pop("max_items", None)
+                if self._auto_timeout:
+                    # fresh closure per shard: independent retry budgets
+                    kwargs["on_timeout"] = self._launcher_on_timeout()
+                # enable_recording() mutates self.stream after
+                # construction — carry the tee into the shard streams
+                # (worker-indexed files), matching the single path.
+                prefix = getattr(self.stream, "record_path_prefix", None)
+                if prefix is not None:
+                    kwargs["record_path_prefix"] = prefix
+                    kwargs["record_max_messages"] = (
+                        self.stream.record_max_messages
+                    )
+                return RemoteStream(
+                    shard, worker_index=i, num_workers=len(shards),
+                    **kwargs,
+                )
+
+            self.ingest = ShardedHostIngest(
+                [shard_stream(i, s) for i, s in enumerate(shards)],
+                batch_size=self.batch_size,
+                schema=self.schema,
+                prefetch=self.prefetch,
+                emit_partial_final=self.emit_partial_final,
+                max_messages=self._stream_kwargs.get("max_items"),
+            )
+        else:
+            self.ingest = HostIngest(
+                self.stream,
+                batch_size=self.batch_size,
+                schema=self.schema,
+                prefetch=self.prefetch,
+                emit_partial_final=self.emit_partial_final,
+            )
         self.ingest.start()
         self.tiles.reset()
         host = self.tiles.host_stage(self.ingest)
@@ -1103,11 +1203,19 @@ class StreamDataPipeline:
         return 0 if self.ingest is None else self.ingest.queue_depth()
 
     def stop(self):
-        if self.ingest is not None:
-            self.ingest.stop()
-        close = getattr(self.stream, "close", None)
-        if close is not None:  # e.g. ReplayStream's recording handles
-            close()
+        try:
+            if self.ingest is not None:
+                self.ingest.stop()
+        except RuntimeError:
+            # A wedged ingest thread (e.g. an opaque source blocked with
+            # no timeout) must not mask a with-body exception in
+            # __exit__ or skip the stream cleanup below — the threads
+            # are daemons; log the diagnosis and keep tearing down.
+            logger.exception("ingest did not shut down cleanly")
+        finally:
+            close = getattr(self.stream, "close", None)
+            if close is not None:  # e.g. ReplayStream's recording handles
+                close()
 
     def __enter__(self):
         return self

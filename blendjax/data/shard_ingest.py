@@ -1,0 +1,481 @@
+"""Sharded parallel ingest: a multi-socket recv/decode worker pool.
+
+``HostIngest`` runs the entire consumer hot loop — socket receive, codec
+decode, schema validate, per-item memcpy — on ONE thread behind ONE
+fan-in PULL socket. That thread is the ingest ceiling: bench rounds 1-5
+show ``ingest.queue_full_waits`` go to zero exactly when the device is
+the bound, and climb as soon as producers outrun a single consumer core.
+
+This module partitions the producer fleet across N receive workers:
+
+- each worker owns its *own* stream (and therefore its own PULL socket:
+  ``RemoteStream`` defers socket construction to ``__iter__``, which runs
+  on the worker thread — the BJX104 thread-affinity invariant is
+  satisfied by construction, not by annotation);
+- zmq's ``recv``, zlib's ``decompress`` (the ``"ndz"`` wire path), and
+  numpy's slice-assign memcpy all release the GIL, so N workers overlap
+  receive+decode+copy on real cores;
+- workers write items straight into shared batch buffers through a
+  lock-cheap slot reservation (:class:`ParallelBatchAssembler`): only
+  the cursor bump + buffer rotation is locked, the per-slot field
+  memcpys proceed concurrently;
+- completed batches flow into the same bounded queue ``HostIngest``
+  uses, so the HWM -> queue backpressure chain is preserved end to end.
+
+Ordering: batches are emitted in COMPLETION order. ZMQ PUSH/PULL fan-in
+already guarantees no cross-producer ordering, so multi-producer
+consumers observe the same contract as before; single-producer strict
+ordering needs ``ingest_workers=1`` (the default).
+"""
+
+from __future__ import annotations
+
+# bjx: hot-path (the parallel receive/decode/assemble loop: BJX102
+# flags any blocking device sync added to this module)
+
+import queue
+import threading
+import time
+
+import numpy as np
+
+from blendjax.data.batcher import (
+    batched_views,
+    passthrough_batch,
+    prebatched_lead,
+)
+from blendjax.data.schema import StreamSchema
+from blendjax.utils.logging import get_logger
+from blendjax.utils.metrics import metrics
+
+logger = get_logger("data")
+
+
+class _PendingBatch:
+    """One in-flight batch: preallocated field buffers plus a slot
+    countdown. Slots fill concurrently and out of order; the writer that
+    completes the last slot emits the batch."""
+
+    __slots__ = ("buffers", "meta", "remaining", "lock")
+
+    def __init__(self, buffers: dict, batch_size: int):
+        self.buffers = buffers
+        self.meta: list = [None] * batch_size
+        self.remaining = batch_size
+        self.lock = threading.Lock()
+
+
+class ParallelBatchAssembler:
+    """Slot-reserving batch assembler for concurrent writers.
+
+    :meth:`reserve` hands out ``(pending, slot)`` under a short lock
+    (cursor bump + buffer rotation only); :meth:`write` then memcpys the
+    item's fields into its slot with NO lock held — numpy releases the
+    GIL for the copies, so writers on different cores proceed in
+    parallel — and returns the completed batch dict when this write was
+    the batch's last outstanding slot.
+
+    The buffer pool must be deep enough that a buffer is not re-reserved
+    while a still-incomplete or still-consumed batch holds it: size it
+    ``>= in-flight pending batches + queue depth + 1``.
+    """
+
+    def __init__(self, schema: StreamSchema, batch_size: int,
+                 num_buffers: int = 4):
+        self.schema = schema
+        self.batch_size = int(batch_size)
+        self._pool = [
+            {
+                k: np.empty((self.batch_size, *spec.shape), spec.dtype)
+                for k, spec in schema.fields.items()
+            }
+            for _ in range(num_buffers)
+        ]
+        self._lock = threading.Lock()
+        self._active = 0
+        self._cursor = 0
+        self._pending: _PendingBatch | None = None
+
+    def reserve(self) -> tuple:
+        """Claim one slot; returns ``(pending, slot_index)``."""
+        with self._lock:
+            if self._pending is None:
+                self._pending = _PendingBatch(
+                    self._pool[self._active], self.batch_size
+                )
+                self._active = (self._active + 1) % len(self._pool)
+                self._cursor = 0
+            pending = self._pending
+            slot = self._cursor
+            self._cursor += 1
+            if self._cursor == self.batch_size:
+                self._pending = None
+            return pending, slot
+
+    def write(self, pending: _PendingBatch, slot: int, item: dict):
+        """Fill a reserved slot; returns the completed batch when this
+        was its last outstanding slot, else None."""
+        buf = pending.buffers
+        for k in self.schema.fields:
+            buf[k][slot] = item[k]
+        pending.meta[slot] = {
+            k: item[k] for k in self.schema.meta_keys if k in item
+        }
+        with pending.lock:
+            pending.remaining -= 1
+            done = pending.remaining == 0
+        if not done:
+            return None
+        batch = dict(pending.buffers)
+        batch["_meta"] = pending.meta
+        return batch
+
+    def add(self, item: dict):
+        """Serial-compatible convenience: reserve + write in one call."""
+        pending, slot = self.reserve()
+        return self.write(pending, slot, item)
+
+    def flush(self):
+        """Emit the partial final batch (``_partial=True``), or None.
+
+        Only valid once all writers have quiesced (every reserved slot
+        written): the caller is the worker pool's last-thread-out, which
+        joins behind every other worker by construction.
+        """
+        with self._lock:
+            pending, filled = self._pending, self._cursor
+            self._pending = None
+        if pending is None or filled == 0:
+            return None
+        batch = {
+            k: pending.buffers[k][:filled] for k in self.schema.fields
+        }
+        batch["_meta"] = pending.meta[:filled]
+        batch["_partial"] = True
+        return batch
+
+
+class ShardedHostIngest:
+    """N worker threads, one stream each: recv -> decode -> validate ->
+    parallel assemble -> ONE bounded queue.
+
+    ``streams`` is a list of per-shard iterables (typically
+    ``RemoteStream`` instances over a partition of the producer
+    addresses — see :func:`blendjax.data.stream.partition_addresses`).
+    Consumer-side semantics match :class:`HostIngest`: iterate batches,
+    errors from any worker propagate, ``stop()`` tears down.
+    """
+
+    _DONE = object()
+
+    def __init__(
+        self,
+        streams,
+        batch_size: int,
+        schema: StreamSchema | None = None,
+        prefetch: int = 2,
+        validate_every: int = 1,
+        emit_partial_final: bool = False,
+        max_messages: int | None = None,
+    ):
+        self.streams = list(streams)
+        if not self.streams:
+            raise ValueError("ShardedHostIngest needs at least one stream")
+        self.batch_size = int(batch_size)
+        self.schema = schema
+        self.prefetch = prefetch
+        self.validate_every = max(1, int(validate_every))
+        self.emit_partial_final = bool(emit_partial_final)
+        self._queue: queue.Queue = queue.Queue(maxsize=prefetch)
+        self._threads: list[threading.Thread] = []
+        self._stop = threading.Event()
+        self._error: BaseException | None = None
+        self._warned_prebatch = False
+        # Shared lazy state (schema inference + assembler construction)
+        # is guarded by one lock; steady-state item handling never takes
+        # it. Counters are per-shard and summed on read, so the hot loop
+        # carries no locked increments.
+        self._infer_lock = threading.Lock()
+        self._assembler: ParallelBatchAssembler | None = None
+        self._active = 0
+        self._active_lock = threading.Lock()
+        # True only once stop() runs — the error path sets _stop without
+        # it, so sentinel delivery can tell the two apart
+        self._consumer_stop = False
+        # GLOBAL message budget, shared across shards. Splitting a
+        # max_items evenly per shard (worker_items-style) is wrong here:
+        # shards see DISJOINT producer subsets, so a shard whose
+        # producers publish less than its even share would block on
+        # messages it can never receive while another shard strands the
+        # surplus. One locked decrement per message arbitrates exactly.
+        self._msg_budget = None if max_messages is None else int(max_messages)
+        self._budget_lock = threading.Lock()
+        self._shard_items = [0] * len(self.streams)
+        self._shard_batches = [0] * len(self.streams)
+
+    # -- aggregate counters --------------------------------------------------
+
+    @property
+    def items_in(self) -> int:
+        return sum(self._shard_items)
+
+    @property
+    def batches_out(self) -> int:
+        return sum(self._shard_batches)
+
+    def queue_depth(self) -> int:
+        """Current prefetch-queue occupancy (observability gauge)."""
+        return self._queue.qsize()
+
+    # -- worker side ---------------------------------------------------------
+
+    def _emit(self, idx: int, batch) -> None:
+        metrics.gauge("ingest.queue_depth", self._queue.qsize())
+        # Bail only when the CONSUMER is gone (stop()). _stop alone is
+        # not enough: the budget-drain and error paths set it while the
+        # consumer is still draining — gating on it dropped the final
+        # batch completed just after a max_items drain.
+        while not self._consumer_stop:
+            try:
+                self._queue.put(batch, timeout=0.25)
+                self._shard_batches[idx] += 1
+                metrics.count("ingest.batches")
+                break
+            except queue.Full:
+                metrics.count("ingest.queue_full_waits")
+                continue
+
+    def _ensure_assembler(self, item: dict, batched: bool):
+        """Schema inference + assembler construction, once, under lock
+        (the first item of ANY shard wins; every later shard validates
+        against the same inferred contract)."""
+        with self._infer_lock:
+            if self.schema is None:
+                if batched:
+                    first = next(batched_views(item), None)
+                    if first is None:
+                        from blendjax.data.schema import SchemaError
+
+                        raise SchemaError(
+                            "batched message has no array field with a "
+                            f"leading batch dim (keys: {sorted(item)})"
+                        )
+                else:
+                    first = item
+                self.schema = StreamSchema.infer(first)
+                logger.info("inferred stream schema: %s", self.schema)
+            if self._assembler is None:
+                # Pool depth: every worker can hold one pending batch
+                # while the queue holds `prefetch` completed ones and
+                # the consumer holds one more.
+                self._assembler = ParallelBatchAssembler(
+                    self.schema, self.batch_size,
+                    num_buffers=self.prefetch + len(self.streams) + 2,
+                )
+        return self._assembler
+
+    def _consume(self, idx: int, item: dict) -> None:
+        if item.pop("_prebatched", False):
+            lead = prebatched_lead(item)
+            if lead != self.batch_size and not self._warned_prebatch:
+                self._warned_prebatch = True
+                logger.warning(
+                    "prebatched message carries %d items but the "
+                    "pipeline batch_size is %d; passing through as-is "
+                    "(match the producer's --batch to avoid jit "
+                    "recompiles)", lead, self.batch_size,
+                )
+            self._shard_items[idx] += lead
+            metrics.count("ingest.items", lead)
+            self._emit(idx, item)
+            return
+        batched = bool(item.pop("_batched", False))
+        assembler = self._assembler
+        if assembler is None:
+            assembler = self._ensure_assembler(item, batched)
+        if batched:
+            whole = passthrough_batch(item, self.schema, self.batch_size)
+            if whole is not None:
+                self._shard_items[idx] += self.batch_size
+                metrics.count("ingest.items", self.batch_size)
+                self._emit(idx, whole)
+                return
+            items = batched_views(item)  # size mismatch: split
+        else:
+            items = (item,)
+        for one in items:
+            if self._shard_items[idx] % self.validate_every == 0:
+                self.schema.validate(one)
+            self._shard_items[idx] += 1
+            metrics.count("ingest.items")
+            pending, slot = assembler.reserve()
+            batch = assembler.write(pending, slot, one)
+            if batch is not None:
+                self._emit(idx, batch)
+
+    def _take_budget(self) -> bool:
+        """Claim one message from the shared budget; False when spent
+        (the claimer that drains it winds the whole pool down — an
+        over-received message on a losing shard is discarded, the same
+        at-most-once outcome as closing a PULL socket with queued
+        messages)."""
+        if self._msg_budget is None:
+            return True
+        with self._budget_lock:
+            if self._msg_budget <= 0:
+                return False
+            self._msg_budget -= 1
+            drained = self._msg_budget == 0
+        if drained:
+            self._stop.set()
+            for stream in self.streams:
+                request_stop = getattr(stream, "request_stop", None)
+                if request_stop is not None:
+                    request_stop()
+        return True
+
+    def _run_shard(self, idx: int) -> None:
+        stream_it = iter(self.streams[idx])
+        span_name = f"ingest.recv.shard{idx}"
+        while True:
+            # span: per-shard time blocked on this shard's socket/decode
+            # — the bench's per-shard recv breakdown
+            with metrics.span(span_name):
+                try:
+                    item = next(stream_it)
+                except StopIteration:
+                    return
+            if not self._take_budget():
+                return
+            if self._consumer_stop or self._error is not None:
+                # consumer stop / peer error: drop the in-hand item and
+                # wind down. (NOT a bare _stop check: the worker that
+                # just drained the budget set _stop for its peers but
+                # still owns this final claimed item.)
+                return
+            self._consume(idx, item)
+
+    def _worker(self, idx: int) -> None:
+        try:
+            self._run_shard(idx)
+        except BaseException as e:  # propagate into the consumer thread
+            with self._active_lock:
+                if self._error is None:
+                    self._error = e
+            # wind the peers down too: a schema error on one shard must
+            # fail the whole pool, not leave N-1 workers running forever
+            # (request_stop reaches peers parked inside a long recv —
+            # the event alone is only checked between items)
+            self._stop.set()
+            for stream in self.streams:
+                request_stop = getattr(stream, "request_stop", None)
+                if request_stop is not None:
+                    request_stop()
+        finally:
+            with self._active_lock:
+                self._active -= 1
+                last = self._active == 0
+            if last:
+                if (
+                    self._error is None
+                    and not self._consumer_stop
+                    and self.emit_partial_final
+                    and self._assembler is not None
+                ):
+                    # all peers joined: every reserved slot is written,
+                    # so the partial flush sees a quiesced assembler
+                    tail = self._assembler.flush()
+                    if tail is not None:
+                        self._emit(idx, tail)
+                # The sentinel must not be droppable: a fixed put timeout
+                # can expire while the consumer sits in a >5s train step
+                # with the queue full, and the consumer would then block
+                # forever in get(). Keep trying until delivered; bail
+                # only on a CONSUMER-initiated stop() — the error path
+                # sets _stop too (to wind down peers), but its consumer
+                # is still listening and must receive _DONE to see the
+                # error (stop()'s drain loop frees a slot anyway).
+                while True:
+                    try:
+                        self._queue.put(self._DONE, timeout=0.25)
+                        break
+                    except queue.Full:
+                        if self._consumer_stop:
+                            break
+                        continue
+
+    # -- consumer side -------------------------------------------------------
+
+    def start(self) -> "ShardedHostIngest":
+        assert not self._threads, "already started"
+        for stream in self.streams:
+            clear = getattr(stream, "clear_stop_request", None)
+            if clear is not None:
+                clear()
+        self._active = len(self.streams)
+        for i in range(len(self.streams)):
+            t = threading.Thread(
+                target=self._worker, args=(i,),
+                name=f"blendjax-ingest-{i}", daemon=True,
+            )
+            self._threads.append(t)
+            t.start()
+        return self
+
+    def __iter__(self):
+        if not self._threads:
+            self.start()
+        while True:
+            # span: consumer-side wait for the worker pool — near-zero
+            # when ingest outruns the device, the whole story when not
+            with metrics.span("ingest.queue_wait"):
+                batch = self._queue.get()
+            if batch is self._DONE:
+                if self._error is not None:
+                    raise self._error
+                return
+            yield batch
+
+    def stop(self, timeout: float = 10.0):
+        self._consumer_stop = True
+        self._stop.set()
+        for stream in self.streams:
+            request_stop = getattr(stream, "request_stop", None)
+            if request_stop is not None:
+                request_stop()
+        if not self._threads:
+            return
+        # Same drain-then-join LOOP as HostIngest.stop(): a one-shot
+        # drain races workers that refill the queue (or park on it)
+        # after the drain swallowed everything.
+        deadline = time.monotonic() + timeout
+        while any(t.is_alive() for t in self._threads):
+            try:
+                while True:
+                    self._queue.get_nowait()
+            except queue.Empty:
+                pass
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                break
+            for t in self._threads:
+                t.join(timeout=min(0.05, max(remaining, 0.01)))
+        alive = [t.name for t in self._threads if t.is_alive()]
+        if alive:
+            raise RuntimeError(
+                f"ingest workers {alive} did not exit within "
+                f"{timeout:.1f}s of stop(): a shard stream is blocked "
+                "somewhere that ignores the stop signal (e.g. a recv "
+                "with no timeout)"
+            )
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        try:
+            self.stop()
+        except RuntimeError:
+            # never mask the with-body exception with a teardown error
+            # (the workers are daemons; log the diagnosis and move on)
+            logger.exception("ingest workers did not shut down cleanly")

@@ -13,12 +13,33 @@ from __future__ import annotations
 # bjx: hot-path (the live receive loop: BJX102 flags any blocking
 # device sync added to this module)
 
+import time
+
 from blendjax import constants
 from blendjax.data.replay import FileRecorder
 from blendjax.transport import DataReceiverSocket, ReceiveTimeoutError
 from blendjax.utils.logging import get_logger
 
 logger = get_logger("data")
+
+
+def partition_addresses(addresses, num_shards: int) -> list:
+    """Round-robin partition of producer addresses into at most
+    ``num_shards`` non-empty groups, one per ingest worker.
+
+    Each group becomes one shard's own PULL socket (its own fair-queued
+    fan-in over its producers), so a fleet of N producers spreads its
+    receive+decode load over ``min(num_shards, N)`` consumer threads.
+    Round-robin (``addresses[i::n]``) keeps early/late launcher
+    instances mixed across shards — launcher address lists are ordered
+    by instance, and contiguous slicing would put all the warm, fast
+    instances on shard 0.
+    """
+    if isinstance(addresses, str):
+        addresses = [addresses]
+    addresses = list(addresses)
+    n = max(1, min(int(num_shards), len(addresses)))
+    return [addresses[i::n] for i in range(n)]
 
 
 class RemoteStream:
@@ -65,6 +86,46 @@ class RemoteStream:
         # the launcher), False/None to fail fast like the reference
         # (``dataset.py:98-99``).
         self.on_timeout = on_timeout
+        self._stop_requested = False
+
+    def request_stop(self) -> None:
+        """Ask a blocked iteration to exit at the next poll slice
+        (<=250 ms away) instead of after the full ``timeoutms``. Safe to
+        call from any thread (a GIL-atomic bool store); the iterating
+        thread still runs its own cleanup (socket close, recorder
+        flush) on the way out. The flag is sticky — a re-iterating
+        owner calls :meth:`clear_stop_request` first (NOT the iterator
+        itself: a reset at iteration start would race a stop requested
+        between thread spawn and the generator's first advance)."""
+        self._stop_requested = True
+
+    def clear_stop_request(self) -> None:
+        self._stop_requested = False
+
+    def _recv_sliced(self, recv):
+        """One logical receive with ``timeoutms`` semantics, polled in
+        <=250 ms slices so :meth:`request_stop` is honored promptly.
+        Returns None when stopped; raises ``ReceiveTimeoutError`` after
+        the full timeout like a single blocking recv would."""
+        deadline = time.monotonic() + self.timeoutms / 1e3
+        while True:
+            if self._stop_requested:
+                return None
+            remaining_ms = (deadline - time.monotonic()) * 1e3
+            try:
+                return recv.recv(
+                    timeoutms=max(0, min(250, int(remaining_ms))),
+                    copy_arrays=self.copy_arrays,
+                )
+            except ReceiveTimeoutError:
+                if remaining_ms <= 0:
+                    # re-raise with the FULL window in the message (the
+                    # slice's own error names a misleading 250 ms)
+                    raise ReceiveTimeoutError(
+                        f"no message within {self.timeoutms} ms from "
+                        f"{self.addresses}"
+                    ) from None
+                continue
 
     def enable_recording(self, prefix: str, max_messages: int | None = None):
         """(reference ``dataset.py:53-58``)"""
@@ -106,11 +167,14 @@ class RemoteStream:
             n = 0
             while limit is None or n < limit:
                 try:
-                    msg, raw = recv.recv(copy_arrays=self.copy_arrays)
+                    out = self._recv_sliced(recv)
                 except ReceiveTimeoutError:
                     if self.on_timeout is not None and self.on_timeout():
                         continue
                     raise
+                if out is None:  # request_stop(): exit through cleanup
+                    return
+                msg, raw = out
                 if recorder is not None:
                     recorder.save(raw)
                 yield self.item_transform(msg)

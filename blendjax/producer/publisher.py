@@ -10,6 +10,7 @@ from __future__ import annotations
 
 from blendjax import constants
 from blendjax.transport import DataPublisherSocket
+from blendjax.transport.wire import DEFAULT_COMPRESS_MIN_BYTES
 
 
 class DataPublisher(DataPublisherSocket):
@@ -21,6 +22,8 @@ class DataPublisher(DataPublisherSocket):
         lingerms: int = 0,
         codec: str = "tensor",
         copy: bool = False,
+        compress_level: int = 0,
+        compress_min_bytes: int = DEFAULT_COMPRESS_MIN_BYTES,
     ):
         super().__init__(
             bind_addr,
@@ -29,4 +32,6 @@ class DataPublisher(DataPublisherSocket):
             codec=codec,
             lingerms=lingerms,
             copy=copy,
+            compress_level=compress_level,
+            compress_min_bytes=compress_min_bytes,
         )

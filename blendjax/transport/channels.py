@@ -28,7 +28,11 @@ import threading
 import zmq
 
 from blendjax import constants
-from blendjax.transport.wire import decode_message, encode_message
+from blendjax.transport.wire import (
+    DEFAULT_COMPRESS_MIN_BYTES,
+    decode_message,
+    encode_message,
+)
 
 
 class ReceiveTimeoutError(TimeoutError):
@@ -85,6 +89,11 @@ class _Channel:
 
     sock: zmq.Socket
     allow_pickle: bool = True
+    # Only the bulk data stream accounts its frames into the
+    # wire.raw_bytes/wire.compressed_bytes pair (DataReceiverSocket
+    # flips this True): control/RPC arrays through the same codec would
+    # pollute the published compression ratio.
+    wire_metrics: bool = False
 
     def _register_poller(self) -> None:
         self.poller = zmq.Poller()
@@ -100,7 +109,9 @@ class _Channel:
         buffers = [f.buffer for f in frames]
         return (
             decode_message(
-                buffers, copy_arrays=copy_arrays, allow_pickle=self.allow_pickle
+                buffers, copy_arrays=copy_arrays,
+                allow_pickle=self.allow_pickle,
+                count_metrics=self.wire_metrics,
             ),
             buffers,
         )
@@ -144,10 +155,18 @@ class DataPublisherSocket(_Channel):
         codec: str = "tensor",
         lingerms: int = 0,
         copy: bool = False,
+        compress_level: int = 0,
+        compress_min_bytes: int = DEFAULT_COMPRESS_MIN_BYTES,
     ):
         self.codec = codec
         self.btid = btid
         self.copy = copy
+        # Per-publisher wire compression (tensor codec only): level > 0
+        # ships large array frames as zlib "ndz" entries. Trades producer
+        # CPU for wire bytes — the right trade on tunneled/cross-host
+        # links, the wrong one on ipc/loopback (docs/performance.md).
+        self.compress_level = int(compress_level)
+        self.compress_min_bytes = int(compress_min_bytes)
         self.sock = zmq_context().socket(zmq.PUSH)
         self.sock.setsockopt(zmq.SNDHWM, send_hwm)
         self.sock.setsockopt(zmq.IMMEDIATE, 1)
@@ -162,7 +181,14 @@ class DataPublisherSocket(_Channel):
         (reference stamps every payload, ``publisher.py:42``)."""
         data = {"btid": self.btid, **kwargs}
         self.sock.send_multipart(
-            encode_message(data, codec=self.codec), copy=self.copy
+            self._encode(data), copy=self.copy
+        )
+
+    def _encode(self, data: dict) -> list:
+        return encode_message(
+            data, codec=self.codec,
+            compress_level=self.compress_level,
+            compress_min_bytes=self.compress_min_bytes,
         )
 
     def publish_tracked(self, **kwargs):
@@ -176,7 +202,7 @@ class DataPublisherSocket(_Channel):
         alone does not cap the total number of in-flight messages."""
         data = {"btid": self.btid, **kwargs}
         return self.sock.send_multipart(
-            encode_message(data, codec=self.codec), copy=False, track=True
+            self._encode(data), copy=False, track=True
         )
 
 
@@ -190,6 +216,8 @@ class DataReceiverSocket(_Channel):
     exact wire bytes without re-encoding (reference tees raw pickles in the
     hot loop, ``dataset.py:100-103``).
     """
+
+    wire_metrics = True  # the data stream IS the wire.* counter pair
 
     def __init__(
         self,

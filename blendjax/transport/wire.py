@@ -10,6 +10,15 @@ Two codecs share one decode entry point:
   ingest path can feed ``jax.device_put`` without a Python-object hop
   (SURVEY.md §5 "distributed communication backend").
 
+  A publisher may opt into per-frame compression (``compress_level > 0``):
+  array frames at least ``compress_min_bytes`` long whose zlib stream is
+  actually smaller ship as ``"ndz"`` entries instead of ``"nd"``. The
+  entry kind rides in the header, so decode needs no configuration —
+  ``"nd"`` and ``"ndz"`` frames interleave freely in one stream and old
+  ``"nd"``-only producers keep working unmodified. Compression is a
+  per-publisher negotiation in the same sense the codec itself is: the
+  consumer accepts everything, the producer chooses what to send.
+
 - ``PickleCodec``: single-frame pickled dict, byte-compatible with the
   reference producers (``pkg_blender/blendtorch/btb/publisher.py:43`` uses
   ``send_pyobj``; consumer ``dataset.py:105`` uses ``recv_pyobj``), so
@@ -33,6 +42,7 @@ Semantics and safety notes:
 from __future__ import annotations
 
 import pickle
+import zlib
 
 import numpy as np
 
@@ -42,10 +52,17 @@ except ImportError:  # pragma: no cover
     msgpack = None
 
 from blendjax.constants import WIRE_MAGIC
+from blendjax.utils.metrics import metrics
 
 # Pickle protocol 4: readable by every Python >= 3.4 (the reference pins 3
 # for Blender 2.8's py3.7, ``file.py:58-63``; any modern Blender reads 4).
 PICKLE_PROTOCOL = 4
+
+# Arrays below this size aren't worth a zlib round trip: the per-call
+# overhead beats the byte savings, and tiny sidecar arrays (tile indices,
+# corner coordinates) dominate frame COUNT while contributing almost no
+# frame BYTES.
+DEFAULT_COMPRESS_MIN_BYTES = 16_384
 
 
 def _np_scalar_to_py(value):
@@ -60,12 +77,19 @@ class TensorCodec:
     name = "tensor"
 
     @staticmethod
-    def encode(message: dict) -> list:
+    def encode(message: dict, compress_level: int = 0,
+               compress_min_bytes: int = DEFAULT_COMPRESS_MIN_BYTES) -> list:
         """Encode ``message`` into a list of frames (bytes / memoryview).
 
         ndarray values (non-object dtype) are shipped as raw frames;
         msgpack-native values ride in the header; anything else falls back
         to an embedded pickle so arbitrary metadata still round-trips.
+
+        With ``compress_level > 0``, array frames of at least
+        ``compress_min_bytes`` ship zlib-compressed (``"ndz"``) — but only
+        when the compressed stream actually shrinks; incompressible data
+        (already-palettized tiles, encrypted blobs) stays raw so the
+        decoder never pays an inflate for nothing.
         """
         if msgpack is None:  # pragma: no cover
             return PickleCodec.encode(message)
@@ -74,10 +98,21 @@ class TensorCodec:
         for key, value in message.items():
             if isinstance(value, np.ndarray) and value.dtype != object:
                 arr = np.ascontiguousarray(value)
+                raw = arr.data if arr.size else b""
+                if compress_level > 0 and arr.nbytes >= compress_min_bytes:
+                    # zlib takes the contiguous view directly — no copy
+                    packed = zlib.compress(raw, compress_level)
+                    if len(packed) < arr.nbytes:
+                        entries.append(
+                            ["ndz", key, list(arr.shape), arr.dtype.str,
+                             len(buffers)]
+                        )
+                        buffers.append(packed)
+                        continue
                 entries.append(
                     ["nd", key, list(arr.shape), arr.dtype.str, len(buffers)]
                 )
-                buffers.append(arr.data if arr.size else b"")
+                buffers.append(raw)
             else:
                 value = _np_scalar_to_py(value)
                 try:
@@ -92,7 +127,8 @@ class TensorCodec:
 
     @staticmethod
     def decode(frames: list, copy_arrays: bool = False,
-               allow_pickle: bool = True) -> dict:
+               allow_pickle: bool = True,
+               count_metrics: bool = False) -> dict:
         header = bytes(frames[0][: len(WIRE_MAGIC)])
         if header != WIRE_MAGIC:
             raise ValueError("not a tensor-codec message")
@@ -102,12 +138,55 @@ class TensorCodec:
         if version != 1:
             raise ValueError(f"unsupported wire version {version}")
         out = {}
+        # wire.raw_bytes / wire.compressed_bytes: decoded array bytes vs
+        # what actually crossed the wire for them — the pair the bench
+        # publishes so compression wins are evidenced, not asserted. Raw
+        # frames count into both sides (ratio 1 when nothing compresses).
+        # Accumulated locally, ONE locked pair of counts per message:
+        # sidecar arrays dominate frame count and this is the hot path.
+        raw_bytes = wire_bytes = 0
         for entry in entries:
             kind, key = entry[0], entry[1]
             if kind == "nd":
                 _, _, shape, dtype, idx = entry
                 buf = frames[1 + idx]
                 arr = np.frombuffer(buf, dtype=np.dtype(dtype)).reshape(shape)
+                raw_bytes += arr.nbytes
+                wire_bytes += arr.nbytes
+                out[key] = arr.copy() if copy_arrays else arr
+            elif kind == "ndz":
+                _, _, shape, dtype, idx = entry
+                wire_buf = frames[1 + idx]
+                dt = np.dtype(dtype)
+                expected = dt.itemsize
+                for dim in shape:
+                    expected *= int(dim)
+                if expected <= 0:
+                    raise ValueError(
+                        f"ndz frame for {key!r} declares zero bytes "
+                        "(empty arrays never ship compressed)"
+                    )
+                # Bounded inflate: allocation is capped at the DECLARED
+                # array size — no more than an honest raw "nd" frame of
+                # the same header could make us hold — so a small
+                # malicious stream can't balloon memory (decompression
+                # bomb; this path is advertised safe for untrusted
+                # networks under allow_pickle=False).
+                dec = zlib.decompressobj()
+                buf = dec.decompress(wire_buf, expected)
+                if not dec.eof or dec.unconsumed_tail:
+                    raise ValueError(
+                        f"ndz frame for {key!r} does not decompress to "
+                        f"the declared {expected} bytes"
+                    )
+                arr = np.frombuffer(buf, dtype=dt).reshape(shape)
+                raw_bytes += arr.nbytes
+                wire_bytes += (
+                    wire_buf.nbytes if isinstance(wire_buf, memoryview)
+                    else len(wire_buf)
+                )
+                # frombuffer over bytes is read-only; honor the nd-path
+                # contract (torch consumers need writable arrays)
                 out[key] = arr.copy() if copy_arrays else arr
             elif kind == "obj":
                 out[key] = msgpack.unpackb(entry[2], raw=False, strict_map_key=False)
@@ -120,6 +199,12 @@ class TensorCodec:
                 out[key] = pickle.loads(entry[2])
             else:
                 raise ValueError(f"unknown wire entry kind {kind!r}")
+        if count_metrics and raw_bytes:
+            # Only the DATA stream counts (DataReceiverSocket sets the
+            # flag): control/RPC messages through the same codec would
+            # pollute the compression-ratio pair the bench publishes.
+            metrics.count("wire.raw_bytes", raw_bytes)
+            metrics.count("wire.compressed_bytes", wire_bytes)
         return out
 
 
@@ -144,17 +229,31 @@ class PickleCodec:
 CODECS = {TensorCodec.name: TensorCodec, PickleCodec.name: PickleCodec}
 
 
-def encode_message(message: dict, codec: str = "tensor") -> list:
+def encode_message(message: dict, codec: str = "tensor",
+                   compress_level: int = 0,
+                   compress_min_bytes: int = DEFAULT_COMPRESS_MIN_BYTES) -> list:
+    if codec == TensorCodec.name:
+        return TensorCodec.encode(
+            message, compress_level=compress_level,
+            compress_min_bytes=compress_min_bytes,
+        )
     return CODECS[codec].encode(message)
 
 
 def decode_message(frames: list, copy_arrays: bool = False,
-                   allow_pickle: bool = True) -> dict:
-    """Decode frames from either codec (autodetected by leading bytes)."""
+                   allow_pickle: bool = True,
+                   count_metrics: bool = False) -> dict:
+    """Decode frames from either codec (autodetected by leading bytes).
+
+    ``count_metrics=True`` accounts the array frames into the
+    ``wire.raw_bytes``/``wire.compressed_bytes`` pair — set only by
+    data-stream receivers so control/RPC traffic stays out of the
+    published compression ratio."""
     head = bytes(frames[0][: len(WIRE_MAGIC)])
     if head == WIRE_MAGIC:
         return TensorCodec.decode(
-            frames, copy_arrays=copy_arrays, allow_pickle=allow_pickle
+            frames, copy_arrays=copy_arrays, allow_pickle=allow_pickle,
+            count_metrics=count_metrics,
         )
     return PickleCodec.decode(
         frames, copy_arrays=copy_arrays, allow_pickle=allow_pickle
@@ -163,4 +262,14 @@ def decode_message(frames: list, copy_arrays: bool = False,
 
 def sizeof_frames(frames: list) -> int:
     """Total payload bytes of an encoded message (for metrics/recording)."""
-    return sum(len(f) if isinstance(f, (bytes, bytearray)) else f.nbytes if isinstance(f, memoryview) else len(bytes(f)) for f in frames)
+    total = 0
+    for f in frames:
+        if isinstance(f, (bytes, bytearray)):
+            total += len(f)
+        elif isinstance(f, memoryview):
+            # len() of a multi-dimensional or non-byte view counts
+            # elements, not bytes — nbytes is the wire size either way.
+            total += f.nbytes
+        else:
+            total += len(bytes(f))
+    return total

@@ -16,8 +16,12 @@ from collections import defaultdict
 
 
 class Metrics:
-    """Process-local registry. Thread-safe enough for hot-loop use
-    (counter increments hold no lock; report() is approximate by design).
+    """Process-local registry. Thread-safe: counters increment under a
+    lock (uncontended CPython lock acquire is ~100 ns — noise next to
+    the per-batch work they count, and the sharded ingest pool's
+    ``wire.*``/``ingest.*`` pairs must sum EXACTLY, not approximately,
+    for the bench's compression/throughput evidence); report() reads a
+    consistent snapshot of spans but only an approximate one of gauges.
     """
 
     def __init__(self):
@@ -27,7 +31,11 @@ class Metrics:
         self._lock = threading.Lock()
 
     def count(self, name: str, n: int = 1) -> None:
-        self.counters[name] += n
+        # `dict[k] += n` is load/add/store bytecode — two workers
+        # interleaving it lose increments. The lock makes the pair of
+        # counters the bench ratios (compressed vs raw) exact.
+        with self._lock:
+            self.counters[name] += n
 
     def gauge(self, name: str, value) -> None:
         self.gauges[name] = value

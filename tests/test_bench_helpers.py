@@ -328,3 +328,28 @@ def test_pipelined_ceiling_caps_and_flags(monkeypatch):
     out = bench.measure_pipelined_ceiling(2, items=32, time_cap=0.0)
     assert out["images"] > 0 and out["img_s"] > 0
     assert out.get("capped") is True
+
+
+def test_ingest_workers_ab_row_shape(monkeypatch):
+    """The sharded-ingest A/B row runs both legs for real and reports
+    the contract the record promises: per-shard ingest.recv spans on
+    the workers-2 leg, the wire byte pair on both, and the throughput
+    ratio. Bench-shape constants shrunk for the CPU mesh like the
+    ceiling test above."""
+    import bench
+
+    monkeypatch.setattr(bench, "SHAPE", (64, 64))
+    monkeypatch.setattr(bench, "_TILE_ARGS", ["16"])
+    monkeypatch.setattr(bench, "TILE_CAPACITY", "16")
+    monkeypatch.setenv("BLENDJAX_BENCH_INSTANCES", "2")
+    row = bench.measure_ingest_workers_ab(chunk=2, items=16, time_cap=10.0)
+    assert row["workers1"]["img_s"] > 0 and row["workers2"]["img_s"] > 0
+    assert row["value"] == pytest.approx(
+        row["workers2"]["img_s"] / row["workers1"]["img_s"], rel=1e-3
+    )
+    assert "ingest.recv" in row["workers1"]["recv_spans"]
+    shard_spans = set(row["workers2"]["recv_spans"])
+    assert {"ingest.recv.shard0", "ingest.recv.shard1"} <= shard_spans
+    for leg in ("workers1", "workers2"):
+        wire = row[leg]["wire"]
+        assert wire["wire.raw_bytes"] >= wire["wire.compressed_bytes"] > 0

@@ -1,6 +1,7 @@
 """Data pipeline tests: schema, record/replay, streaming, batching."""
 
 import threading
+import time
 
 import numpy as np
 import pytest
@@ -243,6 +244,67 @@ def test_remote_stream_worker_split():
 # -- batching ---------------------------------------------------------------
 
 
+def test_batch_assembler_flush_emits_partial_tail():
+    schema = StreamSchema.infer(_item(0))
+    asm = BatchAssembler(schema, batch_size=4)
+    assert asm.flush() is None  # nothing pending
+    for i in range(6):
+        asm.add(_item(i))
+    tail = asm.flush()
+    assert tail["_partial"] is True
+    np.testing.assert_array_equal(tail["frameid"], [4, 5])
+    assert tail["image"].shape == (2, 4, 6, 4)
+    assert [m["btid"] for m in tail["_meta"]] == [0, 0]
+    assert asm.flush() is None  # one-shot
+
+
+def test_host_ingest_emit_partial_final():
+    """A finite stream's tail items surface as a _partial batch when
+    opted in — and stay dropped (reference behavior) by default."""
+    items = [_item(i) for i in range(6)]
+    batches = list(HostIngest(items, batch_size=4, emit_partial_final=True))
+    assert len(batches) == 2
+    assert not batches[0].get("_partial")
+    assert batches[1]["_partial"] and len(batches[1]["frameid"]) == 2
+    got = sorted(int(v) for b in batches for v in b["frameid"])
+    assert got == list(range(6))
+    # default: tail silently dropped, exactly as before
+    batches = list(HostIngest([_item(i) for i in range(6)], batch_size=4))
+    assert len(batches) == 1 and len(batches[0]["frameid"]) == 4
+
+
+def test_host_ingest_stop_returns_promptly_and_joins():
+    """The stop() shutdown race: signalling then draining ONCE could
+    swallow _DONE while the thread was still emitting, leaving join to
+    burn its whole timeout. stop() must return promptly with the thread
+    actually dead — even when the worker sits in a long recv (the
+    request_stop path) or keeps producing into a full queue."""
+    # blocked-in-recv case: 60s timeout, no producer traffic
+    pub = DataPublisherSocket(WILD, btid=0)
+    stream = RemoteStream([pub.addr], timeoutms=60_000)
+    ingest = HostIngest(stream, batch_size=4, prefetch=1).start()
+    time.sleep(0.4)  # thread is inside the sliced poll
+    t0 = time.monotonic()
+    ingest.stop()
+    assert time.monotonic() - t0 < 5.0
+    assert not ingest._thread.is_alive()
+    pub.close()
+
+    # producing-into-full-queue case: infinite stream, consumer absent
+    def forever():
+        i = 0
+        while True:
+            yield _item(i)
+            i += 1
+
+    ingest = HostIngest(forever(), batch_size=2, prefetch=1).start()
+    time.sleep(0.4)  # queue is full, thread parked in _emit
+    t0 = time.monotonic()
+    ingest.stop()
+    assert time.monotonic() - t0 < 5.0
+    assert not ingest._thread.is_alive()
+
+
 def test_batch_assembler_packs_and_recycles():
     schema = StreamSchema.infer(_item(0))
     asm = BatchAssembler(schema, batch_size=3, num_buffers=2)
@@ -342,6 +404,90 @@ def test_host_ingest_rebatches_mismatched_producer_batches():
     t.join(timeout=10)
     ingest.stop()
     pub.close()
+
+
+def test_passthrough_dtype_mismatch_falls_back_to_split():
+    """A producer batch with the right shapes but a wrong dtype can't
+    take the zero-copy passthrough; the split path engages and per-item
+    validation rejects the items loudly (fail fast, not a silent cast
+    into the preallocated buffers)."""
+    from blendjax.data.batcher import passthrough_batch
+
+    schema = StreamSchema.infer(_item(0))
+    good = _batched_item(0, 4)
+    good.pop("_batched")
+    assert passthrough_batch(good, schema, 4) is not None
+    bad = dict(good)
+    bad["xy"] = bad["xy"].astype(np.float64)
+    assert passthrough_batch(bad, schema, 4) is None  # falls back to split
+
+    pub = DataPublisherSocket(WILD, btid=0)
+    stream = RemoteStream([pub.addr], timeoutms=2000)
+    ingest = HostIngest(stream, batch_size=4)
+    wire_bad = _batched_item(0, 4)
+    wire_bad["xy"] = wire_bad["xy"].astype(np.float64)
+    t = _publish_async(pub, [_batched_item(4, 4), wire_bad])
+    with pytest.raises(SchemaError, match="dtype"):
+        list(ingest)
+    t.join(timeout=10)
+    pub.close()
+
+
+def test_batched_views_with_scalar_sidecar_fields():
+    """Scalar (and mismatched-lead) sidecars replicate into every split
+    item instead of being sliced; the passthrough correctly refuses the
+    message (a scalar field can't match a (B,)-shaped schema spec)."""
+    from blendjax.data.batcher import batched_views, passthrough_batch
+
+    item = _batched_item(0, 3)
+    item.pop("_batched")
+    item["frameid"] = 7  # shared scalar, not a per-item array
+    item["palette"] = np.arange(5)  # lead dim 5 != 3: sidecar, replicated
+    views = list(batched_views(item))
+    assert len(views) == 3
+    assert [v["frameid"] for v in views] == [7, 7, 7]
+    for v in views:
+        np.testing.assert_array_equal(v["palette"], np.arange(5))
+        assert v["image"].shape == (4, 6, 4)
+    schema = StreamSchema.infer(_item(0))
+    assert passthrough_batch(item, schema, 3) is None
+
+    # end to end: the split path re-batches, scalar broadcast to items
+    pub = DataPublisherSocket(WILD, btid=0)
+    stream = RemoteStream([pub.addr], timeoutms=2000)
+    ingest = HostIngest(stream, batch_size=3)
+    msg = _batched_item(0, 3)
+    msg["frameid"] = 7
+    t = _publish_async(pub, [msg])
+    batch = next(iter(ingest))
+    np.testing.assert_array_equal(batch["frameid"], [7, 7, 7])
+    t.join(timeout=10)
+    ingest.stop()
+    pub.close()
+
+
+def test_passthrough_meta_fans_out_per_item():
+    """_meta from a producer batch: per-item arrays slice out one row
+    per item, shared scalars replicate — each item's provenance stays
+    item-shaped for downstream consumers."""
+    from blendjax.data.batcher import passthrough_batch
+
+    schema = StreamSchema(
+        {
+            "image": (( 4, 6, 4), np.uint8),
+            "xy": ((8, 2), np.float32),
+            "frameid": ((), np.int64),
+        },
+        meta_keys=("btid", "seq", "tag"),
+    )
+    item = _batched_item(0, 4)
+    item.pop("_batched")
+    item["seq"] = np.arange(100, 104)  # per-item: fans out one each
+    item["tag"] = "runA"  # shared: replicated
+    batch = passthrough_batch(item, schema, 4)
+    assert [m["seq"] for m in batch["_meta"]] == [100, 101, 102, 103]
+    assert [m["tag"] for m in batch["_meta"]] == ["runA"] * 4
+    assert [m["btid"] for m in batch["_meta"]] == [0] * 4
 
 
 def test_host_ingest_mixed_batched_and_single_producers():

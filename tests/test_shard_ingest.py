@@ -1,0 +1,361 @@
+"""Sharded parallel ingest: partitioning, lock-cheap parallel assembly,
+worker-pool semantics, and the single-vs-sharded CPU microbench."""
+
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from blendjax.data import (
+    HostIngest,
+    ParallelBatchAssembler,
+    RemoteStream,
+    ShardedHostIngest,
+    StreamSchema,
+    partition_addresses,
+)
+from blendjax.data.schema import SchemaError
+from blendjax.transport import DataPublisherSocket
+from blendjax.transport.wire import decode_message, encode_message
+
+WILD = "tcp://127.0.0.1:*"
+
+
+def _item(i, h=4, w=6):
+    return {
+        "btid": 0,
+        "image": np.full((h, w, 4), i % 255, np.uint8),
+        "xy": np.full((8, 2), float(i), np.float32),
+        "frameid": i,
+    }
+
+
+# -- shard partitioning ------------------------------------------------------
+
+
+def test_partition_addresses_round_robin():
+    assert partition_addresses(["a", "b", "c", "d", "e"], 2) == [
+        ["a", "c", "e"], ["b", "d"],
+    ]
+    assert partition_addresses(["a", "b", "c"], 3) == [["a"], ["b"], ["c"]]
+
+
+def test_partition_addresses_clamps_to_fleet_size():
+    # never more shards than producers, never an empty shard
+    assert partition_addresses(["a", "b"], 8) == [["a"], ["b"]]
+    assert partition_addresses("tcp://one", 4) == [["tcp://one"]]
+    assert partition_addresses(["a", "b", "c"], 0) == [["a", "b", "c"]]
+
+
+# -- parallel assembly -------------------------------------------------------
+
+
+def test_parallel_assembler_no_lost_or_duplicated_slots():
+    """4 writer threads x 100 items through reserve/write: every item
+    lands in exactly one slot of exactly one batch (ids recorded at
+    emit time — the bounded-queue contract)."""
+    schema = StreamSchema.infer(_item(0))
+    asm = ParallelBatchAssembler(schema, batch_size=8, num_buffers=8)
+    seen = []
+    lock = threading.Lock()
+
+    def writer(lo, hi):
+        for i in range(lo, hi):
+            pending, slot = asm.reserve()
+            batch = asm.write(pending, slot, _item(i))
+            if batch is not None:
+                with lock:
+                    seen.extend(int(v) for v in batch["frameid"])
+                    seen_meta.append(len(batch["_meta"]))
+
+    seen_meta = []
+    threads = [
+        threading.Thread(target=writer, args=(k * 100, (k + 1) * 100))
+        for k in range(4)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert sorted(seen) == list(range(400))
+    assert seen_meta == [8] * 50  # every batch carried full _meta
+
+
+def test_parallel_assembler_flush_partial():
+    schema = StreamSchema.infer(_item(0))
+    asm = ParallelBatchAssembler(schema, batch_size=4, num_buffers=3)
+    assert asm.flush() is None
+    for i in range(3):
+        assert asm.add(_item(i)) is None
+    tail = asm.flush()
+    assert tail["_partial"] is True
+    assert [int(v) for v in tail["frameid"]] == [0, 1, 2]
+    assert len(tail["_meta"]) == 3
+    assert asm.flush() is None  # flush is one-shot
+
+
+# -- worker pool over plain iterables ---------------------------------------
+
+
+def test_sharded_ingest_counts_and_partial_final():
+    streams = [[_item(i) for i in range(k, 60, 3)] for k in range(3)]
+    ingest = ShardedHostIngest(
+        streams, batch_size=8, emit_partial_final=True
+    )
+    # consume incrementally: batch buffers recycle (pool contract, same
+    # as the serial BatchAssembler) so a test must not retain them all
+    got, partial_sizes = [], []
+    for b in ingest:
+        got.extend(int(v) for v in b["frameid"])
+        if b.get("_partial"):
+            partial_sizes.append(len(b["frameid"]))
+    assert sorted(got) == list(range(60))
+    assert ingest.items_in == 60
+    assert partial_sizes == [60 % 8]
+
+
+def test_sharded_ingest_drops_tail_without_opt_in():
+    streams = [[_item(i) for i in range(k, 30, 2)] for k in range(2)]
+    batches = list(ShardedHostIngest(streams, batch_size=8))
+    assert sum(len(b["frameid"]) for b in batches) == 24  # 30 - (30 % 8)
+    assert not any(b.get("_partial") for b in batches)
+
+
+def test_sharded_ingest_propagates_shard_error():
+    bad = dict(_item(1))
+    bad["image"] = np.zeros((9, 9, 4), np.uint8)
+    ingest = ShardedHostIngest(
+        [[_item(0)], [_item(2), bad]], batch_size=2
+    )
+    with pytest.raises(SchemaError):
+        list(ingest)
+
+
+# -- worker pool over real sockets ------------------------------------------
+
+
+def _publish_async(pub, items):
+    t = threading.Thread(
+        target=lambda: [pub.publish(**it) for it in items], daemon=True
+    )
+    t.start()
+    return t
+
+
+def test_sharded_ingest_two_producers_two_shards():
+    pubs = [DataPublisherSocket(WILD, btid=k) for k in range(2)]
+    feeders = [
+        _publish_async(pub, [_item(k * 20 + i) for i in range(20)])
+        for k, pub in enumerate(pubs)
+    ]
+    shards = partition_addresses([p.addr for p in pubs], 2)
+    streams = [
+        RemoteStream(
+            shard, timeoutms=5000, max_items=40,
+            worker_index=i, num_workers=2,
+        )
+        for i, shard in enumerate(shards)
+    ]
+    ingest = ShardedHostIngest(streams, batch_size=8)
+    got = sorted(int(v) for b in ingest for v in b["frameid"])
+    assert got == list(range(40))
+    for t in feeders:
+        t.join(timeout=10)
+    for p in pubs:
+        p.close()
+
+
+def test_sharded_ingest_stop_responsive_under_long_timeout():
+    """stop() must return promptly even while every worker is parked in
+    a long recv (the request_stop poll-slice path), and must not leave
+    live threads behind."""
+    pub = DataPublisherSocket(WILD, btid=0)
+    streams = [RemoteStream([pub.addr], timeoutms=60_000) for _ in range(2)]
+    ingest = ShardedHostIngest(streams, batch_size=4).start()
+    time.sleep(0.6)  # both workers are inside the sliced poll now
+    t0 = time.monotonic()
+    ingest.stop()
+    assert time.monotonic() - t0 < 5.0
+    assert not any(t.is_alive() for t in ingest._threads)
+    pub.close()
+
+
+def test_pipeline_ingest_workers_integration():
+    """StreamDataPipeline(ingest_workers=2) over two producers: the
+    sharded pool feeds the same device pipeline, nothing lost."""
+    from blendjax.data import StreamDataPipeline
+
+    pubs = [DataPublisherSocket(WILD, btid=k) for k in range(2)]
+    feeders = [
+        _publish_async(pub, [_item(k * 16 + i) for i in range(16)])
+        for k, pub in enumerate(pubs)
+    ]
+    with StreamDataPipeline(
+        [p.addr for p in pubs], batch_size=8, ingest_workers=2,
+        timeoutms=5000, max_items=32,
+    ) as pipe:
+        got = sorted(
+            int(v) for b in pipe for v in np.asarray(b["frameid"])
+        )
+    assert got == list(range(32))
+    assert isinstance(pipe.ingest, ShardedHostIngest)
+    for t in feeders:
+        t.join(timeout=10)
+    for p in pubs:
+        p.close()
+
+
+def test_pipeline_single_worker_keeps_host_ingest():
+    from blendjax.data import StreamDataPipeline
+
+    pub = DataPublisherSocket(WILD, btid=0)
+    feeder = _publish_async(pub, [_item(i) for i in range(8)])
+    with StreamDataPipeline(
+        [pub.addr], batch_size=4, timeoutms=5000, max_items=8
+    ) as pipe:
+        got = sorted(
+            int(v) for b in pipe for v in np.asarray(b["frameid"])
+        )
+    assert got == list(range(8))
+    assert isinstance(pipe.ingest, HostIngest)  # default path unchanged
+    feeder.join(timeout=10)
+    pub.close()
+    # a single producer can't shard: ingest_workers=2 falls back (a
+    # FRESH publisher — reusing the first one races its dying PULL
+    # pipe, which is the at-most-once contract, not a bug here)
+    pub2 = DataPublisherSocket(WILD, btid=1)
+    feeder2 = _publish_async(pub2, [_item(i) for i in range(8)])
+    with StreamDataPipeline(
+        [pub2.addr], batch_size=4, ingest_workers=2,
+        timeoutms=5000, max_items=8,
+    ) as pipe:
+        list(pipe)
+    assert isinstance(pipe.ingest, HostIngest)
+    feeder2.join(timeout=10)
+    pub2.close()
+
+
+def test_pipeline_sharded_max_items_is_global_across_unequal_shards():
+    """max_items is enforced as ONE pool-wide budget, not an even
+    per-shard split: shards see disjoint producer subsets, so a split
+    would block one shard on messages only the other shard's producers
+    hold (and silently strand the surplus)."""
+    from blendjax.data import StreamDataPipeline
+
+    pubs = [DataPublisherSocket(WILD, btid=k) for k in range(2)]
+    counts = [24, 8]  # a 16/16 split would strand 8 and time out on 8
+    feeders = [
+        _publish_async(pub, [_item(k * 100 + i) for i in range(counts[k])])
+        for k, pub in enumerate(pubs)
+    ]
+    with StreamDataPipeline(
+        [p.addr for p in pubs], batch_size=8, ingest_workers=2,
+        timeoutms=8000, max_items=32,
+    ) as pipe:
+        got = [int(v) for b in pipe for v in np.asarray(b["frameid"])]
+    assert sorted(got) == sorted(
+        list(range(24)) + [100 + i for i in range(8)]
+    )
+    for t in feeders:
+        t.join(timeout=10)
+    for p in pubs:
+        p.close()
+
+
+def test_wire_counters_scoped_to_data_stream():
+    """Control/RPC channels decode through the same codec but must not
+    pollute the wire.raw/compressed byte pair the bench publishes."""
+    from blendjax.transport import PairChannel
+    from blendjax.utils.metrics import metrics
+
+    metrics.reset()
+    prod = PairChannel(WILD, btid=1, bind=True)
+    cons = PairChannel(prod.addr, btid=None, bind=False)
+    cons.send(params=np.zeros((64, 64), np.float32))
+    got = prod.recv(timeoutms=5000)
+    assert got is not None and got["params"].shape == (64, 64)
+    assert not any(k.startswith("wire.") for k in metrics.counters)
+    prod.close(); cons.close()
+
+    pub = DataPublisherSocket(WILD, btid=0)
+    feeder = _publish_async(pub, [_item(0)])
+    stream = RemoteStream([pub.addr], timeoutms=5000, max_items=1)
+    list(stream)
+    feeder.join(timeout=10)
+    assert metrics.counters["wire.raw_bytes"] > 0  # data stream counts
+    pub.close()
+
+
+def test_pipeline_rejects_worker_kwargs_with_sharding():
+    from blendjax.data import StreamDataPipeline
+
+    with pytest.raises(ValueError, match="worker"):
+        StreamDataPipeline(
+            ["tcp://a", "tcp://b"], batch_size=4, ingest_workers=2,
+            num_workers=2,
+        )
+
+
+# -- the microbench: sharded beats single-threaded ---------------------------
+
+
+@pytest.mark.skipif(
+    (os.cpu_count() or 1) < 2, reason="needs >=2 cores to show overlap"
+)
+def test_sharded_ingest_outpaces_single_worker():
+    """CPU-only microbench (acceptance criterion): >=2 producers'
+    decode work (zlib "ndz" inflate + memcpy, both GIL-releasing)
+    overlaps across 2 shards, so the pool's items/s beats the
+    single-thread path on the same message set. In-process streams
+    (pre-encoded wire frames, decoded inside the iterator) keep the
+    work deterministic — the bench covers the socket layer."""
+    rng = np.random.default_rng(0)
+    base = np.repeat(rng.integers(0, 50, 65536, dtype=np.uint8), 16)
+    n_msgs, n_shards = 48, 2
+
+    def wire(i):
+        return [
+            bytes(f) for f in encode_message(
+                {
+                    "btid": i % n_shards,
+                    "image": np.roll(base, i).reshape(1024, 1024),
+                    "frameid": i,
+                },
+                compress_level=1, compress_min_bytes=1024,
+            )
+        ]
+
+    messages = [wire(i) for i in range(n_msgs)]
+
+    def decoding_stream(msgs):
+        for frames in msgs:
+            yield dict(decode_message(frames))
+
+    def run_once(sharded: bool) -> float:
+        if sharded:
+            shards = [messages[k::n_shards] for k in range(n_shards)]
+            ingest = ShardedHostIngest(
+                [decoding_stream(s) for s in shards], batch_size=8,
+                prefetch=4,
+            )
+        else:
+            ingest = HostIngest(
+                decoding_stream(messages), batch_size=8, prefetch=4
+            )
+        t0 = time.perf_counter()
+        n = sum(len(b["frameid"]) for b in ingest)
+        dt = time.perf_counter() - t0
+        assert n == n_msgs
+        return n / dt
+
+    # best-of-2 each, interleaved, so a scheduler hiccup on one pass
+    # can't decide the comparison
+    single = max(run_once(False), run_once(False))
+    sharded = max(run_once(True), run_once(True))
+    assert sharded > single, (
+        f"sharded pool ({sharded:.1f} items/s) should beat the single "
+        f"worker ({single:.1f} items/s) with {n_shards} shards of "
+        "GIL-releasing decode work"
+    )

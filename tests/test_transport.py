@@ -236,3 +236,114 @@ def test_decode_rejects_pickle_when_disallowed():
 
     with _pytest.raises(ValueError, match="pickle"):
         decode_message(frames, allow_pickle=False)
+
+
+# -- compressed wire frames ("ndz") ------------------------------------------
+
+
+def test_ndz_roundtrip_and_interleaving():
+    """Compressible arrays above the threshold ship as zlib "ndz"
+    entries; small or incompressible ones stay raw "nd" — both kinds
+    interleave in one message and decode bit-exact."""
+    from blendjax.transport.wire import sizeof_frames
+
+    compressible = np.tile(
+        np.arange(64, dtype=np.uint8), 4096
+    ).reshape(512, 512)
+    rng = np.random.default_rng(7)
+    incompressible = rng.integers(0, 256, (256, 256), dtype=np.uint8)
+    tiny = np.arange(16, dtype=np.float32)
+    msg = {
+        "img": compressible,
+        "noise": incompressible,
+        "xy": tiny,
+        "frameid": 3,
+        "name": "cube",
+    }
+    plain = encode_message(msg)
+    packed = encode_message(msg, compress_level=6, compress_min_bytes=1024)
+    assert sizeof_frames(packed) < sizeof_frames(plain) // 2
+    # noise frame shipped raw: compression would not have shrunk it
+    assert any(
+        bytes(a) == incompressible.tobytes() for a in packed[1:]
+    )
+    out = decode_message(packed)
+    np.testing.assert_array_equal(out["img"], compressible)
+    np.testing.assert_array_equal(out["noise"], incompressible)
+    np.testing.assert_array_equal(out["xy"], tiny)
+    assert out["frameid"] == 3 and out["name"] == "cube"
+
+
+def test_ndz_decodes_with_pickle_disallowed():
+    """The compressed path is pickle-free: an untrusted-network consumer
+    (allow_pickle=False) accepts "ndz" frames."""
+    msg = {"img": np.zeros((256, 256), np.uint8), "frameid": 1}
+    frames = encode_message(msg, compress_level=1, compress_min_bytes=1024)
+    out = decode_message(frames, allow_pickle=False)
+    np.testing.assert_array_equal(out["img"], msg["img"])
+
+
+def test_ndz_rejects_decompression_bomb_and_truncation():
+    """The inflate is bounded by the DECLARED array size (the
+    untrusted-network path must not allocate more than an honest raw
+    frame could make it hold), and truncated streams fail loudly."""
+    import zlib
+
+    import msgpack
+
+    from blendjax.constants import WIRE_MAGIC
+
+    bomb = zlib.compress(b"\x00" * (1 << 20), 9)  # ~1 KB -> 1 MB
+    hdr = WIRE_MAGIC + msgpack.packb(
+        [1, [["ndz", "x", [4], "|u1", 0]]], use_bin_type=True
+    )
+    with pytest.raises(ValueError, match="declared"):
+        decode_message([hdr, bomb])
+
+    good = encode_message(
+        {"x": np.zeros(65536, np.uint8)}, compress_level=1
+    )
+    with pytest.raises(ValueError, match="declared"):
+        decode_message([good[0], bytes(good[1])[:-4]])
+
+
+def test_ndz_below_threshold_stays_raw():
+    msg = {"img": np.zeros((64,), np.uint8)}
+    frames = encode_message(msg, compress_level=9, compress_min_bytes=1024)
+    assert bytes(frames[1]) == msg["img"].tobytes()
+
+
+def test_ndz_over_socket_with_compressing_publisher():
+    """A compress_level publisher feeds an UNMODIFIED receiver — the
+    per-publisher negotiation is one-sided by design."""
+    pub = DataPublisherSocket(
+        WILD, btid=0, compress_level=6, compress_min_bytes=1024
+    )
+    recv = DataReceiverSocket([pub.addr], timeoutms=5000)
+    img = np.tile(np.arange(256, dtype=np.uint8), 1024).reshape(512, 512)
+    pub.publish(image=img, frameid=5)
+    msg, raw = recv.recv(copy_arrays=True)
+    np.testing.assert_array_equal(msg["image"], img)
+    assert msg["frameid"] == 5
+    # the wire actually carried the compressed frame
+    from blendjax.transport import sizeof_frames
+
+    assert sizeof_frames(raw) < img.nbytes // 2
+    recv.close(); pub.close()
+
+
+def test_sizeof_frames_counts_all_frame_types():
+    import array
+
+    from blendjax.transport.wire import sizeof_frames
+
+    arr = np.arange(12, dtype=np.uint8)
+    frames = [
+        b"0123",                      # bytes
+        bytearray(b"456789"),         # bytearray
+        memoryview(arr),              # memoryview (nbytes, not len)
+        arr.reshape(3, 4).data,       # multi-dim view: len() counts rows
+        np.arange(3, dtype=np.int32).data,  # itemsize 4: len() counts items
+        array.array("B", [1, 2, 3]),  # other buffer: the bytes() fallback
+    ]
+    assert sizeof_frames(frames) == 4 + 6 + 12 + 12 + 12 + 3
