@@ -64,6 +64,15 @@ OVERLAP = os.environ.get("BLENDJAX_BENCH_OVERLAP", "0") == "1"
 # the sharded recv/decode pool's win (or non-win, on 1-core hosts) is
 # re-evidenced every round. Off in degraded windows like the other rows.
 INGEST_AB = os.environ.get("BLENDJAX_BENCH_INGEST_AB", "1") == "1"
+# Async overlap driver A/B row (docs/performance.md "Closing the
+# live-MFU gap"): the fused single-dispatch-per-step path driven by
+# TrainDriver at inflight=1 (serialized baseline) vs inflight=N, with
+# dispatch counts, decode.dispatch elimination, and the steps-in-flight
+# high-water mark in the record.
+LIVE_OVERLAP = os.environ.get("BLENDJAX_BENCH_LIVE_OVERLAP", "1") == "1"
+LIVE_OVERLAP_INFLIGHT = int(
+    os.environ.get("BLENDJAX_BENCH_LIVE_OVERLAP_INFLIGHT", "4")
+)
 # The non-sparse row's codec: 'pal' (lossless full-frame palette; 4-8x
 # fewer bytes across socket AND host->device, decoded by a device
 # gather) or 'raw' (uncompressed frames). pal chunk-groups 8 batches
@@ -216,7 +225,9 @@ def ceiling_ratio_row(ips: float, ceiling: dict, headline_fit: bool):
 def measure(encoding: str, chunk: int, items: int, time_cap: float,
             with_stages: bool = True, tile_args=None,
             tile_capacity=None, model=None, loss_fn=None,
-            ingest_workers: int = 1) -> dict:
+            ingest_workers: int = 1,
+            driver_inflight: int | None = None,
+            driver_sync_every: int = 16) -> dict:
     """One full producer-fleet + pipeline + train measurement pass.
 
     ``tile_args``/``tile_capacity`` default to the module-level bench
@@ -226,7 +237,12 @@ def measure(encoding: str, chunk: int, items: int, time_cap: float,
     passes a StreamFormer + reshaping loss instead. ``ingest_workers``
     feeds straight through to ``StreamDataPipeline`` (>=2 shards the
     consumer's receive/decode across threads; the per-shard
-    ``ingest.recv.shard*`` spans land in the stage breakdown)."""
+    ``ingest.recv.shard*`` spans land in the stage breakdown).
+    ``driver_inflight`` switches the consumer loop to the async overlap
+    path: ``emit_packed=True`` + ``make_fused_tile_step`` (exactly one
+    device dispatch per step, no standalone decode.dispatch) driven by
+    ``TrainDriver(inflight=N, sync_every=driver_sync_every)``; the
+    driver's stats land under ``result["driver"]``."""
     import jax
 
     from blendjax.data import StreamDataPipeline
@@ -234,6 +250,7 @@ def measure(encoding: str, chunk: int, items: int, time_cap: float,
     from blendjax.models import CubeRegressor
     from blendjax.parallel import batch_sharding, create_mesh
     from blendjax.train import (
+        TrainDriver,
         make_chunked_supervised_step,
         make_fused_tile_step,
         make_supervised_step,
@@ -266,7 +283,17 @@ def measure(encoding: str, chunk: int, items: int, time_cap: float,
     # device round trips (the binding constraint on high-latency links).
     # Tile and pal streams both chunk-group; raw mode steps per batch.
     chunk = chunk if encoding in ("tile", "pal") else 1
-    if chunk > 1 and FUSED:
+    driver = None
+    if driver_inflight is not None:
+        # Async overlap path: fused decode+step (one dispatch per step)
+        # with up to `inflight` dispatches outstanding. inflight=1 is
+        # the serialized A/B baseline on the identical program.
+        step = make_fused_tile_step(loss_fn=loss_fn)
+        driver = TrainDriver(
+            step, state, inflight=driver_inflight,
+            sync_every=driver_sync_every,
+        )
+    elif chunk > 1 and FUSED:
         step = make_fused_tile_step(loss_fn=loss_fn)
     elif chunk > 1:
         step = make_chunked_supervised_step(loss_fn=loss_fn)
@@ -309,12 +336,18 @@ def measure(encoding: str, chunk: int, items: int, time_cap: float,
             if "_packed" in sb:
                 from blendjax.ops.tiles import TILEIDX_SUFFIX
 
-                # packed chunk group: K' rows x the tileidx lead dim B
-                idx_shape = next(
-                    s for n, d, s, o, b in sb["_spec"]
-                    if n.endswith(TILEIDX_SUFFIX)
+                # packed chunk group: K' rows x the per-batch lead dim B
+                # (the tileidx lead for tile groups, xy for pal groups)
+                lead = next(
+                    (s[0] for n, d, s, o, b in sb["_spec"]
+                     if n.endswith(TILEIDX_SUFFIX)),
+                    None,
                 )
-                return sb["_packed"].shape[0] * idx_shape[0]
+                if lead is None:
+                    lead = next(
+                        s[0] for n, d, s, o, b in sb["_spec"] if n == "xy"
+                    )
+                return sb["_packed"].shape[0] * lead
             # chunked superbatches are (K, B, ...); raw batches (B, ...)
             return (
                 sb["image"].shape[0] * sb["image"].shape[1]
@@ -328,14 +361,17 @@ def measure(encoding: str, chunk: int, items: int, time_cap: float,
         def run_step(state, sb):
             if "_packed" in sb:
                 return step(state, sb)
-            return step(state, {"image": sb["image"], "xy": sb["xy"]})
+            fields = {"image": sb["image"], "xy": sb["xy"]}
+            if "_mask" in sb:  # bucket-padded tail: loss-masked rows
+                fields["_mask"] = sb["_mask"]
+            return step(state, fields)
 
         with StreamDataPipeline(
             launcher.addresses["DATA"],
             batch_size=BATCH,
             sharding=sharding,
             chunk=chunk,
-            emit_packed=chunk > 1 and FUSED,
+            emit_packed=(chunk > 1 and FUSED) or driver is not None,
             ingest_workers=ingest_workers,
             timeoutms=60_000,
         ) as pipe:
@@ -347,15 +383,22 @@ def measure(encoding: str, chunk: int, items: int, time_cap: float,
             # measured window.
             for _ in range(max(2, WARMUP_BATCHES // chunk)):
                 sb = next(it)  # warmup: compile + fill queues
-                state, metrics = run_step(state, sb)
+                if driver is not None:
+                    driver.submit(sb)
+                else:
+                    state, metrics = run_step(state, sb)
             # Sync by fetching the value, not block_until_ready: on
             # tunneled/experimental backends block_until_ready can return
             # with steps still in flight, and the loss value transitively
             # depends on every dispatched step (donated-state chain) — a
             # d2h fetch is the one sync that is honest everywhere.
-            last_loss(metrics)
+            if driver is not None:
+                driver.drain()
+            else:
+                last_loss(metrics)
 
             reg.reset()  # stage spans cover the measured window only
+            drv0 = dict(driver.stats) if driver is not None else None
             images = 0
             t_next = t_step = 0.0
             pool = fut = None
@@ -377,7 +420,9 @@ def measure(encoding: str, chunk: int, items: int, time_cap: float,
                 ta = time.perf_counter()
                 sb = next(it)
                 tb = time.perf_counter()
-                if pool is not None:
+                if driver is not None:
+                    driver.submit(sb)
+                elif pool is not None:
                     if fut is not None:
                         state, metrics = fut.result()
                     fut = pool.submit(run_step, state, sb)
@@ -394,7 +439,10 @@ def measure(encoding: str, chunk: int, items: int, time_cap: float,
             if pool is not None:
                 pool.shutdown(wait=True)
             t_sync0 = time.perf_counter()
-            final_loss = last_loss(metrics)  # full drain, see above
+            if driver is not None:
+                final_loss = driver.drain()  # full drain, see above
+            else:
+                final_loss = last_loss(metrics)  # full drain, see above
             t_sync = time.perf_counter() - t_sync0
             dt = time.perf_counter() - t0
 
@@ -408,6 +456,20 @@ def measure(encoding: str, chunk: int, items: int, time_cap: float,
         "seconds": round(dt, 2),
         "final_loss": final_loss,
     }
+    if driver is not None:
+        # measured-window driver behavior only (warmup deltas removed;
+        # the high-water mark is a max, not a delta, and warmup cannot
+        # exceed the same `inflight` bound)
+        stats = driver.stats
+        result["driver"] = {
+            "inflight": stats["inflight"],
+            "sync_every": driver_sync_every,
+            "dispatches": stats["dispatches"] - drv0["dispatches"],
+            "steps": stats["steps"] - drv0["steps"],
+            "host_blocks": stats["host_blocks"] - drv0["host_blocks"],
+            "syncs": stats["syncs"] - drv0["syncs"],
+            "inflight_hwm": stats["inflight_hwm"],
+        }
     if with_stages:
         # Per-stage breakdown (VERDICT r1 item 1): consumer-loop wall
         # split + pipeline spans, so the binding constraint is
@@ -429,7 +491,19 @@ def measure(encoding: str, chunk: int, items: int, time_cap: float,
             },
             "counters": {
                 k: int(v) for k, v in reg.counters.items()
-                if k.startswith(("tiles.", "ingest.", "pal.", "wire."))
+                if k.startswith(
+                    ("tiles.", "ingest.", "pal.", "wire.", "train.",
+                     "feed.")
+                )
+            },
+            # Occupancy gauges beside the counters: queue_full_waits
+            # alone can't separate backpressure (queue_depth_hwm pinned
+            # at prefetch) from overlap stalls (hwm ~0 while the
+            # consumer starves) — the gauge pair makes the two regimes
+            # distinguishable in the record.
+            "gauges": {
+                k: v for k, v in reg.gauges.items()
+                if k.startswith(("ingest.", "feed."))
             },
         }
     return result
@@ -849,6 +923,61 @@ def measure_ingest_workers_ab(chunk: int, items: int | None = None,
     return row
 
 
+def measure_live_overlap(chunk: int, items: int | None = None,
+                         time_cap: float = 30.0,
+                         inflight: int | None = None) -> dict:
+    """Interleaved async-overlap A/B on the live tile stream: the SAME
+    fused single-dispatch-per-step program driven by ``TrainDriver`` at
+    ``inflight=1`` (the serialized dispatch-wait-dispatch baseline) vs
+    ``inflight=N``.
+
+    Each leg reports the driver's dispatch count (exactly one device
+    call per step on the fused path — ``dispatch_per_step`` proves it),
+    the ``decode.dispatch`` span count (0 = the standalone decode jit is
+    eliminated), genuine ring-full ``host_blocks``, and the
+    steps-in-flight high-water mark. ``value`` is the inflight-N /
+    inflight-1 throughput ratio (>1 means keeping dispatches in flight
+    pays on this link)."""
+    items = min(192, MEASURE_ITEMS) if items is None else items
+    inflight = LIVE_OVERLAP_INFLIGHT if inflight is None else inflight
+    # inflight<=1 would A/B a leg against itself (and burn the second
+    # measurement for a meaningless ~1.0 ratio)
+    inflight = max(2, int(inflight))
+    row: dict = {}
+    for n in (1, inflight):
+        leg = measure(
+            ENCODING, chunk, items, time_cap,
+            with_stages=True, driver_inflight=n,
+        )
+        spans = leg.get("stages", {}).get("spans", {})
+        drv = leg.get("driver", {})
+        decode_calls = spans.get("decode.dispatch", {}).get("count", 0)
+        train_calls = spans.get("train.dispatch", {}).get("count", 0)
+        row[f"inflight{n}"] = {
+            "img_s": leg["value"],
+            "images": leg["images"],
+            "seconds": leg["seconds"],
+            "dispatches": drv.get("dispatches"),
+            "steps_in_flight_hwm": drv.get("inflight_hwm"),
+            "host_blocks": drv.get("host_blocks"),
+            "decode_dispatch_count": decode_calls,
+            "train_dispatch_count": train_calls,
+        }
+    one, many = row["inflight1"], row[f"inflight{inflight}"]
+    row["decode_dispatch_eliminated"] = (
+        one["decode_dispatch_count"] == 0
+        and many["decode_dispatch_count"] == 0
+    )
+    # one jit call per driver step: the fused path's dispatch contract
+    # (the bench-smoke CI job asserts this stays exactly 1.0)
+    calls = many["train_dispatch_count"] + many["decode_dispatch_count"]
+    row["dispatch_per_step"] = (
+        round(calls / many["dispatches"], 3) if many["dispatches"] else None
+    )
+    row["value"] = round(many["img_s"] / max(one["img_s"], 1e-9), 3)
+    return row
+
+
 def measure_rl_hz(seconds: float = 3.0) -> dict:
     """Full REQ/REP rendezvous stepping rate, rendering off (the
     reference's '2000 Hz are easily achieved' row, ``Readme.md:95``;
@@ -976,7 +1105,18 @@ def run_gated_row(fn, probe, *, headline_fit, degraded,
     immediately (polling again would just burn watchdog budget — and
     in outage mode each probe costs multiple multi-second RTTs, so
     probes are skipped wholesale). The returned row carries its own
-    pre+post probes + fit verdict."""
+    pre+post probes + fit verdict.
+
+    A failed post probe after a fit pre gets ONE immediate re-probe
+    before the verdict: the 8 MB bandwidth sample shares the host with
+    producer teardown, and a single jittered sample was enough to
+    invalidate an otherwise-held window (BENCH_r05: ``step_alone``'s
+    post read 21.6 MB/s between two fit samples and poisoned
+    ``utilization`` with ``invalid: "weather"``). A real collapse stays
+    collapsed across back-to-back probes; a host-jitter blip recovers
+    instantly — the re-probe interleaves a second sample with the
+    measured window's edge so one blip can't decide the comparison.
+    The discarded sample is preserved as ``post.jitter_discarded``."""
     if degraded:
         row = fn()
         row["weather"] = {"pre": _SKIPPED_PROBE, "post": _SKIPPED_PROBE}
@@ -994,6 +1134,11 @@ def run_gated_row(fn, probe, *, headline_fit, degraded,
             pre = probe()
         row = fn()
         post = probe()
+        if pre.get("fit") and not post.get("fit"):
+            retry = probe()
+            if retry.get("fit"):
+                retry["jitter_discarded"] = post.get("h2d_MB_s")
+                post = retry
         row["weather"] = {"pre": pre, "post": post}
         row["fit_window"] = bool(pre.get("fit") and post.get("fit"))
         if row["fit_window"] or not headline_fit or clock() - t0 > budget:
@@ -1175,6 +1320,20 @@ def _build_record(progress: dict) -> dict:
             detail["raw_row"] = raw
         except Exception as e:  # pragma: no cover - device flake path
             detail["raw_row"] = {"error": repr(e)[:200]}
+    if ENCODING == "tile" and LIVE_OVERLAP and not degraded:
+        # Async-overlap A/B (same weather regime as the headline): the
+        # fused one-dispatch-per-step path at driver inflight=1 vs N.
+        # The row is the live evidence for the dispatch contract (no
+        # standalone decode.dispatch calls; dispatch_per_step == 1) and
+        # for whether keeping dispatches in flight raises end-to-end
+        # img/s on this link.
+        try:
+            detail["live_overlap"] = gated_row(
+                lambda: measure_live_overlap(primary["chunk"]),
+                budget=150.0, attempts=1,
+            )
+        except Exception as e:  # pragma: no cover - device flake path
+            detail["live_overlap"] = {"error": repr(e)[:200]}
     if ENCODING == "tile" and INGEST_AB and not degraded:
         # Sharded-ingest A/B (same weather regime as the headline): does
         # a second recv/decode worker raise end-to-end img/s on THIS

@@ -4,6 +4,7 @@ from __future__ import annotations
 
 from blendjax.analysis.rules import (  # noqa: F401  (registration side effects)
     deserialization,
+    driver_sync,
     hotpath,
     purity,
     resource_leak,

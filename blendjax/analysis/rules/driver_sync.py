@@ -1,0 +1,153 @@
+"""BJX106 sync-on-inflight-step: same-iteration host sync on a step
+output inside a driver hot path.
+
+The async overlap driver (``blendjax/train/driver.py``) exists to keep
+``inflight`` donated step dispatches outstanding; one
+``block_until_ready()``, ``.item()``, ``float()``/``np.asarray()``
+fetch of a value dispatched IN THE SAME loop iteration collapses the
+pipeline back to dispatch-wait-dispatch (the BENCH_r05 live loop:
+mfu_live 55x below mfu_step_alone). The sanctioned pattern is
+completion tracking: retire finished entries with non-blocking
+``is_ready`` polls, block only on the entry dispatched ``inflight``
+iterations back, and fetch losses at ``sync_every`` boundaries — all
+of which sync values produced in EARLIER iterations (helper methods /
+ring pops / a sync placed textually BEFORE the dispatch, which reads
+the previous iteration's value), none of which this rule flags: a
+finding requires the sync to sit at or after the name's assignment
+within the same loop body.
+
+Modules opt in with a ``bjx: driver-hot-path`` marker comment (the same
+comment-marker mechanism as BJX102's ``bjx: hot-path``); any module
+named ``driver.py`` is always checked.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Iterator
+
+from blendjax.analysis.core import (
+    Finding,
+    ModuleContext,
+    Rule,
+    register,
+)
+
+DRIVER_BASENAMES = {"driver.py"}
+# Comment lines only, like BJX102: the marker quoted in a docstring
+# (this module's own, say) must not opt a module in.
+DRIVER_MARKER_RE = re.compile(r"^\s*#.*bjx: driver-hot-path", re.MULTILINE)
+
+HOST_CASTS = {"float", "int"}
+HOST_ARRAY_CASTS = {"numpy.asarray", "numpy.array", "numpy.ascontiguousarray"}
+
+LoopNode = ast.For | ast.AsyncFor | ast.While
+
+
+def _is_driver_hot(module: ModuleContext) -> bool:
+    if os.path.basename(module.relpath) in DRIVER_BASENAMES:
+        return True
+    return DRIVER_MARKER_RE.search(module.source[:4096]) is not None
+
+
+def _walk_loop(loop: LoopNode) -> Iterator[ast.AST]:
+    """Walk a loop's body without descending into nested function/class
+    definitions (their bodies run in a different iteration context)."""
+    stack: list[ast.AST] = list(loop.body) + list(loop.orelse)
+    while stack:
+        node = stack.pop()
+        yield node
+        if not isinstance(
+            node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+        ):
+            stack.extend(ast.iter_child_nodes(node))
+
+
+def _names(node: ast.AST) -> set[str]:
+    return {n.id for n in ast.walk(node) if isinstance(n, ast.Name)}
+
+
+@register
+class InflightSyncRule(Rule):
+    id = "BJX106"
+    name = "sync-on-inflight-step"
+    description = (
+        "host sync (block_until_ready/.item()/np.asarray/float) on a "
+        "value dispatched in the same loop iteration inside a driver "
+        "hot path"
+    )
+
+    def check(self, module: ModuleContext) -> Iterator[Finding]:
+        if not _is_driver_hot(module):
+            return
+        for qual, fn, _cls in module.iter_functions():
+            seen: set[tuple[int, int]] = set()
+            for node in ast.walk(fn):
+                if isinstance(node, (ast.For, ast.AsyncFor, ast.While)):
+                    for f in self._scan_loop(module, node, qual):
+                        key = (f.line, f.col)
+                        if key not in seen:  # nested loops scan twice
+                            seen.add(key)
+                            yield f
+
+    def _scan_loop(
+        self, module: ModuleContext, loop: LoopNode, qual: str
+    ) -> Iterator[Finding]:
+        nodes = list(_walk_loop(loop))
+        # Names bound from ANY call result in this iteration — the step
+        # dispatch (`state, m = step(state, b)`) and anything derived
+        # from it — keyed by their FIRST assignment line: a sync that
+        # textually precedes the assignment consumes the PREVIOUS
+        # iteration's value (the sanctioned sync-one-behind shape) and
+        # must not be flagged.
+        dispatched: dict[str, int] = {}
+        for node in nodes:
+            if isinstance(node, ast.Assign) and isinstance(
+                node.value, ast.Call
+            ):
+                for target in node.targets:
+                    for name in _names(target):
+                        line = getattr(node, "lineno", 0)
+                        if name not in dispatched or line < dispatched[name]:
+                            dispatched[name] = line
+        if not dispatched:
+            return
+        for node in nodes:
+            if not isinstance(node, ast.Call):
+                continue
+            func = node.func
+            synced: set[str] = set()
+            form: str | None = None
+            if isinstance(func, ast.Attribute) and func.attr in (
+                "block_until_ready", "item"
+            ):
+                form = f"{func.attr}()"
+                synced = (
+                    _names(node.args[0]) if node.args
+                    else _names(func.value)
+                )
+            else:
+                resolved = module.resolve(func) or ""
+                if (
+                    resolved in HOST_ARRAY_CASTS
+                    or resolved in HOST_CASTS
+                    or resolved.endswith(".block_until_ready")
+                ) and node.args:
+                    form = f"{resolved}()"
+                    synced = _names(node.args[0])
+            hit = sorted(
+                name for name in synced
+                if name in dispatched
+                and getattr(node, "lineno", 0) >= dispatched[name]
+            )
+            if form and hit:
+                yield self.finding(
+                    module,
+                    node,
+                    f"{form} on in-flight step output '{hit[0]}' in "
+                    f"driver hot path '{qual}' collapses the dispatch "
+                    "pipeline to one step deep — track completion per "
+                    "entry and sync only at sync_every boundaries",
+                )

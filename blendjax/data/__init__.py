@@ -25,7 +25,12 @@ from blendjax.data.replay import (
 )
 from blendjax.data.schema import StreamSchema
 from blendjax.data.stream import RemoteStream, partition_addresses
-from blendjax.data.batcher import BatchAssembler, HostIngest
+from blendjax.data.batcher import (
+    BatchAssembler,
+    HostIngest,
+    bucket_sizes,
+    pad_to_bucket,
+)
 from blendjax.data.shard_ingest import (
     ParallelBatchAssembler,
     ShardedHostIngest,
@@ -42,6 +47,8 @@ __all__ = [
     "partition_addresses",
     "BatchAssembler",
     "HostIngest",
+    "bucket_sizes",
+    "pad_to_bucket",
     "ParallelBatchAssembler",
     "ShardedHostIngest",
     "DeviceFeeder",

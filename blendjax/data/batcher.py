@@ -72,6 +72,87 @@ def passthrough_batch(item: dict, schema: StreamSchema, batch_size: int):
     return batch
 
 
+def bucket_sizes(batch_size: int) -> tuple:
+    """Power-of-two bucket ladder up to (and including) ``batch_size``:
+    the small fixed set of padded leading dims that keeps a jitted step's
+    compile cache bounded no matter what tail sizes a finite stream
+    produces (``8 -> (1, 2, 4, 8)``)."""
+    batch_size = max(1, int(batch_size))
+    sizes = []
+    b = 1
+    while b < batch_size:
+        sizes.append(b)
+        b <<= 1
+    sizes.append(batch_size)
+    return tuple(sizes)
+
+
+def pad_to_bucket(batch: dict, batch_size: int | None = None,
+                  buckets=None) -> dict:
+    """Pad a partial batch's leading dim up to a bucket shape and attach
+    a ``_mask`` validity vector.
+
+    Every array field whose leading dim equals the batch's true item
+    count is zero-padded to the smallest bucket that fits (buckets
+    default to :func:`bucket_sizes` of ``batch_size``); ``_mask`` is a
+    float32 ``(bucket,)`` vector with 1 for real rows and 0 for padding
+    — the mask-aware losses in :mod:`blendjax.train.steps` weight rows
+    by it and divide by its sum, so a padded batch scores (and
+    backpropagates) identically to its exact-shape form. The
+    ``_partial`` marker is dropped (the shape is regular now); consumers
+    recover the true count as ``int(mask.sum())``. Fields of other
+    leading dims (shared palettes, sidecars) and ``_meta`` pass through
+    untouched. Works on host numpy batches (free) and on device arrays
+    (one pad dispatch per field — still tail-only, vs a multi-second
+    recompile)."""
+    meta = batch.get("_meta")
+    if isinstance(meta, list) and meta:
+        # assembler-flushed partials: _meta's length IS the item count
+        lead = len(meta)
+    else:
+        # most common leading dim wins (sidecar arrays — palettes,
+        # shared refs — carry unrelated leads; ties go to the larger)
+        counts: dict = {}
+        for v in batch.values():
+            if hasattr(v, "ndim") and getattr(v, "ndim", 0) >= 1:
+                counts[v.shape[0]] = counts.get(v.shape[0], 0) + 1
+        lead = max(counts, key=lambda s: (counts[s], s), default=0)
+    if not lead:
+        return batch
+    if buckets is None:
+        # Without a batch_size there is no ladder to anchor: pad to the
+        # next power of two (the driver's defensive path).
+        buckets = bucket_sizes(batch_size) if batch_size else ()
+    target = min((b for b in buckets if b >= lead), default=None)
+    if target is None:
+        # lead exceeds every bucket (e.g. a prebatched tail larger than
+        # the pipeline batch_size): pad to the next power of two so the
+        # compile set stays bounded anyway.
+        target = 1
+        while target < lead:
+            target <<= 1
+    out = {}
+    for k, v in batch.items():
+        if k == "_partial":
+            continue
+        if (
+            hasattr(v, "ndim") and getattr(v, "ndim", 0) >= 1
+            and v.shape[0] == lead and target > lead
+        ):
+            widths = [(0, target - lead)] + [(0, 0)] * (v.ndim - 1)
+            if isinstance(v, np.ndarray):
+                v = np.pad(v, widths)
+            else:
+                import jax.numpy as jnp
+
+                v = jnp.pad(v, widths)
+        out[k] = v
+    mask = np.zeros(target, np.float32)
+    mask[:lead] = 1.0
+    out["_mask"] = mask
+    return out
+
+
 def prebatched_lead(item: dict) -> int | None:
     """Leading dim of an opaque producer-assembled (``_prebatched``)
     message: a ``*__tileidx`` field's is authoritative for tile messages
@@ -206,7 +287,14 @@ class HostIngest:
         return passthrough_batch(item, self.schema, self.batch_size)
 
     def _emit(self, batch) -> None:
-        metrics.gauge("ingest.queue_depth", self._queue.qsize())
+        # Occupancy gauge pair: the instantaneous depth plus its
+        # high-water mark, so bench output can tell backpressure (queue
+        # pinned at `prefetch`, producers outrunning the consumer) from
+        # overlap stalls (depth near zero while queue_full_waits climbs
+        # elsewhere) — the counter alone can't distinguish the two.
+        depth = self._queue.qsize()
+        metrics.gauge("ingest.queue_depth", depth)
+        metrics.gauge_max("ingest.queue_depth_hwm", depth)
         while not self._stop.is_set():
             try:
                 self._queue.put(batch, timeout=0.25)

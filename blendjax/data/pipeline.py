@@ -42,17 +42,21 @@ class DeviceFeeder:
 
     ``_meta`` (per-item provenance like ``btid``) stays on host.
 
-    ``throttle`` bounds how many transfers may be outstanding: before a
-    new batch is placed, the feeder blocks (one bounded RPC round trip)
-    on one representative array of the batch placed ``throttle`` places
-    back — usually long done, so the wait is trivial. Batches are yielded
-    without waiting, so device-side data dependencies order the work; the
-    window only stops the transfer queue from growing without bound,
-    which on tunneled/remote device hosts degrades per-transfer latency
-    5-10x (measured on a TPU-over-network host). A deep window (default
-    8) rides out such a link's per-op turnaround (~100ms) that a
-    wait-each-batch regime pays in full. ``throttle=0``/None disables
-    the bound.
+    ``throttle`` bounds how many transfers may be outstanding: each
+    window entry is one representative array of a placed batch, and
+    completed transfers are retired by a non-blocking per-entry
+    readiness poll — the feeder blocks (one bounded RPC round trip, on
+    the oldest entry) only when the window is GENUINELY full of
+    unfinished transfers. A consumer running ahead of the feeder
+    therefore never costs a block (the old regime blocked on the oldest
+    entry whenever the window filled, even with every transfer long
+    done). Batches are yielded without waiting, so device-side data
+    dependencies order the work; the window only stops the transfer
+    queue from growing without bound, which on tunneled/remote device
+    hosts degrades per-transfer latency 5-10x (measured on a
+    TPU-over-network host). A deep window (default 8) rides out such a
+    link's per-op turnaround (~100ms) that a wait-each-batch regime
+    pays in full. ``throttle=0``/None disables the bound.
     """
 
     def __init__(self, sharding=None, prefetch: int = 2, multihost: bool = False,
@@ -151,26 +155,44 @@ class DeviceFeeder:
         ]
         return max(arrays, key=lambda v: v.size, default=None)
 
+    @staticmethod
+    def _is_done(arr) -> bool:
+        """Non-blocking readiness poll for one window entry (shared
+        definition: :func:`blendjax.utils.device.transfer_done`)."""
+        from blendjax.utils.device import transfer_done
+
+        return transfer_done(arr)
+
     def __call__(self, host_batches):
         """Iterate device batches, keeping ``prefetch`` transfers in flight
         ahead of the consumer (flax-style prefetch ring) and at most
         ``throttle`` transfers outstanding on the device.
 
-        The window wait blocks (one RPC) on a single representative array
-        — the batch's largest — rather than locally polling ``is_ready``:
-        on lazy-flushing remote backends a local poll never forces the
-        queue to drain, while one bounded ~ms round trip per batch does,
-        and the array it waits on was placed ``throttle`` batches ago so
-        the wait is usually trivial."""
+        Completion is tracked per entry: a cheap ``is_ready`` poll
+        retires finished transfers from anywhere in the window, so the
+        feeder only pays a blocking wait (one RPC, on the oldest entry's
+        representative array — the batch's largest) when the ring is
+        genuinely full of unfinished work. On lazy-flushing remote
+        backends the poll may never turn true without a sync — the
+        blocking wait remains the honest bound there, and the array it
+        waits on was placed ``throttle`` batches ago so the wait is
+        usually trivial."""
         jax = _require_jax()
         ring = collections.deque()
         window: collections.deque = collections.deque()
         it = iter(host_batches)
 
         def place(hb):
-            while self.throttle and len(window) >= self.throttle:
-                oldest = window.popleft()
-                if oldest is not None:
+            if self.throttle:
+                still = [
+                    w for w in window
+                    if w is not None and not self._is_done(w)
+                ]
+                window.clear()
+                window.extend(still)
+                while len(window) >= self.throttle:
+                    oldest = window.popleft()
+                    metrics.count("feed.throttle_blocks")
                     with metrics.span("feed.throttle_wait"):
                         jax.block_until_ready(oldest)
             with metrics.span("feed.place"):
@@ -231,10 +253,13 @@ class TileStreamDecoder:
         self.chunk = max(1, int(chunk))
         # emit_packed=True skips the decode jit: device_stage yields
         # ``{"_packed", "_refs", "_spec", "_names", "_geoms", ...}`` for
+        # tile groups and ``{"_packed", "_spec", "_pal", ...}`` for
+        # full-frame palette groups, consumed by
         # :func:`blendjax.train.make_fused_tile_step`, which fuses the
         # decode into the train jit — one device call per chunk group
-        # instead of two. Tile groups always route through the chunk
-        # path (K'=1 groups when chunk==1).
+        # instead of two, and zero standalone decode.dispatch spans.
+        # Both group kinds always route through the chunk path (K'=1
+        # groups when chunk==1).
         self.emit_packed = bool(emit_packed)
         # strict=True restores the fail-fast contract: any non-tile
         # message in a chunk>1 stream raises instead of degrading to a
@@ -367,7 +392,7 @@ class TileStreamDecoder:
                 self._refs[key] = ref_tiles
             pal_groups = T.pop_frame_palette_batches(hb)
             if pal_groups:
-                if self.multihost or self.emit_packed:
+                if self.multihost:
                     # Correctness-first fallback: expand on host and let
                     # the batch ride the existing raw paths (multihost
                     # global assembly). The device-gather paths below
@@ -397,7 +422,7 @@ class TileStreamDecoder:
                         metrics.count(
                             "pal.decoded_bytes", int(h_ * w_ * c_) * lead
                         )
-                    if self.chunk == 1:
+                    if self.chunk == 1 and not self.emit_packed:
                         self._plans.append(
                             ("pal", spec, rest, tuple(pal_groups))
                         )
@@ -407,7 +432,10 @@ class TileStreamDecoder:
                     # stacked transfer + one scanned step, exactly like
                     # the tile chunk path (the non-sparse row is
                     # op-latency bound on tunneled links: K transfers +
-                    # K step dispatches collapse K-fold).
+                    # K step dispatches collapse K-fold). emit_packed
+                    # routes through this grouped form too (K'=1 groups
+                    # when chunk==1): the fused step consumes the
+                    # stacked (K', total) layout.
                     gkey = (spec, tuple(pal_groups))
                     if pal_group and pal_group["key"] != gkey:
                         yield from self._flush_pal_group(pal_group)
@@ -813,30 +841,17 @@ class TileStreamDecoder:
                 _decode_fields, static_argnames=("names", "geoms")
             )
         if self._decode_pal is None:
-
-            def _decode_pal(packed, spec, pal_groups):
-                fields = T.unpack_fields(packed, spec)
-                for name, (h_, w_, c_, bits) in pal_groups:
-                    fields[name] = T.pop_frame_palette_payload(
-                        fields, name, bits, h_, w_, c_,
-                        T.expand_palette_frames,
-                    )
-                return fields
-
+            # Shared fusable entry points (blendjax.ops.tiles): the SAME
+            # decode program make_fused_tile_step traces into the train
+            # jit, wrapped standalone here for decode-then-step
+            # consumers — the two paths cannot drift.
             self._decode_pal = jax.jit(
-                _decode_pal, static_argnames=("spec", "pal_groups")
+                T.decode_packed_pal_batch,
+                static_argnames=("spec", "pal_groups"),
             )
-
-            def _decode_pal_chunk(stacked, spec, pal_groups):
-                # (K', total) stacked packed buffers -> (K', B, ...)
-                # superbatch fields; each group member gathers through
-                # its OWN palette (vmap over the chunk axis).
-                return jax.vmap(
-                    lambda p: _decode_pal(p, spec, pal_groups)
-                )(stacked)
-
             self._decode_pal_chunk = jax.jit(
-                _decode_pal_chunk, static_argnames=("spec", "pal_groups")
+                T.decode_packed_pal_superbatch,
+                static_argnames=("spec", "pal_groups"),
             )
         if self._decode_mh_chunk is None:
             mesh, axis = self._decode_mesh()
@@ -917,6 +932,19 @@ class TileStreamDecoder:
                 continue
             if plan is not None and plan[0] == "palchunk":
                 _, spec, rests, pal_groups = plan
+                if self.emit_packed:
+                    # Fused-step form: the still-encoded stacked buffer
+                    # plus its decode plan — the palette expand happens
+                    # INSIDE the train jit (make_fused_tile_step), so no
+                    # standalone decode.dispatch call exists on this
+                    # path and decoded frames never round-trip as
+                    # standalone jax.Arrays.
+                    db["_packed"] = db.pop("__packed__")
+                    db["_spec"] = spec
+                    db["_pal"] = pal_groups
+                    db["_meta"] = rests
+                    yield db
+                    continue
                 with metrics.span("decode.dispatch"):
                     fields = self._decode_pal_chunk(
                         db.pop("__packed__"), spec=spec,
@@ -1008,6 +1036,7 @@ class StreamDataPipeline:
         emit_packed: bool = False,
         ingest_workers: int = 1,
         emit_partial_final: bool = False,
+        pad_partial: bool = True,
         **stream_kwargs,
     ):
         from blendjax.data.stream import RemoteStream
@@ -1029,6 +1058,15 @@ class StreamDataPipeline:
         # and recording-tee semantics unchanged.
         self.ingest_workers = max(1, int(ingest_workers))
         self.emit_partial_final = bool(emit_partial_final)
+        # Shape-bucketed partials (on by default): a `_partial=True`
+        # tail batch is zero-padded on the HOST up to a power-of-two
+        # bucket with a `_mask` validity vector (pad_to_bucket), so a
+        # finite stream's ragged tail hits a small fixed compile set
+        # instead of recompiling the jitted step mid-run. The train-
+        # layer losses are mask-aware (rows weighted by _mask, mean
+        # divided by its sum), so the padded batch trains identically.
+        # pad_partial=False restores the exact-shape tail.
+        self.pad_partial = bool(pad_partial)
         self._addresses = None
         self._stream_kwargs = dict(stream_kwargs)
         if hasattr(addresses, "__iter__") and not isinstance(
@@ -1196,8 +1234,24 @@ class StreamDataPipeline:
             )
         self.ingest.start()
         self.tiles.reset()
-        host = self.tiles.host_stage(self.ingest)
+        source = (
+            self._pad_partial_stage(self.ingest)
+            if self.pad_partial else self.ingest
+        )
+        host = self.tiles.host_stage(source)
         return iter(self.tiles.device_stage(self.feeder(host)))
+
+    def _pad_partial_stage(self, batches):
+        """Bucket-pad `_partial` tail batches on the host (numpy, free)
+        before tile handling and device placement, so every downstream
+        stage — packing, feeder sharding, the jitted step — sees a
+        regular bucket shape plus a `_mask` validity vector."""
+        from blendjax.data.batcher import pad_to_bucket
+
+        for hb in batches:
+            if hb.get("_partial"):
+                hb = pad_to_bucket(hb, batch_size=self.batch_size)
+            yield hb
 
     def queue_depth(self) -> int:
         return 0 if self.ingest is None else self.ingest.queue_depth()

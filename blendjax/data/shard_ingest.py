@@ -230,7 +230,13 @@ class ShardedHostIngest:
     # -- worker side ---------------------------------------------------------
 
     def _emit(self, idx: int, batch) -> None:
-        metrics.gauge("ingest.queue_depth", self._queue.qsize())
+        # Same occupancy gauge pair as HostIngest._emit: queue_full_waits
+        # alone can't separate backpressure (depth pinned at `prefetch`)
+        # from overlap stalls (depth ~0) in bench output; gauge_max is
+        # lock-exact across the worker pool.
+        depth = self._queue.qsize()
+        metrics.gauge("ingest.queue_depth", depth)
+        metrics.gauge_max("ingest.queue_depth_hwm", depth)
         # Bail only when the CONSUMER is gone (stop()). _stop alone is
         # not enough: the budget-drain and error paths set it while the
         # consumer is still draining — gating on it dropped the final

@@ -830,6 +830,37 @@ def decode_packed_superbatch(packed, refs, spec, names, geoms,
     return fields
 
 
+def decode_packed_pal_batch(packed, spec, pal_groups):
+    """Decode ONE packed full-frame-palette batch to full fields —
+    jit-safe (slice/bitcast unpack + the byte-LUT palette gather).
+
+    ``packed``: (total,) uint8 buffer of :func:`pack_fields` layout
+    ``spec``; ``pal_groups``: ``((name, (h, w, c, bits)), ...)`` as
+    produced by :func:`pop_frame_palette_batches`. Shared by
+    :class:`blendjax.data.TileStreamDecoder` (decode-then-step) and
+    :func:`blendjax.train.make_fused_tile_step` (decode fused into the
+    train jit), so the two paths cannot drift."""
+    fields = unpack_fields(packed, spec)
+    for name, (h, w, c, bits) in pal_groups:
+        fields[name] = pop_frame_palette_payload(
+            fields, name, bits, h, w, c, expand_palette_frames
+        )
+    return fields
+
+
+def decode_packed_pal_superbatch(packed, spec, pal_groups):
+    """(K', total) stacked packed pal buffers -> (K', B, ...) superbatch
+    fields — each group member gathers through its OWN palette (vmap
+    over the chunk axis). The full-frame-palette twin of
+    :func:`decode_packed_superbatch`, consumed by the same two callers.
+    """
+    import jax
+
+    return jax.vmap(
+        lambda p: decode_packed_pal_batch(p, spec, pal_groups)
+    )(packed)
+
+
 # -- device side ------------------------------------------------------------
 
 

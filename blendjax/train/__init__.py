@@ -15,6 +15,7 @@ from blendjax.train.steps import (
     make_supervised_step,
 )
 from blendjax.train.checkpoint import CheckpointManager
+from blendjax.train.driver import TrainDriver
 
 __all__ = [
     "make_train_state",
@@ -24,4 +25,5 @@ __all__ = [
     "make_fused_tile_step",
     "corner_loss",
     "CheckpointManager",
+    "TrainDriver",
 ]

@@ -1,0 +1,175 @@
+"""Async overlap driver: keep donated train-step dispatches in flight.
+
+The live-loop gap this closes (BENCH_r05): ``mfu_step_alone`` 0.4724 vs
+``mfu_live`` 0.0085 — a ~55x gap — because the consumer loop ran
+dispatch-SYNC-dispatch: every step's loss was fetched (or its buffers
+blocked on) before the next batch was even requested, and the on-device
+decode dispatched as a separate jit call that serialized with the step.
+With the decode fused into the step (``make_fused_tile_step``: exactly
+one device dispatch per step) and this driver keeping up to ``inflight``
+of those dispatches outstanding, H2D transfer, fused decode+step
+compute, and host ingest all overlap; the host touches device results
+only every ``sync_every`` steps and when the ring is genuinely full.
+
+Rules of the hot loop (enforced by bjx-lint BJX106 on this module):
+never host-sync a value dispatched in the same loop iteration —
+completion is tracked per in-flight entry (non-blocking ``is_ready``
+polls retire finished work), and blocking waits target the OLDEST
+entry only, which was dispatched ``inflight`` steps ago and is usually
+long done.
+"""
+
+from __future__ import annotations
+
+# bjx: driver-hot-path (BJX106 flags same-iteration host syncs on step
+# outputs inside this module's dispatch loops)
+
+import collections
+
+import numpy as np
+
+from blendjax.utils.metrics import metrics
+
+
+class TrainDriver:
+    """Dispatch-ahead wrapper around a ``step(state, batch) ->
+    (state, metrics)`` callable (any :mod:`blendjax.train.steps`
+    builder; pair with :func:`make_fused_tile_step` +
+    ``StreamDataPipeline(emit_packed=True)`` for the one-dispatch-per-
+    step fused path).
+
+    - ``inflight``: how many step dispatches may be outstanding. The
+      ring is bounded by completion tracking, not serialization:
+      finished entries retire via a non-blocking readiness poll, and the
+      driver blocks (once, on the oldest entry) only when the ring is
+      genuinely full of unfinished work. ``inflight=1`` reproduces the
+      old dispatch-wait-dispatch loop for A/B comparison.
+    - ``sync_every``: fetch one loss value to host every N steps (the
+      oldest in flight — the least-blocking real number). 0 disables
+      periodic syncs; :meth:`finish`/:meth:`drain` still fetch the final
+      loss, which transitively syncs the whole donated-state chain.
+    - ``pad_partial``: bucket-pad `_partial` tail batches that reach the
+      driver unmasked (``blendjax.data.batcher.pad_to_bucket``), so a
+      finite stream's ragged tail cannot recompile the step mid-run.
+      Pipelines constructed with ``pad_partial=True`` (the default)
+      already deliver masked bucket shapes and skip this path.
+
+    Stats (:attr:`stats`): ``steps``/``dispatches`` (one device call per
+    step on the fused path), ``inflight_hwm`` (steps-in-flight
+    high-water mark), ``host_blocks`` (genuine ring-full waits — near
+    zero when the device keeps up), ``syncs`` (periodic loss fetches).
+    """
+
+    def __init__(self, step, state, inflight: int = 4,
+                 sync_every: int = 32, pad_partial: bool = True,
+                 buckets=None):
+        self.step = step
+        self.state = state
+        self.inflight = max(1, int(inflight))
+        self.sync_every = max(0, int(sync_every or 0))
+        self.pad_partial = bool(pad_partial)
+        self.buckets = buckets
+        self._pending: collections.deque = collections.deque()
+        self.losses: list = []
+        self.steps = 0
+        self.dispatches = 0
+        self.inflight_hwm = 0
+        self.host_blocks = 0
+
+    # -- ring ----------------------------------------------------------------
+
+    @staticmethod
+    def _is_done(arr) -> bool:
+        """Non-blocking readiness poll (shared definition:
+        :func:`blendjax.utils.device.transfer_done`)."""
+        from blendjax.utils.device import transfer_done
+
+        return transfer_done(arr)
+
+    def _block_oldest(self) -> None:
+        """Retire the oldest in-flight entry, blocking if needed. A
+        block is counted only when genuine (the entry wasn't already
+        done): with overlap working, the entry ``inflight`` steps back
+        has finished and this is a free pop."""
+        import jax
+
+        loss = self._pending.popleft()
+        if self._is_done(loss):
+            return
+        self.host_blocks += 1
+        with metrics.span("driver.ring_wait"):
+            jax.block_until_ready(loss)
+
+    def _sync_oldest(self) -> None:
+        """Periodic loss fetch (the designed host-sync point): the
+        OLDEST in-flight loss — a real training signal that blocks the
+        least, because everything newer stays dispatched."""
+        if not self._pending:
+            return
+        loss = self._pending.popleft()
+        with metrics.span("driver.loss_sync"):
+            self.losses.append(float(np.asarray(loss).reshape(-1)[-1]))
+
+    # -- dispatch ------------------------------------------------------------
+
+    def submit(self, batch) -> None:
+        """Dispatch one step without waiting on its result."""
+        if (
+            self.pad_partial and batch.get("_partial")
+            and "_mask" not in batch
+        ):
+            from blendjax.data.batcher import pad_to_bucket
+
+            batch = pad_to_bucket(batch, buckets=self.buckets)
+        pending = self._pending
+        while pending and self._is_done(pending[0]):
+            pending.popleft()  # completion tracking: free retires
+        while len(pending) >= self.inflight:
+            self._block_oldest()
+        with metrics.span("train.dispatch"):
+            self.state, m = self.step(self.state, batch)
+        metrics.count("train.dispatches")
+        self.dispatches += 1
+        self.steps += 1
+        pending.append(m["loss"])
+        if len(pending) > self.inflight_hwm:
+            self.inflight_hwm = len(pending)
+        if self.sync_every and self.steps % self.sync_every == 0:
+            self._sync_oldest()
+
+    def drain(self):
+        """Block until every dispatched step completed and return the
+        newest loss value (the d2h fetch transitively syncs the whole
+        donated-state chain — the one sync honest on every backend;
+        see docs/performance.md measurement hygiene)."""
+        if not self._pending:
+            return self.losses[-1] if self.losses else None
+        newest = self._pending.pop()
+        self._pending.clear()
+        val = float(np.asarray(newest).reshape(-1)[-1])
+        self.losses.append(val)
+        return val
+
+    def finish(self):
+        """Drain and return ``(state, final_loss)``."""
+        return self.state, self.drain()
+
+    def run(self, batches, max_steps: int | None = None):
+        """Drive a batch iterable end to end; returns
+        ``(state, final_loss)``."""
+        for batch in batches:
+            self.submit(batch)
+            if max_steps is not None and self.steps >= max_steps:
+                break
+        return self.finish()
+
+    @property
+    def stats(self) -> dict:
+        return {
+            "steps": self.steps,
+            "dispatches": self.dispatches,
+            "inflight": self.inflight,
+            "inflight_hwm": self.inflight_hwm,
+            "host_blocks": self.host_blocks,
+            "syncs": len(self.losses),
+        }

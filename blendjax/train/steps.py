@@ -33,15 +33,40 @@ def make_train_state(
     return TrainState.create(apply_fn=model.apply, params=params, tx=optimizer)
 
 
-def corner_loss(pred, xy, image_shape=None):
+def corner_loss(pred, xy, image_shape=None, mask=None):
     """MSE over predicted corner pixels, normalized to [0,1] image coords
-    so the loss is resolution-independent."""
+    so the loss is resolution-independent.
+
+    ``mask`` (lead,) marks valid rows of a bucket-padded partial batch
+    (``blendjax.data.batcher.pad_to_bucket``): padded rows contribute
+    nothing and the mean divides by the true row count, so a padded
+    batch scores — and backpropagates — identically to its exact-shape
+    form (up to float associativity). ``mask=None`` is bit-for-bit the
+    old unmasked loss."""
     if image_shape is not None:
         h, w = image_shape
         scale = jnp.asarray([w, h], jnp.float32)
         pred = pred / scale
         xy = xy / scale
-    return jnp.mean((pred - xy.astype(jnp.float32)) ** 2)
+    err = (pred - xy.astype(jnp.float32)) ** 2
+    if mask is None:
+        return jnp.mean(err)
+    per = err.reshape(err.shape[0], -1).mean(axis=1)
+    m = mask.astype(jnp.float32)
+    return (per * m).sum() / jnp.maximum(m.sum(), 1.0)
+
+
+def _default_loss(state, params, batch):
+    """ONE default loss for all step builders (per-batch, chunked, and
+    fused runs must score identically): corner regression with the
+    bucket-padding ``_mask`` honored when present, so mask-padded tail
+    batches train without recompiles or loss skew."""
+    return corner_loss(
+        state.apply_fn({"params": params}, batch["image"]),
+        batch["xy"],
+        image_shape=batch["image"].shape[1:3],
+        mask=batch.get("_mask"),
+    )
 
 
 def make_supervised_step(
@@ -79,13 +104,7 @@ def make_supervised_step(
     """
     del mesh, batch_sharding  # layouts ride on the arrays (see above)
     base_rng = _resolve_augment_rng(augment, augment_rng)
-    loss_fn = loss_fn or (
-        lambda state, params, batch: corner_loss(
-            state.apply_fn({"params": params}, batch["image"]),
-            batch["xy"],
-            image_shape=batch["image"].shape[1:3],
-        )
-    )
+    loss_fn = loss_fn or _default_loss
     accum_steps = max(1, int(accum_steps))
 
     def step(state, batch):
@@ -208,13 +227,7 @@ def make_chunked_supervised_step(
     the same stream trained one batch at a time (and to a
     checkpoint-resumed run).
     """
-    loss_fn = loss_fn or (
-        lambda state, params, batch: corner_loss(
-            state.apply_fn({"params": params}, batch["image"]),
-            batch["xy"],
-            image_shape=batch["image"].shape[1:3],
-        )
-    )
+    loss_fn = loss_fn or _default_loss
     base_rng = _resolve_augment_rng(augment, augment_rng)
 
     def step(state, superbatch):
@@ -234,27 +247,27 @@ def make_fused_tile_step(
 ):
     """Build ``step(state, packed_batch) -> (state, metrics)`` where
     ``packed_batch`` is what ``StreamDataPipeline(emit_packed=True)``
-    yields: the still-encoded tile chunk group plus its decode plan.
+    yields: the still-encoded chunk group plus its decode plan — a tile
+    group (``_refs``/``_names``/``_geoms``) or a full-frame palette
+    group (``_pal``).
 
-    Fuses the on-device tile reconstruction INTO the train jit: one
-    device call per K batches where the decode-then-step pipeline costs
-    two. On serialized tunnel/remote runtimes every dispatched call pays
-    a queue turnaround (measured ~40ms on an axon link), so halving the
-    call count is worth more than any kernel-level win. Training
-    semantics are bit-identical to ``make_chunked_supervised_step`` over
-    the decoded fields.
+    Fuses the on-device reconstruction INTO the train jit: one device
+    call per K batches where the decode-then-step pipeline costs two,
+    and ZERO standalone ``decode.dispatch`` calls — decoded frames live
+    only as fused-step intermediates, never round-tripping as
+    standalone ``jax.Array``s. On serialized tunnel/remote runtimes
+    every dispatched call pays a queue turnaround (measured ~40ms on an
+    axon link), so halving the call count is worth more than any
+    kernel-level win. Training semantics are bit-identical to
+    ``make_chunked_supervised_step`` over the decoded fields.
 
     A batch without ``"_packed"`` (the mixed-stream K'=1 degradation
-    path) falls back to the scan-only chunked step on its decoded
-    fields.
+    path, including mask-padded partial tails) falls back to the
+    scan-only chunked step on its decoded fields — still one device
+    call. Pairs with :class:`blendjax.train.TrainDriver` to keep
+    several of these single-dispatch steps in flight.
     """
-    loss_fn = loss_fn or (
-        lambda state, params, batch: corner_loss(
-            state.apply_fn({"params": params}, batch["image"]),
-            batch["xy"],
-            image_shape=batch["image"].shape[1:3],
-        )
-    )
+    loss_fn = loss_fn or _default_loss
     chunked = make_chunked_supervised_step(
         loss_fn=loss_fn, donate=donate,
         augment=augment, augment_rng=augment_rng,
@@ -276,7 +289,27 @@ def make_fused_tile_step(
         donate_argnums=(0,) if donate else (),
     )
 
+    def _fused_pal(state, packed, spec, pal_groups):
+        from blendjax.ops.tiles import decode_packed_pal_superbatch
+
+        superbatch = decode_packed_pal_superbatch(packed, spec, pal_groups)
+        state, losses = jax.lax.scan(
+            _chunk_scan_body(loss_fn, augment, base_rng), state, superbatch
+        )
+        return state, {"loss": losses}
+
+    fused_pal = jax.jit(
+        _fused_pal,
+        static_argnames=("spec", "pal_groups"),
+        donate_argnums=(0,) if donate else (),
+    )
+
     def step(state, batch):
+        if "_pal" in batch:
+            return fused_pal(
+                state, batch["_packed"],
+                spec=batch["_spec"], pal_groups=batch["_pal"],
+            )
         if "_packed" in batch:
             return fused(
                 state, batch["_packed"], batch["_refs"],
@@ -295,15 +328,25 @@ def make_fused_tile_step(
 def make_eval_step():
     def evaluate(state, batch):
         pred = state.apply_fn({"params": state.params}, batch["image"])
+        mask = batch.get("_mask")
+        err = jnp.linalg.norm(
+            pred - batch["xy"].astype(jnp.float32), axis=-1
+        )
+        if mask is None:
+            px_err = jnp.mean(err)
+        else:
+            # mask-padded tail batch: padded rows must not dilute the
+            # eval metrics (an eval pass sees every real example once)
+            m = mask.astype(jnp.float32)
+            px_err = (
+                err.reshape(err.shape[0], -1).mean(axis=1) * m
+            ).sum() / jnp.maximum(m.sum(), 1.0)
         return {
             "loss": corner_loss(
-                pred, batch["xy"], image_shape=batch["image"].shape[1:3]
+                pred, batch["xy"], image_shape=batch["image"].shape[1:3],
+                mask=mask,
             ),
-            "px_err": jnp.mean(
-                jnp.linalg.norm(
-                    pred - batch["xy"].astype(jnp.float32), axis=-1
-                )
-            ),
+            "px_err": px_err,
         }
 
     return jax.jit(evaluate)

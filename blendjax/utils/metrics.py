@@ -40,6 +40,14 @@ class Metrics:
     def gauge(self, name: str, value) -> None:
         self.gauges[name] = value
 
+    def gauge_max(self, name: str, value) -> None:
+        # High-water-mark gauge: read-max-store is a lost-update race
+        # for concurrent writers (the sharded ingest pool), so the pair
+        # runs under the counter lock.
+        with self._lock:
+            if value > self.gauges.get(name, value - 1):
+                self.gauges[name] = value
+
     @contextlib.contextmanager
     def span(self, name: str):
         t0 = time.perf_counter()

@@ -42,6 +42,18 @@ def main():
         "jitted scan of K updates (needs --encoding tile or pal)",
     )
     ap.add_argument(
+        "--inflight", type=int, default=0,
+        help="async overlap driver: fuse the decode into the train jit "
+        "(one device dispatch per step) and keep up to N dispatches in "
+        "flight via blendjax.train.TrainDriver (needs --encoding tile "
+        "or pal; see docs/performance.md 'Closing the live-MFU gap'). "
+        "0 = classic decode-then-step loop",
+    )
+    ap.add_argument(
+        "--sync-every", type=int, default=16,
+        help="driver loss-fetch cadence (steps) when --inflight > 0",
+    )
+    ap.add_argument(
         "--augment", action="store_true",
         help="on-device color jitter inside the jitted step "
         "(blendjax.ops.augment; per-step deterministic keys). Only "
@@ -79,7 +91,19 @@ def main():
 
         augment = make_augment(color_jitter)
     chunk = args.chunk if args.encoding in ("tile", "pal") else 1
-    if chunk > 1:
+    use_driver = args.inflight > 0 and args.encoding in ("tile", "pal")
+    if use_driver:
+        # Fused decode + async overlap: exactly one device dispatch per
+        # step, up to --inflight of them outstanding, loss fetched every
+        # --sync-every steps (docs/performance.md).
+        from blendjax.train import TrainDriver, make_fused_tile_step
+
+        step = make_fused_tile_step(augment=augment)
+        driver = TrainDriver(
+            step, state, inflight=args.inflight,
+            sync_every=args.sync_every,
+        )
+    elif chunk > 1:
         # K sequential updates per device call (see docs/performance.md);
         # augmentation keys fold the in-scan step counter, so this
         # trains identically to chunk=1 with --augment.
@@ -89,23 +113,40 @@ def main():
             mesh=mesh, batch_sharding=sharding, augment=augment
         )
 
+    def batch_count(batch):
+        if "_packed" in batch:
+            # packed chunk group: K' rows x the per-batch xy lead
+            lead = next(
+                s[0] for nm, d, s, o, b in batch["_spec"] if nm == "xy"
+            )
+            return batch["_packed"].shape[0] * lead
+        # superbatches are (K', B, ...) and K' can run short on a
+        # group flush; count what actually arrived
+        shp = batch["image"].shape
+        return shp[0] * shp[1] if chunk > 1 or use_driver else shp[0]
+
     def run_steps(batches):
         nonlocal state
         t0, n = time.perf_counter(), 0
         for i, batch in enumerate(batches):
             if i >= args.steps:
                 break
-            state, metrics = step(
-                state, {"image": batch["image"], "xy": batch["xy"]}
-            )
-            # superbatches are (K', B, ...) and K' can run short on a
-            # group flush; count what actually arrived
-            shp = batch["image"].shape
-            n += shp[0] * shp[1] if chunk > 1 else shp[0]
-            if i % 10 == 0:
-                loss = metrics["loss"]
-                loss = loss[-1] if getattr(loss, "ndim", 0) else loss
-                print(f"step {i}: loss={float(loss):.5f}")
+            if use_driver:
+                driver.submit(batch)
+            else:
+                fields = {"image": batch["image"], "xy": batch["xy"]}
+                if "_mask" in batch:  # bucket-padded tail: loss-masked
+                    fields["_mask"] = batch["_mask"]
+                state, metrics = step(state, fields)
+                if i % 10 == 0:
+                    loss = metrics["loss"]
+                    loss = loss[-1] if getattr(loss, "ndim", 0) else loss
+                    print(f"step {i}: loss={float(loss):.5f}")
+            n += batch_count(batch)
+        if use_driver:
+            state, final = driver.finish()
+            if final is not None:  # None = zero batches submitted
+                print(f"final loss={final:.5f}  driver={driver.stats}")
         dt = time.perf_counter() - t0
         print(f"{n / dt:.1f} images/sec ({n} images in {dt:.1f}s)")
 
@@ -116,7 +157,8 @@ def main():
         # traffic (tile-delta recordings included), looping like epochs.
         pipe = StreamDataPipeline.from_recording(
             args.replay, batch_size=args.batch, sharding=sharding, loop=True,
-            chunk=chunk, allow_pickle=args.allow_pickle,
+            chunk=chunk, emit_packed=use_driver,
+            allow_pickle=args.allow_pickle,
         )
         with pipe:
             run_steps(iter(pipe))
@@ -139,6 +181,7 @@ def main():
             batch_size=args.batch,
             sharding=sharding,
             chunk=chunk,
+            emit_packed=use_driver,
             record_path_prefix=args.record,
         ) as pipe:
             run_steps(iter(pipe))

@@ -174,6 +174,90 @@ def test_bjx102_negative_outside_hot_path_and_benign_hot_code():
     )
 
 
+# -- BJX106 sync-on-inflight-step -------------------------------------------
+
+DRIVER_SYNC = """
+    import jax
+    import numpy as np
+
+    def run(step, state, batches):
+        for b in batches:
+            state, m = step(state, b)
+            jax.block_until_ready(m["loss"])
+            v = float(np.asarray(m["loss"]))
+        return state
+"""
+
+
+def test_bjx106_flags_same_iteration_sync_in_driver_module():
+    got = findings(DRIVER_SYNC, relpath="blendjax/train/driver.py")
+    assert [f.rule for f in got] == ["BJX106"] * 3
+    assert "block_until_ready()" in got[0].message
+    assert "'m'" in got[0].message
+
+
+def test_bjx106_marker_opts_a_module_in():
+    marked = "# bjx: driver-hot-path\n" + textwrap.dedent(DRIVER_SYNC)
+    got = analyze_source(marked, "anywhere.py")
+    assert [f.rule for f in got] == ["BJX106"] * 3
+
+
+def test_bjx106_negatives_prior_iteration_and_non_driver_modules():
+    # the sanctioned driver shapes: syncs on ring-popped values from
+    # EARLIER iterations (helper methods, no same-iteration assign)
+    clean = """
+        import collections
+
+        import jax
+        import numpy as np
+
+        def run(step, state, batches, inflight=4):
+            pending = collections.deque()
+            for b in batches:
+                while len(pending) >= inflight:
+                    _wait(pending)
+                state, m = step(state, b)
+                pending.append(m["loss"])
+            return state, float(np.asarray(pending.pop()))
+
+        def _wait(pending):
+            oldest = pending.popleft()
+            jax.block_until_ready(oldest)
+    """
+    assert rule_ids(clean, relpath="blendjax/train/driver.py") == []
+    # identical per-iteration sync outside driver hot paths: silent
+    assert rule_ids(DRIVER_SYNC, relpath="blendjax/train/loops.py") == []
+    # sync placed BEFORE the dispatch reads the PREVIOUS iteration's
+    # value — the sanctioned sync-one-behind shape, not flagged
+    one_behind = """
+        import numpy as np
+
+        def run(step, state, batches):
+            m = None
+            for b in batches:
+                if m is not None:
+                    print(float(np.asarray(m["loss"])))
+                state, m = step(state, b)
+            return state
+    """
+    assert rule_ids(one_behind, relpath="blendjax/train/driver.py") == []
+
+
+def test_bjx106_item_and_attribute_form():
+    got = findings(
+        """
+        def run(step, state, batches):
+            for b in batches:
+                state, m = step(state, b)
+                x = m["loss"].item()
+            return state
+        """,
+        relpath="blendjax/train/driver.py",
+    )
+    assert [f.rule for f in got] == ["BJX106"]
+    assert "item()" in got[0].message
+
+
 # -- BJX103 unsafe-deserialization ------------------------------------------
 
 
@@ -560,7 +644,9 @@ def test_cli_exit_codes_and_json(tmp_path):
 
     ok = run("--list-rules")
     assert ok.returncode == 0
-    for rule_id in ("BJX101", "BJX102", "BJX103", "BJX104", "BJX105"):
+    for rule_id in (
+        "BJX101", "BJX102", "BJX103", "BJX104", "BJX105", "BJX106",
+    ):
         assert rule_id in ok.stdout
 
 
@@ -584,7 +670,7 @@ def test_syntax_error_reports_bjx000():
 
 def test_every_rule_registered():
     assert set(all_rules()) == {
-        "BJX101", "BJX102", "BJX103", "BJX104", "BJX105",
+        "BJX101", "BJX102", "BJX103", "BJX104", "BJX105", "BJX106",
     }
 
 

@@ -286,15 +286,35 @@ def test_gated_row_retries_once_after_midrow_collapse():
     import bench
 
     clock = _Clock()
-    # attempt 1: pre fit, post collapsed (flap); attempt 2: fit holds
+    # attempt 1: pre fit, post collapsed AND the jitter re-probe still
+    # collapsed (a real mid-row flap); attempt 2: fit holds
     row = bench.run_gated_row(
         _row_fn([500.0, 510.0], clock),
-        _probe_seq([FIT, COLLAPSED, FIT, FIT], clock),
+        _probe_seq([FIT, COLLAPSED, COLLAPSED, FIT, FIT], clock),
         headline_fit=True, degraded=False, budget=180.0,
         poll_sleep=12.0, clock=clock, sleep=clock.sleep,
     )
     assert row["fit_window"] is True
     assert row["img_s"] == 510.0  # the retry's measurement
+
+
+def test_gated_row_single_jitter_sample_cannot_invalidate():
+    """One collapsed post sample between two fit ones is host jitter,
+    not weather: the immediate re-probe absorbs it, the row stays fit
+    on its FIRST measurement, and the discarded sample is preserved
+    (the BENCH_r05 `utilization.invalid: "weather"` mode)."""
+    import bench
+
+    clock = _Clock()
+    row = bench.run_gated_row(
+        _row_fn([500.0, 510.0], clock),
+        _probe_seq([FIT, COLLAPSED, FIT], clock),
+        headline_fit=True, degraded=False, budget=180.0,
+        poll_sleep=12.0, clock=clock, sleep=clock.sleep,
+    )
+    assert row["fit_window"] is True
+    assert row["img_s"] == 500.0  # no re-measurement needed
+    assert row["weather"]["post"]["jitter_discarded"] == 12.0
 
 
 def test_gated_row_degraded_skips_probes_entirely():
@@ -328,6 +348,35 @@ def test_pipelined_ceiling_caps_and_flags(monkeypatch):
     out = bench.measure_pipelined_ceiling(2, items=32, time_cap=0.0)
     assert out["images"] > 0 and out["img_s"] > 0
     assert out.get("capped") is True
+
+
+def test_live_overlap_row_shape(monkeypatch):
+    """The async-overlap A/B row runs both legs for real through the
+    fused driver path and reports the record's contract: zero
+    standalone decode dispatches, exactly one jit call per driver step
+    (the bench-smoke CI assertion), driver ring stats, and the
+    throughput ratio. Bench shapes shrunk for the CPU mesh like the
+    rows above."""
+    import bench
+
+    monkeypatch.setattr(bench, "SHAPE", (64, 64))
+    monkeypatch.setattr(bench, "_TILE_ARGS", ["16"])
+    monkeypatch.setattr(bench, "TILE_CAPACITY", "16")
+    monkeypatch.setenv("BLENDJAX_BENCH_INSTANCES", "2")
+    row = bench.measure_live_overlap(
+        chunk=2, items=16, time_cap=10.0, inflight=3
+    )
+    assert row["inflight1"]["img_s"] > 0
+    assert row["inflight3"]["img_s"] > 0
+    assert row["decode_dispatch_eliminated"] is True
+    assert row["dispatch_per_step"] == 1.0
+    for leg in ("inflight1", "inflight3"):
+        assert row[leg]["decode_dispatch_count"] == 0
+        assert row[leg]["train_dispatch_count"] == row[leg]["dispatches"]
+        assert row[leg]["steps_in_flight_hwm"] <= 3
+    assert row["value"] == pytest.approx(
+        row["inflight3"]["img_s"] / row["inflight1"]["img_s"], rel=1e-3
+    )
 
 
 def test_ingest_workers_ab_row_shape(monkeypatch):
