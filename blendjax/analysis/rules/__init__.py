@@ -6,6 +6,7 @@ from blendjax.analysis.rules import (  # noqa: F401  (registration side effects)
     deserialization,
     driver_sync,
     hotpath,
+    metric_names,
     purity,
     resource_leak,
     zmq_affinity,

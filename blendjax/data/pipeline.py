@@ -1213,6 +1213,11 @@ class StreamDataPipeline:
                     )
                 return RemoteStream(
                     shard, worker_index=i, num_workers=len(shards),
+                    # shards see DISJOINT producer subsets (whole
+                    # per-producer streams), so seq-gap accounting is
+                    # sound despite the worker slot — override the
+                    # auto num_workers==1 default.
+                    track_gaps=True,
                     **kwargs,
                 )
 
@@ -1255,6 +1260,23 @@ class StreamDataPipeline:
 
     def queue_depth(self) -> int:
         return 0 if self.ingest is None else self.ingest.queue_depth()
+
+    def doctor(self, driver=None):
+        """One-line bottleneck verdict for the live pipeline
+        (:mod:`blendjax.obs.doctor`): classifies producer-/wire-/
+        decode-/feed-/step-bound from the current metrics snapshot plus
+        frame lineage. ``driver`` may be a ``TrainDriver`` (or its
+        ``stats`` dict) so ring-full blocks feed the diagnosis; the
+        pipeline's own ``prefetch`` bound lets the queue-depth
+        high-water gauge count as backpressure evidence.
+
+        >>> print(pipe.doctor().render())
+        """
+        from blendjax.obs import diagnose_current
+
+        stats = getattr(driver, "stats", driver)
+        metrics.gauge("ingest.queue_depth", self.queue_depth())
+        return diagnose_current(driver=stats, prefetch=self.prefetch)
 
     def stop(self):
         try:

@@ -340,10 +340,16 @@ class ReplayStream:
         self.loop = loop
 
     def __iter__(self):
+        # Lineage stamps recorded off a live wire are STRIPPED, not
+        # accounted: replayed wall times would read as hours of
+        # staleness, and a looped replay would re-walk the same seq
+        # numbers as an endless reorder storm.
+        from blendjax.obs.lineage import strip_stamps
+
         while True:
             for reader in self.readers:
                 for i in range(len(reader)):
-                    yield reader[i]
+                    yield strip_stamps(reader[i])
             if not self.loop:
                 return
 

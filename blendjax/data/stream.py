@@ -17,6 +17,7 @@ import time
 
 from blendjax import constants
 from blendjax.data.replay import FileRecorder
+from blendjax.obs.lineage import lineage
 from blendjax.transport import DataReceiverSocket, ReceiveTimeoutError
 from blendjax.utils.logging import get_logger
 
@@ -67,6 +68,7 @@ class RemoteStream:
         copy_arrays: bool = False,
         allow_pickle: bool = True,
         on_timeout=None,
+        track_gaps: bool | None = None,
     ):
         if isinstance(addresses, str):
             addresses = [addresses]
@@ -86,6 +88,22 @@ class RemoteStream:
         # the launcher), False/None to fail fast like the reference
         # (``dataset.py:98-99``).
         self.on_timeout = on_timeout
+        # Seq-gap accounting is only sound when THIS consumer sees each
+        # connected producer's whole stream. ZMQ PUSH fair-queues
+        # messages ACROSS connected PULL peers, so several consumers
+        # sharing the same addresses (torch DataLoader workers,
+        # multiprocess worker splits) each observe a strided
+        # subsequence — every stride would read as a phantom drop. The
+        # default is therefore AUTO: track only when num_workers == 1.
+        # Staleness and telemetry accounting (per-message,
+        # consumer-local) stay on either way; only the sequence
+        # bookkeeping is skipped. The sharded ingest pool passes
+        # track_gaps=True explicitly: it partitions ADDRESSES, so each
+        # shard still sees whole per-producer streams despite its
+        # worker slot.
+        self.track_gaps = (
+            num_workers == 1 if track_gaps is None else bool(track_gaps)
+        )
         self._stop_requested = False
 
     def request_stop(self) -> None:
@@ -177,6 +195,17 @@ class RemoteStream:
                 msg, raw = out
                 if recorder is not None:
                     recorder.save(raw)
+                # Frame lineage: pop the publisher's seq/time stamps (+
+                # any piggybacked telemetry snapshot) and account them —
+                # per-producer e2e staleness histograms and EXACT
+                # drop/reorder counts (docs/observability.md). Runs
+                # after the recorder tee (recordings keep the stamps)
+                # and before item_transform (transforms see the same
+                # message shape as before PR 4). The sharded ingest
+                # pool inherits this per shard stream: each producer's
+                # numbering lands whole on one shard socket, so
+                # round-robin partitioning can't fake a gap.
+                lineage.ingest(msg, track_gaps=self.track_gaps)
                 yield self.item_transform(msg)
                 n += 1
         finally:

@@ -153,6 +153,9 @@ class RemoteIterableDataset(tud.IterableDataset):
             worker_index=worker_index,
             num_workers=num_workers,
             copy_arrays=True,  # torch tensors need writable arrays
+            # num_workers > 1 shares the producer fan-in, so the stream
+            # auto-disables seq-gap accounting (strided subsequences
+            # would read as phantom drops; staleness/telemetry stay on).
         )
         messages = iter(stream)
         items = self._items(messages)

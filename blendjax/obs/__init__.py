@@ -1,0 +1,62 @@
+"""blendjax.obs — end-to-end pipeline telemetry.
+
+The observability layer over the streaming stack (docs/observability.md):
+
+- :mod:`blendjax.obs.lineage` — frame lineage: per-producer end-to-end
+  staleness histograms, exact seq-gap/reorder counters, and the fleet
+  telemetry view assembled from producer-piggybacked snapshots.
+- :mod:`blendjax.obs.doctor` — the stall doctor: classifies the current
+  bottleneck (producer-/wire-/decode-/feed-/step-bound) from one
+  metrics snapshot.
+- :mod:`blendjax.obs.exporters` — Prometheus text over a stdlib HTTP
+  endpoint, JSONL snapshot archives, Chrome/Perfetto trace export of
+  span events.
+- :mod:`blendjax.obs.reporter` — ``StatsReporter``, the background
+  thread that logs a doctor verdict (and optionally archives
+  snapshots) on an interval.
+
+Import-cheap by design: nothing here pulls jax, zmq, or numpy, so
+producer processes (Blender's Python) can export their own metrics.
+"""
+
+from __future__ import annotations
+
+from blendjax.obs.doctor import (  # noqa: F401
+    DEFAULT_STALE_WIRE_S,
+    VERDICTS,
+    Verdict,
+    diagnose,
+    diagnose_current,
+)
+from blendjax.obs.exporters import (  # noqa: F401
+    JsonlExporter,
+    MetricsHTTPServer,
+    chrome_trace,
+    prometheus_text,
+    start_http_exporter,
+    write_chrome_trace,
+)
+from blendjax.obs.lineage import (  # noqa: F401
+    FrameLineage,
+    lineage,
+    strip_stamps,
+)
+from blendjax.obs.reporter import StatsReporter  # noqa: F401
+
+__all__ = [
+    "DEFAULT_STALE_WIRE_S",
+    "VERDICTS",
+    "Verdict",
+    "diagnose",
+    "diagnose_current",
+    "JsonlExporter",
+    "MetricsHTTPServer",
+    "chrome_trace",
+    "prometheus_text",
+    "start_http_exporter",
+    "write_chrome_trace",
+    "FrameLineage",
+    "lineage",
+    "strip_stamps",
+    "StatsReporter",
+]

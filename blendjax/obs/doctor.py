@@ -1,0 +1,271 @@
+"""Stall doctor: classify the pipeline's current bottleneck.
+
+tf.data-style per-stage bottleneck attribution over the metrics the
+pipeline already emits. The streaming stack has five distinct failure
+modes, previously told apart by hand-reading counter dumps in
+``BENCH_r0*.json``; the doctor encodes that reading as a deterministic
+decision procedure over one :meth:`Metrics.report` snapshot:
+
+==============  ============================================================
+verdict         evidence
+==============  ============================================================
+step-bound      ingest outruns the consumer: ``ingest.queue_full_waits``
+                climbing while the consumer barely waits on the queue, or
+                the driver's dispatch ring blocking (``driver.ring_wait`` /
+                ``train.host_blocks``)
+feed-bound      host→device transfer is the wall: ``feed.throttle_blocks``
+                with a significant ``feed.throttle_wait``/``feed.place``
+                share
+decode-bound    the standalone decode jit dominates (``decode.dispatch``)
+wire-bound      the consumer starves (``ingest.queue_wait`` high) AND
+                frames arrive already old (per-producer e2e staleness p95
+                above ``stale_wire_s``): the socket/codec path is slow,
+                not the producers
+producer-bound  the consumer starves but frames arrive FRESH: producers
+                simply don't render fast enough
+==============  ============================================================
+
+plus ``balanced`` (no single stage dominates — the healthy verdict) and
+``idle`` (no span data yet). The discriminator between wire- and
+producer-bound is frame lineage (:mod:`blendjax.obs.lineage`): identical
+queue-wait symptoms, opposite staleness signatures.
+
+All inputs are plain dicts so synthetic fixtures exercise every verdict
+without sockets or devices (``tests/test_obs.py``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+# Verdict kinds, in the order the decision procedure tests them.
+VERDICTS = (
+    "step-bound",
+    "feed-bound",
+    "decode-bound",
+    "wire-bound",
+    "producer-bound",
+    "balanced",
+    "idle",
+)
+
+# Staleness p95 above which a starving consumer reads wire-bound rather
+# than producer-bound: a healthy local pipe delivers frames in tens of
+# milliseconds; a quarter second of age on arrival means the frames
+# existed long before we got them.
+DEFAULT_STALE_WIRE_S = 0.25
+
+
+@dataclasses.dataclass(frozen=True)
+class Verdict:
+    """One classification: ``kind`` (a :data:`VERDICTS` member), a
+    human ``reason`` with the deciding numbers inlined, ``advice`` (the
+    lever to pull), and the span ``shares`` it was computed from."""
+
+    kind: str
+    reason: str
+    advice: str
+    shares: dict
+
+    def render(self) -> str:
+        return f"doctor: {self.kind} — {self.reason} ({self.advice})"
+
+    def __str__(self) -> str:  # str(verdict) in f-strings/logs
+        return self.render()
+
+
+def _total(spans: dict, name: str) -> float:
+    v = spans.get(name)
+    if not v:
+        return 0.0
+    return float(v.get("total_s", 0.0))
+
+
+def diagnose(
+    report: dict,
+    driver: dict | None = None,
+    lineage: dict | None = None,
+    staleness_p95_s: float | None = None,
+    stale_wire_s: float = DEFAULT_STALE_WIRE_S,
+    prefetch: int | None = None,
+) -> Verdict:
+    """Classify one :meth:`blendjax.utils.metrics.Metrics.report`
+    snapshot. ``driver`` is an optional ``TrainDriver.stats`` dict;
+    ``lineage`` an optional :meth:`FrameLineage.report` snapshot (used
+    for the staleness discriminator when ``staleness_p95_s`` isn't
+    given directly); ``prefetch`` — when the caller knows the ingest
+    queue bound — lets the ``ingest.queue_depth_hwm`` gauge act as
+    backpressure evidence (queue pinned at its bound == producers
+    outran the consumer) alongside ``ingest.queue_full_waits``."""
+    spans = report.get("spans", {})
+    counters = report.get("counters", {})
+    gauges = report.get("gauges", {})
+
+    recv = sum(
+        float(v.get("total_s", 0.0))
+        for k, v in spans.items()
+        if k.startswith("ingest.recv")
+    )
+    qwait = _total(spans, "ingest.queue_wait")
+    place = _total(spans, "feed.place")
+    throttle = _total(spans, "feed.throttle_wait")
+    decode = _total(spans, "decode.dispatch")
+    train = _total(spans, "train.dispatch")
+    ring = _total(spans, "driver.ring_wait")
+
+    busy = recv + qwait + place + throttle + decode + train + ring
+    shares = {
+        "ingest.recv": recv,
+        "ingest.queue_wait": qwait,
+        "feed.place": place,
+        "feed.throttle_wait": throttle,
+        "decode.dispatch": decode,
+        "train.dispatch": train,
+        "driver.ring_wait": ring,
+    }
+    if busy <= 0.0:
+        return Verdict(
+            "idle", "no span data recorded yet",
+            "run the pipeline before asking for a diagnosis", shares,
+        )
+    shares = {k: round(v / busy, 4) for k, v in shares.items()}
+
+    full_waits = int(counters.get("ingest.queue_full_waits", 0))
+    throttle_blocks = int(counters.get("feed.throttle_blocks", 0))
+    host_blocks = int(counters.get("train.host_blocks", 0))
+    if driver:
+        host_blocks = max(host_blocks, int(driver.get("host_blocks", 0)))
+
+    if staleness_p95_s is None and lineage:
+        vals = [
+            p.get("e2e_staleness_ms", {}).get("p95")
+            for p in lineage.values()
+            if p.get("e2e_staleness_ms", {}).get("count")
+        ]
+        vals = [v for v in vals if v is not None]
+        if vals:
+            staleness_p95_s = max(vals) / 1e3
+
+    # 1. step-bound (specific evidence): the dispatch ring genuinely
+    #    filling — these signals implicate the STEP itself, so they
+    #    outrank the generic backpressure arm below (which any
+    #    downstream-of-queue bottleneck also produces).
+    depth_hwm = int(gauges.get("ingest.queue_depth_hwm", 0))
+    backpressured = full_waits > 0 or (
+        prefetch is not None and prefetch > 0 and depth_hwm >= prefetch
+    )
+
+    def step_verdict():
+        return Verdict(
+            "step-bound",
+            f"ingest.queue_full_waits={full_waits}, "
+            f"queue_depth_hwm={depth_hwm}, "
+            f"ring_wait share={shares['driver.ring_wait']:.0%}, "
+            f"host_blocks={host_blocks}: the train step can't keep up "
+            "with ingest",
+            "raise chunk/inflight, shrink the model, or add chips",
+            shares,
+        )
+
+    if shares["driver.ring_wait"] > 0.35 or (
+        host_blocks > 0 and shares["train.dispatch"] > 0.35
+    ):
+        return step_verdict()
+
+    # 2. feed-bound: host→device transfer throttling the loop. Checked
+    #    BEFORE the backpressure step-bound arm: a slow feed fills the
+    #    ingest queue too, and its own counters are the more specific
+    #    evidence.
+    if throttle_blocks > 0 and (
+        shares["feed.throttle_wait"] + shares["feed.place"] > 0.25
+    ):
+        return Verdict(
+            "feed-bound",
+            f"feed.throttle_blocks={throttle_blocks}, "
+            f"throttle_wait+place share="
+            f"{shares['feed.throttle_wait'] + shares['feed.place']:.0%}: "
+            "host->device transfer is the wall",
+            "shrink wire bytes (tile/pal encoding), raise chunk, or "
+            "check link weather",
+            shares,
+        )
+
+    # 3. decode-bound: the standalone decode jit dominates.
+    others = max(
+        shares["ingest.recv"], shares["ingest.queue_wait"],
+        shares["feed.place"], shares["feed.throttle_wait"],
+        shares["train.dispatch"], shares["driver.ring_wait"],
+    )
+    if shares["decode.dispatch"] > 0.30 and shares["decode.dispatch"] >= others:
+        return Verdict(
+            "decode-bound",
+            f"decode.dispatch share={shares['decode.dispatch']:.0%} "
+            "dominates the loop",
+            "fuse the decode into the step (emit_packed + "
+            "make_fused_tile_step) or revisit tile geometry",
+            shares,
+        )
+
+    # 3b. step-bound (generic backpressure): ingest blocked on a full
+    #     queue — or the depth high-water mark pinned at the known
+    #     bound — while the consumer barely waits on it. Reached only
+    #     once feed and decode have been ruled out, because ANY
+    #     downstream-of-queue bottleneck produces this signature.
+    if backpressured and shares["ingest.queue_wait"] < 0.15:
+        return step_verdict()
+
+    # 4/5. consumer starving: gate on ingest.queue_wait ALONE — it is
+    #      the consumer-observed wait. ingest.recv accrues concurrently
+    #      in N worker threads (N shards blocked in recv can bank ~N x
+    #      wall of span time), so using it as evidence would
+    #      misclassify a healthy sharded run as starving; it only
+    #      corroborates via the reason string.
+    if shares["ingest.queue_wait"] > 0.30:
+        if staleness_p95_s is not None and staleness_p95_s >= stale_wire_s:
+            return Verdict(
+                "wire-bound",
+                f"consumer starving (queue_wait share="
+                f"{shares['ingest.queue_wait']:.0%}) and frames arrive "
+                f"{staleness_p95_s * 1e3:.0f} ms old (p95): the "
+                "socket/codec path is slow, not the producers",
+                "enable wire compression (compress_level), raise "
+                "ingest_workers, or fix the link",
+                shares,
+            )
+        fresh = (
+            f"{staleness_p95_s * 1e3:.0f} ms old (p95)"
+            if staleness_p95_s is not None else "unstamped"
+        )
+        return Verdict(
+            "producer-bound",
+            f"consumer starving (queue_wait share="
+            f"{shares['ingest.queue_wait']:.0%}) while frames arrive "
+            f"fresh ({fresh}): producers don't render fast enough",
+            "launch more producer instances or cheapen the scene/render",
+            shares,
+        )
+
+    return Verdict(
+        "balanced",
+        "no single stage dominates",
+        "nothing to fix; scale the workload to find the next wall",
+        shares,
+    )
+
+
+def diagnose_current(driver: dict | None = None,
+                     stale_wire_s: float = DEFAULT_STALE_WIRE_S,
+                     prefetch: int | None = None) -> Verdict:
+    """Diagnose the live process-wide registries (the convenience the
+    :class:`blendjax.obs.reporter.StatsReporter` thread and
+    ``StreamDataPipeline.doctor()`` call)."""
+    from blendjax.obs.lineage import lineage
+    from blendjax.utils.metrics import metrics
+
+    return diagnose(
+        metrics.report(),
+        driver=driver,
+        staleness_p95_s=lineage.staleness_p95_s(),
+        stale_wire_s=stale_wire_s,
+        prefetch=prefetch,
+    )

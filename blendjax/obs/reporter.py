@@ -1,0 +1,113 @@
+"""StatsReporter: a background thread that keeps the operator informed.
+
+Every ``interval_s`` it takes one consistent metrics snapshot, runs the
+stall doctor over it, logs the one-line verdict, and (optionally)
+appends the full snapshot to a JSONL archive — the always-on version of
+what ``bench.py`` stamps into its stage breakdowns, for long training
+runs that never go through the bench harness.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from blendjax.obs.doctor import diagnose
+from blendjax.obs.exporters import JsonlExporter
+from blendjax.obs.lineage import FrameLineage
+from blendjax.obs.lineage import lineage as default_lineage
+from blendjax.utils.metrics import Metrics, metrics
+from blendjax.utils.logging import get_logger
+
+logger = get_logger("obs")
+
+
+class StatsReporter:
+    """Periodic doctor verdict + optional JSONL snapshot archive.
+
+    >>> rep = StatsReporter(interval_s=10, jsonl_path="run_stats.jsonl")
+    >>> rep.start()
+    ... # train ...
+    >>> rep.stop()
+
+    ``driver_stats`` may be a zero-arg callable returning a
+    ``TrainDriver.stats`` dict so ring-full blocks feed the diagnosis.
+    """
+
+    def __init__(
+        self,
+        interval_s: float = 10.0,
+        registry: Metrics = metrics,
+        lineage: FrameLineage = default_lineage,
+        jsonl_path: str | None = None,
+        driver_stats=None,
+        log=logger,
+    ):
+        self.interval_s = float(interval_s)
+        self.registry = registry
+        self.lineage = lineage
+        self.driver_stats = driver_stats
+        self.log = log
+        self._jsonl = JsonlExporter(jsonl_path) if jsonl_path else None
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self.last_verdict = None
+
+    def tick(self):
+        """One report cycle (public so tests — and callers that want a
+        verdict NOW — can run it synchronously)."""
+        report = self.registry.report()
+        driver = self.driver_stats() if callable(self.driver_stats) else None
+        verdict = diagnose(
+            report, driver=driver,
+            staleness_p95_s=self.lineage.staleness_p95_s(),
+        )
+        self.last_verdict = verdict
+        self.log.info("%s", verdict.render())
+        if self._jsonl is not None:
+            self._jsonl.write(
+                report,
+                extra={
+                    "doctor": {
+                        "kind": verdict.kind,
+                        "reason": verdict.reason,
+                        "shares": verdict.shares,
+                    },
+                    "lineage": self.lineage.report(),
+                },
+            )
+        return verdict
+
+    def _run(self) -> None:
+        # wait-first loop: a reporter started beside an empty pipeline
+        # shouldn't open with a meaningless "idle" line
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.tick()
+            except Exception:  # a reporting flake must not kill the run
+                self.log.exception("stats reporter tick failed")
+
+    def start(self) -> "StatsReporter":
+        assert self._thread is None, "already started"
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._run, name="blendjax-stats-reporter", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self, final_tick: bool = True) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+        if final_tick:
+            try:
+                self.tick()  # closing snapshot: the run's last word
+            except Exception:
+                self.log.exception("final stats tick failed")
+
+    def __enter__(self) -> "StatsReporter":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()

@@ -18,6 +18,7 @@ blocking strategy the reference uses under ``--background``,
 from __future__ import annotations
 
 from blendjax.producer.signal import Signal
+from blendjax.utils.metrics import metrics
 
 
 class Engine:
@@ -129,7 +130,12 @@ class AnimationController:
             self._rewind_requested = False
             self.frameid = frame
             self.pre_frame.invoke(frame)
-            self.engine.frame_set(frame)
+            # producer.frame = render + physics for one frame: the span
+            # producers piggyback to consumers via the data-channel
+            # telemetry snapshots (DataPublisherSocket.telemetry_every),
+            # so a fleet-wide render-time view needs no extra socket.
+            with metrics.span("producer.frame"):
+                self.engine.frame_set(frame)
             self.post_frame.invoke(frame)
             if self._cancel_requested:
                 raise CancelledError

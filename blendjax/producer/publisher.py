@@ -24,7 +24,13 @@ class DataPublisher(DataPublisherSocket):
         copy: bool = False,
         compress_level: int = 0,
         compress_min_bytes: int = DEFAULT_COMPRESS_MIN_BYTES,
+        lineage: bool = True,
+        telemetry_every: int = 64,
     ):
+        # lineage/telemetry_every: publish-time stamps + the periodic
+        # producer-metrics piggyback (docs/observability.md) — on by
+        # default so every producer in a fleet shows up in the
+        # consumer's staleness/gap/telemetry view without opting in.
         super().__init__(
             bind_addr,
             btid=btid,
@@ -34,4 +40,6 @@ class DataPublisher(DataPublisherSocket):
             copy=copy,
             compress_level=compress_level,
             compress_min_bytes=compress_min_bytes,
+            lineage=lineage,
+            telemetry_every=telemetry_every,
         )

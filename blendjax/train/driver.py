@@ -97,6 +97,10 @@ class TrainDriver:
         if self._is_done(loss):
             return
         self.host_blocks += 1
+        # Registry mirror of the instance stat: the stall doctor
+        # (blendjax.obs.doctor) reads plain metrics snapshots, and a
+        # genuine ring-full block is its strongest step-bound signal.
+        metrics.count("train.host_blocks")
         with metrics.span("driver.ring_wait"):
             jax.block_until_ready(loss)
 
@@ -134,6 +138,12 @@ class TrainDriver:
         pending.append(m["loss"])
         if len(pending) > self.inflight_hwm:
             self.inflight_hwm = len(pending)
+        # Registry mirror runs UNCONDITIONALLY (gauge_max is already a
+        # no-op when not a new high): gating it on instance-hwm growth
+        # meant a metrics.reset() mid-run (bench's measured-window
+        # reset) silently lost the gauge forever — the instance hwm,
+        # pinned during warmup, never grew again.
+        metrics.gauge_max("train.inflight_hwm", len(pending))
         if self.sync_every and self.steps % self.sync_every == 0:
             self._sync_oldest()
 

@@ -24,6 +24,7 @@ from __future__ import annotations
 
 import os
 import threading
+import time
 
 import zmq
 
@@ -157,6 +158,8 @@ class DataPublisherSocket(_Channel):
         copy: bool = False,
         compress_level: int = 0,
         compress_min_bytes: int = DEFAULT_COMPRESS_MIN_BYTES,
+        lineage: bool = True,
+        telemetry_every: int = 64,
     ):
         self.codec = codec
         self.btid = btid
@@ -167,6 +170,19 @@ class DataPublisherSocket(_Channel):
         # links, the wrong one on ipc/loopback (docs/performance.md).
         self.compress_level = int(compress_level)
         self.compress_min_bytes = int(compress_min_bytes)
+        # Frame lineage (docs/observability.md): every message carries a
+        # wall + monotonic publish time and a per-publisher monotonic
+        # sequence number, and every `telemetry_every`-th message
+        # piggybacks a snapshot of this process's metrics registry —
+        # the consumer side (blendjax.obs.lineage) turns these into
+        # per-producer staleness histograms, exact drop/reorder counts,
+        # and a fleet telemetry view, all without a second socket.
+        # lineage=False restores the pre-telemetry wire shape.
+        self.lineage = bool(lineage)
+        self.telemetry_every = int(telemetry_every) if lineage else 0
+        self._seq = 0
+        self._created_wall = time.time()
+        self._tel_mark = (0, self._created_wall)  # (seq, wall) at last snapshot
         self.sock = zmq_context().socket(zmq.PUSH)
         self.sock.setsockopt(zmq.SNDHWM, send_hwm)
         self.sock.setsockopt(zmq.IMMEDIATE, 1)
@@ -178,11 +194,50 @@ class DataPublisherSocket(_Channel):
 
     def publish(self, **kwargs):
         """Publish a message dict; stamps ``btid`` for provenance
-        (reference stamps every payload, ``publisher.py:42``)."""
-        data = {"btid": self.btid, **kwargs}
+        (reference stamps every payload, ``publisher.py:42``) plus the
+        lineage stamps (seq + publish times; see ``__init__``)."""
+        data = self._stamp({"btid": self.btid, **kwargs})
         self.sock.send_multipart(
             self._encode(data), copy=self.copy
         )
+
+    def _stamp(self, data: dict) -> dict:
+        if not self.lineage:
+            return data
+        data["_seq"] = self._seq
+        data["_pub_wall"] = time.time()
+        data["_pub_mono"] = time.monotonic()
+        if self.telemetry_every and self._seq % self.telemetry_every == 0:
+            data["_telemetry"] = self._telemetry_snapshot()
+        self._seq += 1
+        return data
+
+    def _telemetry_snapshot(self) -> dict:
+        """Compact, msgpack-native snapshot of this process's metrics
+        (producer render spans, publish rate, frame counter) — the
+        piggyback payload the consumer's fleet view aggregates."""
+        from blendjax.utils.metrics import metrics
+
+        now = time.time()
+        last_seq, last_wall = self._tel_mark
+        dt = max(now - last_wall, 1e-9)
+        self._tel_mark = (self._seq, now)
+        report = metrics.report()
+        return {
+            "seq": int(self._seq),
+            "uptime_s": round(now - self._created_wall, 3),
+            # messages/s since the previous snapshot (0.0 on the first)
+            "mps": round((self._seq - last_seq) / dt, 3),
+            "counters": {k: int(v) for k, v in report["counters"].items()},
+            "spans": {
+                k: {
+                    "count": int(v["count"]),
+                    "mean_ms": round(float(v["mean_ms"]), 3),
+                    "p95_ms": round(float(v.get("p95_ms", 0.0)), 3),
+                }
+                for k, v in report["spans"].items()
+            },
+        }
 
     def _encode(self, data: dict) -> list:
         return encode_message(
@@ -200,7 +255,7 @@ class DataPublisherSocket(_Channel):
         HWM-based pool sizing this bounds buffer reuse for *any* number of
         connected consumers: PUSH keeps one queue per pipe, so per-pipe HWM
         alone does not cap the total number of in-flight messages."""
-        data = {"btid": self.btid, **kwargs}
+        data = self._stamp({"btid": self.btid, **kwargs})
         return self.sock.send_multipart(
             self._encode(data), copy=False, track=True
         )

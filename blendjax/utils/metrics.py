@@ -1,33 +1,159 @@
-"""Lightweight metrics: counters, gauges, and timing spans.
+"""Lightweight metrics: counters, gauges, exact histograms, timing spans.
 
 The reference has no metrics system (SURVEY.md §5 — only wall-clock in its
-benchmark harness); blendjax instruments the ingest pipeline so feed
-stalls are diagnosable: per-stage spans, queue-depth gauges, and a
-one-line report. For deep dives, ``trace`` wraps ``jax.profiler.trace``
-so the same code path emits a TensorBoard-loadable profile.
+benchmark harness); blendjax instruments the whole producer → wire →
+ingest → train pipeline so feed stalls are diagnosable: per-stage spans
+feed lock-exact log-bucketed histograms (p50/p95/p99, not just means —
+the mean hides exactly the tail a stall doctor needs), queue-depth
+gauges, and a one-line report. ``blendjax.obs`` builds the cross-process
+layer on top: frame lineage, the stall doctor, and the Prometheus /
+JSONL / Chrome-trace exporters. For deep device-side dives, ``trace``
+wraps ``jax.profiler.trace`` so the same code path emits a
+TensorBoard-loadable profile.
 """
 
 from __future__ import annotations
 
 import contextlib
+import math
 import threading
 import time
-from collections import defaultdict
+from collections import defaultdict, deque
+
+# 8 buckets per octave: bucket bounds grow by 2**(1/8) ≈ 9.05%, so a
+# quantile read from the bucket midpoint is within ~4.4% of the true
+# value — tight enough to tell a 2x tail regression apart, cheap enough
+# (one log + one dict bump) for the ingest hot path.
+_GAMMA = 2.0 ** 0.125
+_LOG_GAMMA = math.log(_GAMMA)
+
+
+class Histogram:
+    """Exact-count log-bucketed histogram.
+
+    COUNTS are exact (every ``observe`` lands in exactly one bucket;
+    bucket counts always sum to ``count`` — the property the bench's
+    "histogram counts sum exactly to span counts" acceptance check
+    rides on); VALUES are bucketed at ~9% geometric resolution, with
+    exact ``min``/``max``/``sum`` kept alongside so p0/p100 and the
+    mean never suffer bucketing error. Not self-locking: the owning
+    :class:`Metrics` registry serializes access under its one lock.
+    """
+
+    __slots__ = (
+        "count", "total", "min", "max", "zeros", "nonfinite", "buckets",
+    )
+
+    def __init__(self) -> None:
+        self.count = 0
+        self.total = 0.0
+        self.min = math.inf
+        self.max = -math.inf
+        # Non-positive observations (e.g. cross-host staleness under
+        # clock skew) can't take a log: they get their own bucket below
+        # every log bucket, so ordering — and therefore quantiles —
+        # stays correct.
+        self.zeros = 0
+        # NaN/inf observations (a producer with a corrupted clock can
+        # put one on the wire as a staleness input) are counted here
+        # and otherwise ignored: math.log would raise and kill the
+        # observing thread — the ingest loop, for lineage — over one
+        # bad telemetry stamp.
+        self.nonfinite = 0
+        self.buckets: dict = {}
+
+    def observe(self, value) -> None:
+        v = float(value)
+        if not math.isfinite(v):
+            self.nonfinite += 1
+            return
+        self.count += 1
+        self.total += v
+        if v < self.min:
+            self.min = v
+        if v > self.max:
+            self.max = v
+        if v <= 0.0:
+            self.zeros += 1
+            return
+        idx = math.floor(math.log(v) / _LOG_GAMMA)
+        self.buckets[idx] = self.buckets.get(idx, 0) + 1
+
+    def quantile(self, q: float) -> float:
+        """Value at quantile ``q`` in [0, 1] (bucket-midpoint estimate,
+        clamped to the exact observed [min, max])."""
+        if self.count == 0:
+            return 0.0
+        if q <= 0.0:
+            return self.min
+        if q >= 1.0:
+            return self.max
+        rank = q * (self.count - 1)
+        seen = self.zeros
+        if rank < seen:
+            return min(self.min, 0.0)
+        for idx in sorted(self.buckets):
+            seen += self.buckets[idx]
+            if rank < seen:
+                mid = _GAMMA ** (idx + 0.5)
+                return min(max(mid, self.min), self.max)
+        return self.max
+
+    def summary(self) -> dict:
+        if self.count == 0:
+            out = {"count": 0, "sum": 0.0, "min": 0.0, "max": 0.0,
+                   "p50": 0.0, "p95": 0.0, "p99": 0.0}
+            if self.nonfinite:
+                out["nonfinite"] = self.nonfinite
+            return out
+        out = {
+            "count": self.count,
+            "sum": self.total,
+            "min": self.min,
+            "max": self.max,
+            "p50": self.quantile(0.50),
+            "p95": self.quantile(0.95),
+            "p99": self.quantile(0.99),
+        }
+        if self.nonfinite:
+            out["nonfinite"] = self.nonfinite
+        return out
+
+    def cumulative_buckets(self) -> list:
+        """``(upper_bound, cumulative_count)`` pairs in ascending bound
+        order — the Prometheus histogram exposition shape (the exporter
+        appends the implicit ``+Inf`` bucket itself)."""
+        out = []
+        cum = self.zeros
+        if self.zeros:
+            out.append((0.0, cum))
+        for idx in sorted(self.buckets):
+            cum += self.buckets[idx]
+            out.append((_GAMMA ** (idx + 1), cum))
+        return out
 
 
 class Metrics:
-    """Process-local registry. Thread-safe: counters increment under a
+    """Process-local registry. Thread-safe AND snapshot-exact: every
+    mutation — counters, gauges, spans, histograms — runs under one
     lock (uncontended CPython lock acquire is ~100 ns — noise next to
-    the per-batch work they count, and the sharded ingest pool's
+    the per-batch work being counted, and the sharded ingest pool's
     ``wire.*``/``ingest.*`` pairs must sum EXACTLY, not approximately,
-    for the bench's compression/throughput evidence); report() reads a
-    consistent snapshot of spans but only an approximate one of gauges.
+    for the bench's compression/throughput evidence), and ``report()``
+    reads a consistent snapshot under the same lock (a lock-free read
+    raced worker mutation: torn gauge snapshots and a possible
+    ``RuntimeError: dictionary changed size during iteration``).
     """
 
     def __init__(self):
         self.counters: dict = defaultdict(int)
         self.gauges: dict = {}
         self._spans: dict = defaultdict(lambda: [0, 0.0])  # count, total_s
+        self._hists: dict = defaultdict(Histogram)
+        # Optional per-span event ring for Chrome-trace export
+        # (blendjax.obs.exporters.write_chrome_trace): disabled by
+        # default — aggregates are always on, events are opt-in.
+        self._events: deque | None = None
         self._lock = threading.Lock()
 
     def count(self, name: str, n: int = 1) -> None:
@@ -38,7 +164,10 @@ class Metrics:
             self.counters[name] += n
 
     def gauge(self, name: str, value) -> None:
-        self.gauges[name] = value
+        # Locked like everything else: a bare dict store is GIL-atomic,
+        # but report()'s consistent snapshot needs writers excluded.
+        with self._lock:
+            self.gauges[name] = value
 
     def gauge_max(self, name: str, value) -> None:
         # High-water-mark gauge: read-max-store is a lost-update race
@@ -47,6 +176,12 @@ class Metrics:
         with self._lock:
             if value > self.gauges.get(name, value - 1):
                 self.gauges[name] = value
+
+    def observe(self, name: str, value) -> None:
+        """Record one sample into the named histogram (lock-exact:
+        concurrent observers never lose a count)."""
+        with self._lock:
+            self._hists[name].observe(value)
 
     @contextlib.contextmanager
     def span(self, name: str):
@@ -59,26 +194,91 @@ class Metrics:
                 s = self._spans[name]
                 s[0] += 1
                 s[1] += dt
+                # Spans FEED the histogram of the same name, under the
+                # same lock acquisition: histogram counts sum exactly
+                # to span counts, by construction, at any concurrency.
+                self._hists[name].observe(dt)
+                if self._events is not None:
+                    self._events.append(
+                        (name, t0, dt, threading.get_ident())
+                    )
+
+    # -- span events (Chrome-trace source) -----------------------------------
+
+    def enable_span_events(self, capacity: int = 200_000) -> None:
+        """Start recording one ``(name, t0, dur_s, tid)`` event per span
+        into a bounded ring (oldest dropped past ``capacity``).
+        Timestamps are ``perf_counter`` seconds — the same clock the
+        span aggregates use, so the exported trace lines up with spans
+        taken anywhere in the process."""
+        with self._lock:
+            self._events = deque(self._events or (), maxlen=int(capacity))
+
+    def disable_span_events(self) -> None:
+        with self._lock:
+            self._events = None
+
+    def span_events(self) -> list:
+        with self._lock:
+            return list(self._events or ())
+
+    # -- snapshots ------------------------------------------------------------
+
+    def _spans_locked(self) -> dict:
+        out = {}
+        for k, (c, t) in self._spans.items():
+            d = {
+                "count": c,
+                "total_s": t,
+                "mean_ms": (t / c * 1e3) if c else 0.0,
+            }
+            h = self._hists.get(k)
+            if h is not None and h.count:
+                d["p50_ms"] = h.quantile(0.50) * 1e3
+                d["p95_ms"] = h.quantile(0.95) * 1e3
+                d["p99_ms"] = h.quantile(0.99) * 1e3
+            out[k] = d
+        return out
 
     def spans(self) -> dict:
         with self._lock:
+            return self._spans_locked()
+
+    def histograms(self) -> dict:
+        with self._lock:
+            return {k: h.summary() for k, h in self._hists.items()}
+
+    def histogram_buckets(self) -> dict:
+        """``name -> (cumulative_buckets, count, sum)`` snapshot — the
+        raw-bucket view the Prometheus exporter renders."""
+        with self._lock:
             return {
-                k: {"count": c, "total_s": t, "mean_ms": (t / c * 1e3) if c else 0.0}
-                for k, (c, t) in self._spans.items()
+                k: (h.cumulative_buckets(), h.count, h.total)
+                for k, h in self._hists.items()
             }
 
     def report(self) -> dict:
-        return {
-            "counters": dict(self.counters),
-            "gauges": dict(self.gauges),
-            "spans": self.spans(),
-        }
+        # One lock acquisition for the WHOLE snapshot: counters, gauges,
+        # spans, and histograms are mutually consistent (no worker can
+        # bump a counter between the copies).
+        with self._lock:
+            return {
+                "counters": dict(self.counters),
+                "gauges": dict(self.gauges),
+                "spans": self._spans_locked(),
+                "histograms": {
+                    k: h.summary() for k, h in self._hists.items()
+                },
+            }
 
     def reset(self) -> None:
         with self._lock:
             self.counters.clear()
             self.gauges.clear()
             self._spans.clear()
+            self._hists.clear()
+            if self._events is not None:
+                self._events.clear()
 
 
 # Default process-wide registry (imports stay cheap; no jax dependency).

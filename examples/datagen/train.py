@@ -54,6 +54,18 @@ def main():
         help="driver loss-fetch cadence (steps) when --inflight > 0",
     )
     ap.add_argument(
+        "--metrics-port", type=int, default=None,
+        help="serve Prometheus text at http://127.0.0.1:PORT/metrics "
+        "while training (0 picks a free port; blendjax.obs.exporters) "
+        "and log a stall-doctor verdict every 10s (StatsReporter)",
+    )
+    ap.add_argument(
+        "--trace-export", default=None, metavar="PATH",
+        help="record pipeline span events and write a Chrome/Perfetto "
+        "trace JSON to PATH at exit (load in ui.perfetto.dev beside a "
+        "jax.profiler trace)",
+    )
+    ap.add_argument(
         "--augment", action="store_true",
         help="on-device color jitter inside the jitted step "
         "(blendjax.ops.augment; per-step deterministic keys). Only "
@@ -75,6 +87,21 @@ def main():
         make_train_state,
     )
 
+    # Observability (docs/observability.md): a live Prometheus scrape
+    # target + periodic doctor verdicts, and/or a Chrome-trace of the
+    # pipeline spans — torn down in the finally below.
+    exporter = reporter = None
+    if args.metrics_port is not None:
+        from blendjax.obs import StatsReporter, start_http_exporter
+
+        exporter = start_http_exporter(port=args.metrics_port)
+        print(f"metrics: http://127.0.0.1:{exporter.port}/metrics")
+        reporter = StatsReporter(interval_s=10.0).start()
+    if args.trace_export:
+        from blendjax.utils.metrics import metrics as _metrics
+
+        _metrics.enable_span_events()
+
     mesh = create_mesh({"data": -1})
     sharding = batch_sharding(mesh)
     h, w = args.shape
@@ -92,6 +119,7 @@ def main():
         augment = make_augment(color_jitter)
     chunk = args.chunk if args.encoding in ("tile", "pal") else 1
     use_driver = args.inflight > 0 and args.encoding in ("tile", "pal")
+    driver = None
     if use_driver:
         # Fused decode + async overlap: exactly one device dispatch per
         # step, up to --inflight of them outstanding, loss fetched every
@@ -152,39 +180,52 @@ def main():
 
     del jax  # device work happens inside the pipeline/step
 
-    if args.replay:
-        # Replays through the identical ingest -> decode path as live
-        # traffic (tile-delta recordings included), looping like epochs.
-        pipe = StreamDataPipeline.from_recording(
-            args.replay, batch_size=args.batch, sharding=sharding, loop=True,
-            chunk=chunk, emit_packed=use_driver,
-            allow_pickle=args.allow_pickle,
-        )
-        with pipe:
-            run_steps(iter(pipe))
-        return
+    try:
+        if args.replay:
+            # Replays through the identical ingest -> decode path as
+            # live traffic (tile-delta recordings included), looping
+            # like epochs.
+            pipe = StreamDataPipeline.from_recording(
+                args.replay, batch_size=args.batch, sharding=sharding,
+                loop=True, chunk=chunk, emit_packed=use_driver,
+                allow_pickle=args.allow_pickle,
+            )
+            with pipe:
+                run_steps(iter(pipe))
+            return
 
-    producer_args = ["--shape", str(h), str(w)]
-    if args.encoding in ("tile", "pal"):
-        producer_args += [
-            "--batch", str(args.batch), "--encoding", args.encoding,
-        ]
-    with PythonProducerLauncher(
-        script=__file__.replace("train.py", "cube_producer.py"),
-        num_instances=args.instances,
-        named_sockets=["DATA"],
-        seed=0,
-        instance_args=[producer_args] * args.instances,
-    ) as launcher:
-        with StreamDataPipeline(
-            launcher.addresses["DATA"],
-            batch_size=args.batch,
-            sharding=sharding,
-            chunk=chunk,
-            emit_packed=use_driver,
-            record_path_prefix=args.record,
-        ) as pipe:
-            run_steps(iter(pipe))
+        producer_args = ["--shape", str(h), str(w)]
+        if args.encoding in ("tile", "pal"):
+            producer_args += [
+                "--batch", str(args.batch), "--encoding", args.encoding,
+            ]
+        with PythonProducerLauncher(
+            script=__file__.replace("train.py", "cube_producer.py"),
+            num_instances=args.instances,
+            named_sockets=["DATA"],
+            seed=0,
+            instance_args=[producer_args] * args.instances,
+        ) as launcher:
+            with StreamDataPipeline(
+                launcher.addresses["DATA"],
+                batch_size=args.batch,
+                sharding=sharding,
+                chunk=chunk,
+                emit_packed=use_driver,
+                record_path_prefix=args.record,
+            ) as pipe:
+                run_steps(iter(pipe))
+                print(pipe.doctor(driver).render())
+    finally:
+        if reporter is not None:
+            reporter.stop()  # final tick logs the closing verdict
+        if exporter is not None:
+            exporter.close()
+        if args.trace_export:
+            from blendjax.obs import write_chrome_trace
+
+            n = write_chrome_trace(args.trace_export)
+            print(f"wrote {n} span events to {args.trace_export}")
 
 
 if __name__ == "__main__":

@@ -258,6 +258,85 @@ def test_bjx106_item_and_attribute_form():
     assert "item()" in got[0].message
 
 
+# -- BJX107 metric-name-cardinality -----------------------------------------
+
+METRIC_NAMES = """
+    from blendjax.utils.metrics import metrics
+
+    def consume(items):
+        for i, item in enumerate(items):
+            metrics.count(f"ingest.item{i}")
+            key = "ingest." + item["kind"]
+            metrics.count(key)
+            with metrics.span("ingest.consume.{}".format(item["kind"])):
+                pass
+"""
+
+
+def test_bjx107_flags_computed_names_in_hot_module():
+    got = findings(METRIC_NAMES, relpath="blendjax/data/pipeline.py")
+    assert [f.rule for f in got] == ["BJX107"] * 3
+    assert "f-string" in got[0].message
+    assert "variable 'key'" in got[1].message
+    assert "str.format()" in got[2].message
+
+
+def test_bjx107_marker_opts_a_module_in():
+    marked = "# bjx: hot-path\n" + textwrap.dedent(METRIC_NAMES)
+    got = analyze_source(marked, "anywhere.py")
+    assert [f.rule for f in got] == ["BJX107"] * 3
+    # the identical code outside a hot path is silent (cold-path
+    # cardinality is still a smell, but not this rule's gate)
+    assert rule_ids(METRIC_NAMES, relpath="blendjax/cold.py") == []
+
+
+def test_bjx107_negatives_constant_names_aliases_and_non_registry():
+    clean = """
+        from blendjax.utils.metrics import metrics as reg
+
+        def consume(items, results):
+            for item in items:
+                reg.count("ingest.items")
+                reg.gauge("ingest.queue_depth", len(items))
+                reg.observe(name="ingest.bytes", value=item["n"])
+                with reg.span("ingest.consume"):
+                    pass
+                # not a registry: same method names on another object
+                results.count(f"whatever.{item}")
+    """
+    assert rule_ids(clean, relpath="blendjax/data/pipeline.py") == []
+
+
+def test_bjx107_alias_import_and_duck_typed_registry_are_covered():
+    got = findings(
+        """
+        from blendjax.utils.metrics import metrics as reg
+
+        class Ingest:
+            def __init__(self, metrics):
+                self.metrics = metrics
+
+            def consume(self, key):
+                reg.count(f"a.{key}")
+                self.metrics.count("b." + key)
+        """,
+        relpath="blendjax/data/batcher.py",
+    )
+    assert [f.rule for f in got] == ["BJX107"] * 2
+
+
+def test_bjx107_inline_suppression():
+    src = """
+        from blendjax.utils.metrics import metrics
+
+        def per_shard(idx):
+            name = f"ingest.recv.shard{idx}"
+            with metrics.span(name):  # bjx: ignore[BJX107]
+                pass
+    """
+    assert rule_ids(src, relpath="blendjax/data/pipeline.py") == []
+
+
 # -- BJX103 unsafe-deserialization ------------------------------------------
 
 
@@ -646,6 +725,7 @@ def test_cli_exit_codes_and_json(tmp_path):
     assert ok.returncode == 0
     for rule_id in (
         "BJX101", "BJX102", "BJX103", "BJX104", "BJX105", "BJX106",
+        "BJX107",
     ):
         assert rule_id in ok.stdout
 
@@ -671,6 +751,7 @@ def test_syntax_error_reports_bjx000():
 def test_every_rule_registered():
     assert set(all_rules()) == {
         "BJX101", "BJX102", "BJX103", "BJX104", "BJX105", "BJX106",
+        "BJX107",
     }
 
 

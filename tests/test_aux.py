@@ -21,8 +21,13 @@ def test_metrics_counters_gauges_spans():
     assert rep["gauges"]["depth"] == 7
     assert rep["spans"]["work"]["count"] == 1
     assert rep["spans"]["work"]["mean_ms"] >= 5
+    # spans feed same-name histograms: count parity is structural
+    assert rep["histograms"]["work"]["count"] == 1
+    assert rep["spans"]["work"]["p95_ms"] >= 5
     m.reset()
-    assert m.report() == {"counters": {}, "gauges": {}, "spans": {}}
+    assert m.report() == {
+        "counters": {}, "gauges": {}, "spans": {}, "histograms": {},
+    }
 
 
 def test_ingest_populates_default_metrics():

@@ -1,0 +1,639 @@
+"""blendjax.obs: histogram exactness, frame lineage, the stall doctor,
+and the exporters (Prometheus / JSONL / Chrome trace)."""
+
+import json
+import re
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from blendjax.obs import (
+    VERDICTS,
+    JsonlExporter,
+    StatsReporter,
+    chrome_trace,
+    diagnose,
+    prometheus_text,
+    start_http_exporter,
+    write_chrome_trace,
+)
+from blendjax.obs.lineage import (
+    PUB_MONO_KEY,
+    PUB_WALL_KEY,
+    SEQ_KEY,
+    FrameLineage,
+    strip_stamps,
+)
+from blendjax.utils.metrics import Histogram, Metrics
+
+WILD = "tcp://127.0.0.1:*"
+
+
+# -- histograms --------------------------------------------------------------
+
+
+def test_histogram_quantiles_within_bucket_resolution():
+    h = Histogram()
+    vals = np.linspace(0.001, 10.0, 5000)
+    for v in vals:
+        h.observe(v)
+    s = h.summary()
+    assert s["count"] == 5000
+    assert s["min"] == pytest.approx(0.001)
+    assert s["max"] == pytest.approx(10.0)
+    # log-bucket midpoint estimate: within ~4.5% relative error
+    for q, true in ((0.5, np.quantile(vals, 0.5)),
+                    (0.95, np.quantile(vals, 0.95)),
+                    (0.99, np.quantile(vals, 0.99))):
+        assert h.quantile(q) == pytest.approx(true, rel=0.05)
+
+
+def test_histogram_nonpositive_values_sort_below_everything():
+    h = Histogram()
+    for v in (-0.5, 0.0, 1.0, 2.0, 4.0):
+        h.observe(v)
+    assert h.count == 5
+    assert h.zeros == 2
+    assert h.quantile(0.0) == -0.5  # exact min preserved
+    assert h.quantile(1.0) == 4.0
+    assert h.quantile(0.5) == pytest.approx(1.0, rel=0.05)
+
+
+def test_histogram_exact_counts_under_concurrent_observe():
+    """Lock-exactness: N threads x M observes never lose a count, and
+    the bucket counts sum exactly to the observe calls."""
+    m = Metrics()
+    threads_n, per_thread = 8, 2000
+
+    def work(seed):
+        rng = np.random.default_rng(seed)
+        for _ in range(per_thread):
+            m.observe("conc", float(rng.random()) + 1e-6)
+
+    ts = [threading.Thread(target=work, args=(i,)) for i in range(threads_n)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    s = m.histograms()["conc"]
+    assert s["count"] == threads_n * per_thread
+    buckets = m.histogram_buckets()["conc"]
+    cum, count, _ = buckets
+    assert cum[-1][1] == count == threads_n * per_thread
+
+
+def test_span_and_histogram_counts_stay_in_lockstep_concurrently():
+    """Spans feed same-name histograms under ONE lock acquisition:
+    histogram count == span count at any concurrency (the bench
+    acceptance check, hermetic version)."""
+    m = Metrics()
+
+    def work():
+        for _ in range(500):
+            with m.span("s"):
+                pass
+
+    ts = [threading.Thread(target=work) for _ in range(6)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert m.spans()["s"]["count"] == 3000
+    assert m.histograms()["s"]["count"] == 3000
+    assert "p99_ms" in m.spans()["s"]
+
+
+def test_report_is_a_consistent_snapshot_under_gauge_churn():
+    """gauge()/report() both take the registry lock (the PR-4 fix for
+    'dictionary changed size during iteration')."""
+    m = Metrics()
+    stop = threading.Event()
+
+    def churn():
+        i = 0
+        while not stop.is_set():
+            m.gauge(f"g{i % 997}", i)
+            i += 1
+
+    ts = [threading.Thread(target=churn) for _ in range(4)]
+    for t in ts:
+        t.start()
+    try:
+        for _ in range(200):
+            m.report()  # raced the writers before the lock
+    finally:
+        stop.set()
+        for t in ts:
+            t.join()
+
+
+# -- frame lineage -----------------------------------------------------------
+
+
+def _stamped(btid, seq, age_s=0.0, **extra):
+    return {
+        "btid": btid,
+        SEQ_KEY: seq,
+        PUB_WALL_KEY: time.time() - age_s,
+        PUB_MONO_KEY: time.monotonic() - age_s,
+        **extra,
+    }
+
+
+def test_lineage_pops_stamps_and_tracks_staleness():
+    ln = FrameLineage()
+    msg = _stamped(3, 0, age_s=0.5, image=np.zeros(2))
+    ln.ingest(msg)
+    assert SEQ_KEY not in msg and PUB_WALL_KEY not in msg
+    assert "image" in msg  # payload untouched
+    rep = ln.report()["3"]
+    assert rep["received"] == 1
+    assert rep["seq_gaps"] == 0
+    assert rep["e2e_staleness_ms"]["p95"] == pytest.approx(500, rel=0.1)
+    assert ln.staleness_p95_s() == pytest.approx(0.5, rel=0.1)
+
+
+def test_lineage_gap_and_reorder_accounting_is_exact():
+    ln = FrameLineage()
+    for seq in (0, 1, 4, 3, 5):  # drop 2+one-of(3,4)=gap 2, then reorder
+        ln.ingest(_stamped(7, seq))
+    rep = ln.report()["7"]
+    assert rep["seq_gaps"] == 2
+    assert rep["seq_reorders"] == 1
+    assert rep["last_seq"] == 5
+    assert ln.total_gaps() == 2
+
+
+def test_lineage_producer_respawn_resets_tracking_not_reorder_storm():
+    """A respawned producer (launcher reuses the btid, fresh publisher
+    numbers from 0) must read as a RESTART: zero reorders, and drop
+    detection works immediately in the new incarnation."""
+    ln = FrameLineage()
+    for seq in range(100):
+        ln.ingest(_stamped(5, seq))
+    # respawn: seq restarts at 0, then a real drop (skip seq 2)
+    for seq in (0, 1, 3, 4):
+        ln.ingest(_stamped(5, seq))
+    rep = ln.report()["5"]
+    assert rep["restarts"] == 1
+    assert rep["seq_reorders"] == 0  # no post-respawn reorder storm
+    assert rep["seq_gaps"] == 1      # the real drop, flagged at once
+    assert rep["last_seq"] == 4
+
+
+def test_lineage_interleaved_producers_are_not_gaps():
+    """Round-robin interleave of independent producers (what the
+    sharded pool's fan-in looks like) must count ZERO gaps: tracking is
+    per producer."""
+    ln = FrameLineage()
+    for seq in range(20):
+        for btid in (0, 1, 2):
+            ln.ingest(_stamped(btid, seq))
+    assert ln.total_gaps() == 0
+    for btid in ("0", "1", "2"):
+        assert ln.report()[btid]["seq_gaps"] == 0
+
+
+def test_lineage_unstamped_messages_pass_through():
+    ln = FrameLineage()
+    msg = {"btid": 0, "image": np.zeros(2)}
+    ln.ingest(msg)
+    assert ln.report() == {}
+
+
+def test_lineage_telemetry_fleet_view():
+    ln = FrameLineage()
+    ln.ingest(_stamped(0, 0, _telemetry={"seq": 0, "mps": 12.5,
+                                         "spans": {}, "counters": {}}))
+    rep = ln.report()["0"]
+    assert rep["telemetry"]["mps"] == 12.5
+    assert rep["telemetry_age_s"] >= 0.0
+
+
+def test_strip_stamps_for_replay():
+    msg = _stamped(0, 3, _telemetry={})
+    out = strip_stamps(msg)
+    assert out is msg
+    assert set(msg) == {"btid"}
+
+
+# -- stamps over a real socket ----------------------------------------------
+
+
+def test_publisher_stamps_and_stream_accounts_them():
+    """DataPublisherSocket stamps -> RemoteStream pops + accounts; the
+    consumer-visible items carry NO stamp keys, and the process-wide
+    lineage sees exact per-producer sequence accounting."""
+    from blendjax.data.stream import RemoteStream
+    from blendjax.obs.lineage import lineage
+    from blendjax.transport import DataPublisherSocket
+    from blendjax.utils.metrics import metrics
+
+    metrics.reset()
+    lineage.reset()
+    pub = DataPublisherSocket(WILD, btid=11, telemetry_every=2)
+    stream = RemoteStream([pub.addr], timeoutms=5000, max_items=5)
+    t = threading.Thread(
+        target=lambda: [
+            pub.publish(image=np.zeros((4, 4), np.uint8), frameid=i)
+            for i in range(5)
+        ],
+        daemon=True,
+    )
+    t.start()
+    items = list(stream)
+    t.join(timeout=5)
+    pub.close()
+    assert len(items) == 5
+    for it in items:
+        assert SEQ_KEY not in it and PUB_WALL_KEY not in it
+        assert "_telemetry" not in it
+    rep = lineage.report()["11"]
+    assert rep["received"] == 5
+    assert rep["last_seq"] == 4
+    assert rep["seq_gaps"] == 0
+    # telemetry_every=2: snapshots piggybacked on seq 0/2/4 — latest won
+    assert rep["telemetry"]["seq"] in (2, 4)
+    assert metrics.counters.get("wire.seq_gaps", 0) == 0
+    assert metrics.histograms()["wire.e2e_staleness_s"]["count"] == 5
+
+
+def test_sharded_ingest_partitions_do_not_fake_gaps_but_real_gaps_flag():
+    """Two producers partitioned across two shard workers: the
+    round-robin interleave counts zero gaps; a producer that SKIPS a
+    seq (simulated drop) is flagged with the exact gap size."""
+    from blendjax.data.shard_ingest import ShardedHostIngest
+    from blendjax.data.stream import RemoteStream, partition_addresses
+    from blendjax.obs.lineage import lineage
+    from blendjax.transport import DataPublisherSocket
+    from blendjax.utils.metrics import metrics
+
+    metrics.reset()
+    lineage.reset()
+    pubs = [
+        DataPublisherSocket(WILD, btid=i, telemetry_every=0)
+        for i in range(2)
+    ]
+    n = 8
+
+    def feed(pub, skip=None):
+        for i in range(n):
+            if i == skip:
+                pub._seq += 1  # simulate a dropped message: seq skips
+                continue
+            pub.publish(image=np.full((2, 2), pub.btid, np.uint8),
+                        frameid=i)
+
+    shards = partition_addresses([p.addr for p in pubs], 2)
+    assert len(shards) == 2
+    streams = [
+        # track_gaps=True: shards see DISJOINT producer subsets, so gap
+        # accounting is sound despite the worker slot (what the
+        # pipeline's shard_stream passes).
+        RemoteStream(s, timeoutms=5000, worker_index=i, num_workers=2,
+                     track_gaps=True)
+        for i, s in enumerate(shards)
+    ]
+    ingest = ShardedHostIngest(
+        streams, batch_size=2, max_messages=2 * n - 1
+    )
+    threads = [
+        threading.Thread(target=feed, args=(pubs[0],), daemon=True),
+        threading.Thread(target=feed, args=(pubs[1], 3), daemon=True),
+    ]
+    for t in threads:
+        t.start()
+    batches = list(ingest)
+    for t in threads:
+        t.join(timeout=5)
+    for p in pubs:
+        p.close()
+    assert sum(len(b["_meta"]) for b in batches) >= 2 * n - 4
+    rep = lineage.report()
+    assert rep["0"]["seq_gaps"] == 0  # clean producer: no false gaps
+    assert rep["1"]["seq_gaps"] == 1  # the simulated drop, exactly
+    assert metrics.counters.get("wire.seq_gaps", 0) == 1
+
+
+def test_shared_fanin_consumers_do_not_fake_gaps():
+    """Two consumers splitting ONE producer fan-in (DataLoader-worker
+    shape: same addresses, num_workers=2) each see a strided
+    subsequence — the auto track_gaps default must count ZERO gaps,
+    while staleness accounting stays on."""
+    from blendjax.data.stream import RemoteStream
+    from blendjax.obs.lineage import lineage
+    from blendjax.transport import DataPublisherSocket
+    from blendjax.utils.metrics import metrics
+
+    metrics.reset()
+    lineage.reset()
+    pub = DataPublisherSocket(WILD, btid=4, telemetry_every=0)
+    streams = [
+        RemoteStream([pub.addr], timeoutms=5000, worker_index=i,
+                     num_workers=2, max_items=16)
+        for i in range(2)
+    ]
+    assert all(not s.track_gaps for s in streams)
+
+    def drain(s, out):
+        out.extend(s)
+
+    outs: list = [[], []]
+    ts = [
+        threading.Thread(target=drain, args=(s, o), daemon=True)
+        for s, o in zip(streams, outs)
+    ]
+    for t in ts:
+        t.start()
+    time.sleep(0.3)  # both PULL peers connected before publishing
+    for i in range(16):
+        pub.publish(image=np.zeros((2, 2), np.uint8), frameid=i)
+    for t in ts:
+        t.join(timeout=10)
+    pub.close()
+    assert sum(len(o) for o in outs) == 16
+    rep = lineage.report()["4"]
+    assert rep["seq_gaps"] == 0 and rep["seq_reorders"] == 0
+    assert rep["e2e_staleness_ms"]["count"] == 16  # staleness stays on
+    assert metrics.counters.get("wire.seq_gaps", 0) == 0
+
+
+def test_nonfinite_staleness_stamp_does_not_kill_ingest():
+    """A corrupted producer clock (NaN/inf _pub_wall) must not raise
+    out of lineage.ingest and kill the receive loop."""
+    ln = FrameLineage()
+    for wall in (float("nan"), float("inf"), float("-inf")):
+        ln.ingest({"btid": 9, SEQ_KEY: 0, PUB_WALL_KEY: wall,
+                   PUB_MONO_KEY: 0.0})  # staleness = now - wall = ±inf/nan
+    h = Histogram()
+    h.observe(float("nan"))
+    h.observe(float("inf"))
+    h.observe(1.0)
+    s = h.summary()
+    assert s["count"] == 1  # finite sample only
+    assert s["nonfinite"] == 2
+    assert h.quantile(0.5) == 1.0
+
+
+# -- stall doctor ------------------------------------------------------------
+
+
+def _report(spans=None, counters=None, gauges=None):
+    return {
+        "spans": {
+            k: {"count": 10, "total_s": v} for k, v in (spans or {}).items()
+        },
+        "counters": counters or {},
+        "gauges": gauges or {},
+        "histograms": {},
+    }
+
+
+def test_doctor_step_bound_on_backpressure():
+    v = diagnose(_report(
+        spans={"ingest.recv": 1.0, "ingest.queue_wait": 0.1,
+               "train.dispatch": 8.0},
+        counters={"ingest.queue_full_waits": 40},
+    ))
+    assert v.kind == "step-bound"
+    assert "queue_full_waits=40" in v.reason
+
+
+def test_doctor_step_bound_on_driver_ring():
+    v = diagnose(
+        _report(spans={"train.dispatch": 1.0, "driver.ring_wait": 4.0}),
+        driver={"host_blocks": 25},
+    )
+    assert v.kind == "step-bound"
+
+
+def test_doctor_feed_bound():
+    v = diagnose(_report(
+        spans={"feed.throttle_wait": 5.0, "feed.place": 1.0,
+               "train.dispatch": 2.0},
+        counters={"feed.throttle_blocks": 17},
+    ))
+    assert v.kind == "feed-bound"
+    assert "throttle_blocks=17" in v.reason
+
+
+def test_doctor_decode_bound():
+    v = diagnose(_report(
+        spans={"decode.dispatch": 6.0, "train.dispatch": 2.0,
+               "ingest.queue_wait": 1.0},
+    ))
+    assert v.kind == "decode-bound"
+
+
+def test_doctor_step_bound_on_pinned_queue_depth_gauge():
+    """queue_depth_hwm pinned at the prefetch bound is backpressure
+    evidence even before a queue_full_wait is ever counted."""
+    v = diagnose(
+        _report(
+            spans={"train.dispatch": 5.0, "ingest.queue_wait": 0.1},
+            gauges={"ingest.queue_depth_hwm": 2},
+        ),
+        prefetch=2,
+    )
+    assert v.kind == "step-bound"
+    assert "queue_depth_hwm=2" in v.reason
+
+
+def test_doctor_sharded_recv_time_does_not_fake_starvation():
+    """N shard workers parked in recv bank ~N x wall of ingest.recv*
+    span time concurrently; that must not classify a healthy run as
+    starving when the consumer itself never waits on the queue."""
+    v = diagnose(_report(spans={
+        "ingest.recv.shard0": 2.0, "ingest.recv.shard1": 2.0,
+        "ingest.recv.shard2": 2.0, "ingest.recv.shard3": 2.0,
+        "ingest.queue_wait": 0.1, "train.dispatch": 2.0,
+        "feed.place": 1.0,
+    }))
+    assert v.kind == "balanced"
+
+
+def test_doctor_wire_vs_producer_bound_split_on_staleness():
+    starving = _report(
+        spans={"ingest.queue_wait": 6.0, "ingest.recv": 2.0,
+               "train.dispatch": 1.0},
+    )
+    stale_lineage = {
+        "0": {"e2e_staleness_ms": {"count": 50, "p95": 900.0}},
+    }
+    fresh_lineage = {
+        "0": {"e2e_staleness_ms": {"count": 50, "p95": 8.0}},
+    }
+    assert diagnose(starving, lineage=stale_lineage).kind == "wire-bound"
+    assert diagnose(starving, lineage=fresh_lineage).kind == "producer-bound"
+    # no lineage at all: still a verdict (producer-bound, "unstamped")
+    v = diagnose(starving)
+    assert v.kind == "producer-bound"
+    assert "unstamped" in v.reason
+
+
+def test_doctor_balanced_and_idle_and_render_shape():
+    assert diagnose(_report()).kind == "idle"
+    v = diagnose(_report(spans={
+        "ingest.recv": 1.0, "ingest.queue_wait": 1.0, "feed.place": 1.0,
+        "decode.dispatch": 1.0, "train.dispatch": 1.0,
+    }))
+    assert v.kind == "balanced"
+    line = v.render()
+    assert line.startswith("doctor: balanced — ") and "\n" not in line
+    assert all(k in VERDICTS for k in (
+        "step-bound", "feed-bound", "decode-bound", "wire-bound",
+        "producer-bound", v.kind, "idle",
+    ))
+
+
+# -- exporters ---------------------------------------------------------------
+
+_PROM_SAMPLE = re.compile(
+    r"^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^{}]*\})? "
+    r"[-+]?[0-9]+(\.[0-9]+)?([eE][-+]?[0-9]+)?$"
+)
+
+
+def _filled_registry():
+    m = Metrics()
+    m.count("wire.raw_bytes", 1024)
+    m.count("ingest.items", 7)
+    m.gauge("ingest.queue_depth", 2)
+    for v in (0.001, 0.002, 0.004, 0.02):
+        m.observe("ingest.recv", v)
+    with m.span("feed.place"):
+        pass
+    return m
+
+
+def test_prometheus_text_is_well_formed():
+    m = _filled_registry()
+    lineage_report = {
+        str(b): {"received": 7, "seq_gaps": 0, "seq_reorders": 0,
+                 "restarts": 0,
+                 "e2e_staleness_ms": {"count": 7, "p50": 3.0, "p95": 9.0,
+                                      "p99": 12.0}}
+        for b in (0, 1)
+    }
+    text = prometheus_text(report=m.report(), lineage_report=lineage_report,
+                           registry=m)
+    assert text.endswith("\n")
+    for line in text.strip().splitlines():
+        if line.startswith("#"):
+            assert re.match(r"^# TYPE [a-zA-Z_:][a-zA-Z0-9_:]* "
+                            r"(counter|gauge|histogram|summary)$", line), line
+        else:
+            assert _PROM_SAMPLE.match(line), line
+    # exposition grouping: all samples of one metric name are ONE
+    # contiguous block (multi-producer pages are rejected by strict
+    # parsers otherwise)
+    names, last = [], None
+    for line in text.strip().splitlines():
+        if line.startswith("#"):
+            continue
+        name = line.split("{", 1)[0].split(" ", 1)[0]
+        if name != last:
+            names.append(name)
+            last = name
+    assert len(names) == len(set(names)), names
+    # histogram invariants: cumulative buckets monotone, +Inf == count
+    assert 'blendjax_ingest_recv_bucket{le="+Inf"} 4' in text
+    cums = [
+        int(line.rsplit(" ", 1)[1])
+        for line in text.splitlines()
+        if line.startswith("blendjax_ingest_recv_bucket")
+    ]
+    assert cums == sorted(cums)
+    assert "blendjax_wire_raw_bytes_total 1024" in text
+    assert 'blendjax_producer_e2e_staleness_ms{btid="0",quantile="0.95"} 9.0' in text
+    assert 'blendjax_producer_seq_gaps_total{btid="1"} 0' in text
+
+
+def test_http_exporter_serves_live_registry():
+    m = _filled_registry()
+    srv = start_http_exporter(port=0, registry=m)
+    try:
+        url = f"http://127.0.0.1:{srv.port}/metrics"
+        with urllib.request.urlopen(url, timeout=5) as resp:
+            assert resp.status == 200
+            assert resp.headers["Content-Type"].startswith("text/plain")
+            body = resp.read().decode()
+        assert "blendjax_ingest_items_total 7" in body
+        # a second scrape sees fresh state
+        m.count("ingest.items", 1)
+        with urllib.request.urlopen(url, timeout=5) as resp:
+            assert "blendjax_ingest_items_total 8" in resp.read().decode()
+    finally:
+        srv.close()
+
+
+def test_jsonl_exporter_appends_parseable_lines(tmp_path):
+    path = str(tmp_path / "snapshots.jsonl")
+    ex = JsonlExporter(path)
+    m = _filled_registry()
+    ex.write(m.report())
+    ex.write(m.report(), extra={"doctor": {"kind": "balanced"}})
+    lines = open(path).read().strip().splitlines()
+    assert len(lines) == 2
+    for line in lines:
+        rec = json.loads(line)
+        assert rec["t"] > 0
+        assert rec["report"]["counters"]["ingest.items"] == 7
+    assert json.loads(lines[1])["doctor"]["kind"] == "balanced"
+
+
+def test_chrome_trace_export_well_formed(tmp_path):
+    m = Metrics()
+    m.enable_span_events()
+    with m.span("ingest.recv"):
+        time.sleep(0.001)
+    with m.span("feed.place"):
+        pass
+    obj = chrome_trace(registry=m)
+    assert set(obj) == {"traceEvents", "displayTimeUnit"}
+    assert len(obj["traceEvents"]) == 2
+    for ev in obj["traceEvents"]:
+        assert ev["ph"] == "X"
+        assert set(ev) >= {"name", "cat", "ts", "dur", "pid", "tid"}
+        assert ev["dur"] >= 0
+    path = str(tmp_path / "trace.json")
+    assert write_chrome_trace(path, registry=m) == 2
+    loaded = json.load(open(path))
+    assert loaded["traceEvents"][0]["name"] in ("ingest.recv", "feed.place")
+    # events ring respects capacity and disable
+    m.disable_span_events()
+    with m.span("x"):
+        pass
+    assert len(m.span_events()) == 0
+
+
+# -- stats reporter ----------------------------------------------------------
+
+
+def test_stats_reporter_tick_logs_verdict_and_archives(tmp_path):
+    m = _filled_registry()
+    ln = FrameLineage()
+    path = str(tmp_path / "stats.jsonl")
+    rep = StatsReporter(
+        interval_s=3600, registry=m, lineage=ln, jsonl_path=path,
+        driver_stats=lambda: {"host_blocks": 0},
+    )
+    v = rep.tick()
+    assert v.kind in VERDICTS
+    assert rep.last_verdict is v
+    rec = json.loads(open(path).read().strip())
+    assert rec["doctor"]["kind"] == v.kind
+    assert "lineage" in rec
+
+
+def test_stats_reporter_thread_lifecycle(tmp_path):
+    m = _filled_registry()
+    rep = StatsReporter(interval_s=0.05, registry=m,
+                        lineage=FrameLineage())
+    rep.start()
+    time.sleep(0.2)
+    rep.stop()
+    assert rep.last_verdict is not None
