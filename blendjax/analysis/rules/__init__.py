@@ -8,6 +8,7 @@ from blendjax.analysis.rules import (  # noqa: F401  (registration side effects)
     hotpath,
     metric_names,
     purity,
+    reservoir_sync,
     resource_leak,
     zmq_affinity,
 )

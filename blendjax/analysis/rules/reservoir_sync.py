@@ -1,0 +1,165 @@
+"""BJX108 reservoir-host-materialization: host fetch of reservoir
+contents in a driver hot path.
+
+The data-echoing reservoir (``blendjax/data/echo.py``) exists so a
+producer-bound pipeline can emit batches at the STEP rate with zero
+host round trips: ``insert`` is a donated jitted scatter, ``sample`` a
+jitted gather, and all echo accounting (budgets, ages, fresh-vs-echoed
+counters) runs against the HOST-chosen index vector — never against
+the device values. One ``np.asarray()``/``.item()``/``float()``/
+``jax.device_get()``/``block_until_ready()`` on an object returned by
+reservoir ``sample``/``insert``/``gather`` re-serializes the whole
+loop on a device fetch per step, exactly the dispatch-wait-dispatch
+regime the echo subsystem was built to avoid.
+
+Scope matches BJX106: modules opting in with the ``bjx:
+driver-hot-path`` marker comment (plus any ``driver.py``). Reservoir
+calls are recognized two ways — by receiver name (any dotted segment
+containing ``reservoir``, e.g. ``self.reservoir.sample(...)``) and by
+dataflow from a ``SampleReservoir(...)`` construction in the same
+function. Both the direct-nesting form
+(``np.asarray(res.sample(idx))``) and the assign-then-fetch form are
+flagged; host operations on independently HOST-chosen indices (the
+sanctioned accounting pattern) are not, because those values never
+came from a reservoir call.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from blendjax.analysis.core import (
+    Finding,
+    ModuleContext,
+    Rule,
+    register,
+    walk_shallow,
+)
+from blendjax.analysis.rules.driver_sync import _is_driver_hot, _names
+
+RESERVOIR_METHODS = {"sample", "insert", "gather"}
+HOST_CASTS = {"float", "int"}
+HOST_ARRAY_FETCHES = {
+    "numpy.asarray",
+    "numpy.array",
+    "numpy.ascontiguousarray",
+    "jax.device_get",
+}
+
+
+def _receiver_is_reservoir(
+    node: ast.Call, reservoir_names: set[str], module: ModuleContext
+) -> bool:
+    """True when ``node`` is a ``sample``/``insert``/``gather`` call on
+    something that looks like a reservoir."""
+    func = node.func
+    if not isinstance(func, ast.Attribute):
+        return False
+    if func.attr not in RESERVOIR_METHODS:
+        return False
+    recv = func.value
+    dotted = module.resolve(recv) or ""
+    if any("reservoir" in part.lower() for part in dotted.split(".")):
+        return True
+    return bool(_names(recv) & reservoir_names)
+
+
+def _is_host_fetch(
+    node: ast.Call, module: ModuleContext
+) -> tuple[str | None, set[str], list[ast.AST]]:
+    """``(form, synced-names, arg-subtrees)`` when ``node`` is a host
+    materialization call, else ``(None, set(), [])``."""
+    func = node.func
+    if isinstance(func, ast.Attribute) and func.attr in (
+        "block_until_ready", "item"
+    ):
+        target = node.args[0] if node.args else func.value
+        return f"{func.attr}()", _names(target), [target]
+    resolved = module.resolve(func) or ""
+    if (
+        resolved in HOST_ARRAY_FETCHES
+        or resolved in HOST_CASTS
+        or resolved.endswith(".block_until_ready")
+    ) and node.args:
+        return f"{resolved}()", _names(node.args[0]), [node.args[0]]
+    return None, set(), []
+
+
+@register
+class ReservoirHostMaterializationRule(Rule):
+    id = "BJX108"
+    name = "reservoir-host-materialization"
+    description = (
+        "host materialization (np.asarray/.item()/float/device_get/"
+        "block_until_ready) of a reservoir sample/insert result in a "
+        "driver hot path"
+    )
+
+    def check(self, module: ModuleContext) -> Iterator[Finding]:
+        if not _is_driver_hot(module):
+            return
+        for qual, fn, _cls in module.iter_functions():
+            yield from self._scan_function(module, fn, qual)
+
+    def _scan_function(
+        self, module: ModuleContext, fn: ast.AST, qual: str
+    ) -> Iterator[Finding]:
+        nodes = list(walk_shallow(fn))
+        # Names bound from SampleReservoir(...) constructions extend
+        # the receiver heuristic to arbitrarily-named locals.
+        reservoir_names: set[str] = set()
+        for node in nodes:
+            if isinstance(node, ast.Assign) and isinstance(
+                node.value, ast.Call
+            ):
+                resolved = module.resolve(node.value.func) or ""
+                if resolved.endswith("SampleReservoir"):
+                    for target in node.targets:
+                        reservoir_names |= _names(target)
+        # Names bound from reservoir sample/insert/gather calls, keyed
+        # by first-assignment line (a fetch above the assignment reads
+        # an unrelated earlier value).
+        tainted: dict[str, int] = {}
+        for node in nodes:
+            if isinstance(node, ast.Assign) and isinstance(
+                node.value, ast.Call
+            ) and _receiver_is_reservoir(
+                node.value, reservoir_names, module
+            ):
+                for target in node.targets:
+                    for name in _names(target):
+                        line = getattr(node, "lineno", 0)
+                        if name not in tainted or line < tainted[name]:
+                            tainted[name] = line
+        for node in nodes:
+            if not isinstance(node, ast.Call):
+                continue
+            form, synced, subtrees = _is_host_fetch(node, module)
+            if form is None:
+                continue
+            # direct nesting: np.asarray(res.sample(idx))
+            nested = any(
+                isinstance(inner, ast.Call)
+                and _receiver_is_reservoir(inner, reservoir_names, module)
+                for tree in subtrees
+                for inner in ast.walk(tree)
+            )
+            hit = sorted(
+                name for name in synced
+                if name in tainted
+                and getattr(node, "lineno", 0) >= tainted[name]
+            )
+            if nested or hit:
+                what = (
+                    f"'{hit[0]}'" if hit
+                    else "a reservoir sample/insert call"
+                )
+                yield self.finding(
+                    module,
+                    node,
+                    f"{form} on reservoir contents ({what}) in driver "
+                    f"hot path '{qual}' forces a device fetch per draw — "
+                    "keep echo accounting on the host-chosen index "
+                    "vector and let the batch stay on device",
+                )

@@ -40,6 +40,11 @@ from blendjax.data.pipeline import (
     StreamDataPipeline,
     TileStreamDecoder,
 )
+from blendjax.data.echo import (
+    EchoingPipeline,
+    SampleReservoir,
+    default_echo_augment,
+)
 
 __all__ = [
     "StreamSchema",
@@ -54,6 +59,9 @@ __all__ = [
     "DeviceFeeder",
     "StreamDataPipeline",
     "TileStreamDecoder",
+    "EchoingPipeline",
+    "SampleReservoir",
+    "default_echo_augment",
     "FileRecorder",
     "FileReader",
     "LegacyBtrReader",

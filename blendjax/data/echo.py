@@ -1,0 +1,706 @@
+"""Data echoing: a device-resident sample reservoir + on-device
+re-augmentation, for producer-bound pipelines.
+
+BENCH_r05 measured the live pipeline cleanly **producer-bound**:
+``mfu_live`` 0.0085 vs ``mfu_step_alone`` 0.4724 — two Blender
+instances render ~11 img/s while the fused step could consume ~1700.
+Ingest and dispatch are already near-free (PR 2/3), so the remaining
+lever is *reusing* each rendered frame several times per arrival —
+**data echoing** (Choi et al., "Faster Neural Network Training with
+Data Echoing", 2020) — with fresh on-device random augmentation per
+draw so the repeats are decorrelated. This is the supervised analogue
+of the RL replay buffer the gym side of the reference implies.
+
+Two pieces:
+
+- :class:`SampleReservoir` — the last ``capacity`` decoded samples as
+  a preallocated pytree ring ON DEVICE. ``insert`` is a jitted donated
+  in-place scatter (stable buffers, no per-step reallocation, no host
+  round trips); ``sample`` is a jitted gather that fuses the optional
+  augmentation chain into the same dispatch. Draw indices are chosen
+  on the HOST (a numpy RNG) so echo accounting — budgets, age
+  histograms, fresh-vs-echoed counters — needs zero device syncs
+  (bjx-lint BJX108 enforces that property on this module).
+- :class:`EchoingPipeline` — wraps a decoded :class:`StreamDataPipeline`
+  (or any batch-dict iterable) and yields train batches at the *step*
+  rate: a background thread drains the inner pipeline into the
+  reservoir as frames arrive; each step draws a batch by jitted gather
+  + augmentation, and never blocks while the echo budget
+  (``max_echo_factor`` per sample, ``min_fresh_fraction`` per batch)
+  has headroom. When the budget is exhausted the draw loop blocks for
+  fresh frames — the **echo-saturated** condition the stall doctor
+  reports (raise producers or capacity).
+
+Composes with :class:`blendjax.train.TrainDriver`: the reservoir's
+insert/gather dispatches ride the data layer (like ``device_put``),
+so the driver still issues exactly ONE train dispatch per step
+(``dispatch_per_step == 1.0``, CI-asserted in the bench ``live_echo``
+row). See docs/performance.md "Echoing past a producer-bound
+pipeline" for when to raise the echo factor vs spawn more producers.
+"""
+
+from __future__ import annotations
+
+# bjx: driver-hot-path (BJX106/BJX108: no same-iteration host syncs, no
+# host materialization of reservoir sample/insert results — accounting
+# runs on host-chosen indices instead)
+
+import math
+import queue
+import threading
+import time
+
+import numpy as np
+
+from blendjax.utils.logging import get_logger
+from blendjax.utils.metrics import metrics
+
+logger = get_logger("data")
+
+
+def _require_jax():
+    import jax  # deferred: producer processes never import jax
+
+    return jax
+
+
+class SampleReservoir:
+    """Device-resident ring of the last ``capacity`` samples.
+
+    Storage is one preallocated array per field, leading dim
+    ``capacity``, allocated from the first inserted batch's structure.
+    ``insert`` writes a batch of B rows at ``(cursor + arange(B)) %
+    capacity`` through a jitted scatter whose buffer arguments are
+    DONATED — XLA updates in place, so the device allocation is made
+    once and its buffer stays stable across the run (no per-step
+    reallocation; ``tests/test_echo.py`` pins the buffer pointer).
+    ``sample(idx)`` gathers rows by a host-chosen index vector and
+    applies the optional ``augment`` chain INSIDE the same jit, keyed
+    by a per-draw fold of ``rng`` with an internal draw counter — so
+    two draws of the same slot decorrelate while staying deterministic
+    and resumable.
+
+    Neither operation reads a device value back to the host: cursor,
+    size, and draw-counter bookkeeping are host integers, and the
+    caller keeps per-slot accounting against the host-side indices
+    this class hands out (the BJX108 invariant).
+
+    ``augment`` is ``fn(rng, batch_dict) -> batch_dict`` over the
+    gathered fields — build one with
+    :func:`blendjax.ops.augment.make_batch_augment`, which pairs
+    geometric image ops with their point/label transforms so echoed
+    labels stay consistent with echoed images.
+    """
+
+    def __init__(self, capacity: int, augment=None, rng=0):
+        self.capacity = int(capacity)
+        if self.capacity < 1:
+            raise ValueError(f"capacity must be >= 1, got {capacity}")
+        self.augment = augment
+        self._rng_seed = rng
+        self._buffers: dict | None = None
+        self._spec: dict | None = None  # field -> (shape, dtype)
+        self._insert_fn = None
+        self._draw_fn = None
+        self._cursor = 0
+        self.size = 0  # filled slots (== capacity once wrapped)
+        self.inserts = 0  # samples inserted, lifetime
+        self._draws = 0  # draw counter folded into the augment key
+
+    # -- lazy jit construction ----------------------------------------------
+
+    def _build(self, fields: dict) -> None:
+        jax = _require_jax()
+        import jax.numpy as jnp
+
+        self._spec = {
+            k: (tuple(v.shape[1:]), np.dtype(v.dtype))
+            for k, v in fields.items()
+        }
+        self._buffers = {
+            k: jnp.zeros((self.capacity, *shape), dtype)
+            for k, (shape, dtype) in self._spec.items()
+        }
+        capacity = self.capacity
+
+        def _insert(bufs, batch, cursor):
+            def put(buf, b):
+                idx = (cursor + jnp.arange(b.shape[0])) % capacity
+                return buf.at[idx].set(b)
+
+            return {k: put(bufs[k], batch[k]) for k in bufs}
+
+        # Donated buffers: the scatter updates the ring in place, so
+        # insert never reallocates the (potentially multi-GB) reservoir
+        # and the train loop's memory footprint is flat.
+        self._insert_fn = jax.jit(_insert, donate_argnums=(0,))
+
+        augment = self.augment
+        base_key = (
+            self._rng_seed
+            if hasattr(self._rng_seed, "dtype")
+            else jax.random.key(int(self._rng_seed))
+        )
+
+        def _draw(bufs, idx, counter):
+            out = {k: v[idx] for k, v in bufs.items()}
+            if augment is not None:
+                out = augment(jax.random.fold_in(base_key, counter), out)
+            return out
+
+        # Gather + augmentation in ONE jitted dispatch per draw: echoed
+        # samples leave the reservoir already re-augmented, with no
+        # intermediate host hop.
+        self._draw_fn = jax.jit(_draw)
+        self._gather_fn = jax.jit(
+            lambda bufs, i: {k: v[i] for k, v in bufs.items()}
+        )
+
+    # -- operations -----------------------------------------------------------
+
+    def insert(self, batch: dict) -> np.ndarray:
+        """Write one batch of samples into the ring; returns the HOST
+        array of slot indices written (for the caller's echo/age
+        accounting — reading them costs no device sync).
+
+        ``batch`` fields must share one leading dim and match the
+        structure of the first insert; host numpy and device arrays
+        both work (numpy transfers inside the jit dispatch). A batch
+        larger than ``capacity`` keeps only its newest ``capacity``
+        rows (duplicate ring slots in one scatter would race).
+        """
+        if not batch:
+            raise ValueError("insert() needs at least one array field")
+        lead = next(iter(batch.values())).shape[0]
+        if lead > self.capacity:
+            batch = {k: v[-self.capacity:] for k, v in batch.items()}
+            lead = self.capacity
+        if self._buffers is None:
+            self._build(batch)
+        else:
+            if set(batch) != set(self._spec):
+                raise ValueError(
+                    f"insert fields {sorted(batch)} != reservoir fields "
+                    f"{sorted(self._spec)}"
+                )
+            for k, v in batch.items():
+                shape, dtype = self._spec[k]
+                if tuple(v.shape[1:]) != shape or np.dtype(v.dtype) != dtype:
+                    raise ValueError(
+                        f"field {k!r}: got {tuple(v.shape[1:])}/{v.dtype}, "
+                        f"reservoir holds {shape}/{dtype}"
+                    )
+        with metrics.span("echo.insert"):
+            self._buffers = self._insert_fn(
+                self._buffers, batch, np.int32(self._cursor % self.capacity)
+            )
+        slots = (self._cursor + np.arange(lead)) % self.capacity
+        self._cursor = (self._cursor + lead) % self.capacity
+        self.size = min(self.size + lead, self.capacity)
+        self.inserts += lead
+        return slots
+
+    def sample(self, idx) -> dict:
+        """Gather the rows at host-chosen ``idx`` (shape ``(B,)``) and
+        apply the augmentation chain, as one jitted dispatch. Each call
+        advances the internal draw counter, so repeated draws of the
+        same slots augment differently (deterministically, given the
+        construction ``rng``)."""
+        if self._buffers is None:
+            raise RuntimeError("reservoir is empty: insert() first")
+        idx = np.asarray(idx, np.int32)
+        counter = np.uint32(self._draws)
+        self._draws += 1
+        with metrics.span("echo.sample"):
+            return self._draw_fn(self._buffers, idx, counter)
+
+    def gather(self, idx) -> dict:
+        """Raw gather of ``idx`` rows with NO augmentation and no draw-
+        counter advance (inspection/testing; the hot path uses
+        :meth:`sample`)."""
+        if self._buffers is None:
+            raise RuntimeError("reservoir is empty: insert() first")
+        return self._gather_fn(self._buffers, np.asarray(idx, np.int32))
+
+    @property
+    def fields(self) -> tuple:
+        return tuple(self._spec) if self._spec else ()
+
+
+class EchoingPipeline:
+    """Yield train batches at the step rate from a producer-bound
+    stream, echoing each rendered sample up to ``max_echo_factor``
+    times with fresh on-device augmentation per draw.
+
+    ``pipeline`` is a decoded-batch source: a
+    :class:`~blendjax.data.pipeline.StreamDataPipeline` constructed
+    with ``chunk=1`` and ``emit_packed=False`` (the defaults), or any
+    iterable of batch dicts. A background thread drains it into the
+    reservoir as frames arrive; the draw loop inserts pending fresh
+    batches (non-blocking), composes a batch of slot indices on the
+    host honoring the echo budget, and emits one jitted
+    gather+augment. While budget headroom exists **a step never blocks
+    on the producers**; when every resident sample has been drawn
+    ``max_echo_factor`` times (or ``min_fresh_fraction`` can't be met)
+    the loop blocks for fresh frames and counts
+    ``echo.saturated_waits`` — the signal the stall doctor turns into
+    its "echo-saturated (raise producers or capacity)" verdict.
+
+    - ``capacity``: reservoir size in samples.
+    - ``max_echo_factor``: hard per-sample reuse cap (total draws per
+      inserted sample, the fresh draw included). Never exceeded.
+    - ``min_fresh_fraction``: minimum fraction of each emitted batch
+      that must be first-use samples (0 disables; the stream's tail —
+      after the inner pipeline ends — relaxes the floor to drain the
+      remaining budget).
+    - ``augment``: ``"default"`` (photometric color jitter on
+      ``image_key`` — label-safe), ``None`` (echo raw repeats), or a
+      ``fn(rng, batch) -> batch`` built with
+      :func:`blendjax.ops.augment.make_batch_augment` (pass
+      ``points_key`` there to pair geometric ops with spatial labels).
+    - ``warm_start``: a ``.bjr``/``.btr`` recording path (or prefix) —
+      the reservoir pre-fills from it through the full replay decode
+      path before live frames arrive, so step 0 never blocks on the
+      first render. Lineage stamps are stripped (``ReplayStream``).
+
+    Metrics: counters ``echo.inserted`` / ``echo.fresh`` /
+    ``echo.echoed`` (``fresh + echoed == steps * batch`` exactly) /
+    ``echo.saturated_waits`` / ``echo.skipped_partial``, gauges
+    ``echo.reservoir_fill`` / ``echo.unique_fraction`` /
+    ``echo.factor``, histogram ``echo.sample_age_s`` (reservoir age of
+    each drawn sample), span ``echo.wait_fresh`` (time blocked waiting
+    for fresh frames).
+    """
+
+    _DONE = object()
+
+    def __init__(
+        self,
+        pipeline,
+        capacity: int = 256,
+        max_echo_factor: int = 8,
+        min_fresh_fraction: float = 0.0,
+        batch_size: int | None = None,
+        augment="default",
+        image_key: str = "image",
+        points_key: str | None = None,
+        rng=0,
+        warm_start: str | None = None,
+        warm_start_allow_pickle: bool = False,
+    ):
+        self.pipeline = pipeline
+        self.capacity = int(capacity)
+        self.max_echo_factor = max(1, int(max_echo_factor))
+        self.min_fresh_fraction = float(min_fresh_fraction)
+        if not 0.0 <= self.min_fresh_fraction <= 1.0:
+            raise ValueError(
+                f"min_fresh_fraction must be in [0, 1], got "
+                f"{min_fresh_fraction}"
+            )
+        self.batch_size = (
+            int(batch_size) if batch_size
+            else getattr(pipeline, "batch_size", None)
+        )
+        tiles = getattr(pipeline, "tiles", None)
+        if tiles is not None and (
+            getattr(tiles, "chunk", 1) > 1
+            or getattr(tiles, "emit_packed", False)
+        ):
+            # The reservoir holds DECODED per-batch samples: chunked
+            # (K, B, ...) superbatches would echo whole groups and the
+            # packed form isn't decoded at all.
+            raise ValueError(
+                "EchoingPipeline needs a decoded per-batch pipeline: "
+                "construct the StreamDataPipeline with chunk=1 and "
+                "emit_packed=False"
+            )
+        self.image_key = image_key
+        self.points_key = points_key
+        if augment == "default":
+            augment = default_echo_augment(
+                image_key=image_key, points_key=points_key
+            )
+        self.reservoir = SampleReservoir(
+            self.capacity, augment=augment, rng=rng
+        )
+        self.warm_start = warm_start
+        self.warm_start_allow_pickle = bool(warm_start_allow_pickle)
+        seed = rng if isinstance(rng, int) else 0
+        self._np_rng = np.random.default_rng(seed)
+        # Host-side per-slot accounting (numpy, never device values):
+        self._use = np.zeros(self.capacity, np.int64)
+        self._t_insert = np.zeros(self.capacity, np.float64)
+        self._filled = np.zeros(self.capacity, bool)
+        self._queue: queue.Queue = queue.Queue(maxsize=2)
+        self._thread: threading.Thread | None = None
+        self._stop = threading.Event()
+        self._inner_error: BaseException | None = None
+        self._inner_done = False
+        self._warned_sidecars = False
+        self._warned_partial = False
+        # lifetime stats (mirrored into the metrics registry as exact
+        # counters; these instance fields feed `stats` and the bench)
+        self.steps = 0
+        self.fresh = 0
+        self.echoed = 0
+        self.inserted = 0
+        self.saturated_waits = 0
+
+    # -- inner-pipeline drain thread ------------------------------------------
+
+    def _drain(self) -> None:
+        try:
+            for b in iter(self.pipeline):
+                while not self._stop.is_set():
+                    try:
+                        self._queue.put(b, timeout=0.25)
+                        break
+                    except queue.Full:
+                        continue
+                if self._stop.is_set():
+                    return
+        except BaseException as e:  # propagate into the draw loop
+            self._inner_error = e
+        finally:
+            while not self._stop.is_set():
+                try:
+                    self._queue.put(self._DONE, timeout=0.25)
+                    break
+                except queue.Full:
+                    continue
+
+    # -- reservoir feeding ----------------------------------------------------
+
+    def _insert_fresh(self, batch: dict) -> None:
+        if "_packed" in batch or "__packed__" in batch:
+            raise ValueError(
+                "EchoingPipeline received a packed (emit_packed) batch; "
+                "echoing needs decoded batches"
+            )
+        if "_mask" in batch or batch.get("_partial"):
+            # A bucket-padded tail carries zero rows a reservoir draw
+            # would happily train on; the mask is device-resident by
+            # now, so slicing the real rows out would cost a host sync.
+            # The tail of a finite stream is the only batch shaped like
+            # this — skip it.
+            if not self._warned_partial:
+                self._warned_partial = True
+                logger.warning(
+                    "skipping a partial/masked tail batch: echoing its "
+                    "padded rows would train on zeros"
+                )
+            metrics.count("echo.skipped_partial")
+            return
+        arrays = {
+            k: v for k, v in batch.items()
+            if not k.startswith("_") and getattr(v, "ndim", 0) >= 1
+        }
+        if not arrays:
+            return
+        lead = max(
+            (v.shape[0] for v in arrays.values()),
+            key=lambda s: sum(
+                1 for v in arrays.values() if v.shape[0] == s
+            ),
+        )
+        fields = {k: v for k, v in arrays.items() if v.shape[0] == lead}
+        # underscore/meta keys are expected baggage, not sidecars worth
+        # a log line — only real array fields of mismatched lead count
+        dropped = sorted(set(arrays) - set(fields))
+        if dropped and not self._warned_sidecars:
+            self._warned_sidecars = True
+            logger.info(
+                "reservoir echoes fields %s; sidecars %s are dropped "
+                "from echoed batches", sorted(fields), dropped,
+            )
+        if self.batch_size is None:
+            self.batch_size = int(lead)
+        slots = self.reservoir.insert(fields)
+        self._use[slots] = 0
+        self._t_insert[slots] = time.monotonic()
+        self._filled[slots] = True
+        n = len(slots)
+        self.inserted += n
+        metrics.count("echo.inserted", n)
+        metrics.gauge("echo.reservoir_fill", int(self._filled.sum()))
+
+    def _poll_fresh(self, block: bool, timeout: float = 0.25) -> bool:
+        """Insert pending fresh batches; with ``block=True`` wait up to
+        ``timeout`` for one when none is pending. Returns whether
+        anything was inserted.
+
+        The non-blocking drain is BOUNDED by the backlog present at
+        entry: a producer fleet fast enough to refill the queue within
+        one insert's dispatch time must not livelock the draw loop
+        into inserting forever (observed with cheap 64x64 scenes on a
+        slow device — the step never ran). At most a queue's worth of
+        inserts ride between two draws; backpressure holds the rest."""
+        got = False
+        for _ in range(max(self._queue.qsize(), 1)):
+            try:
+                b = self._queue.get_nowait()
+            except queue.Empty:
+                break
+            if b is self._DONE:
+                self._inner_done = True
+                return got
+            self._insert_fresh(b)
+            got = True
+        if not got and block and not self._inner_done:
+            try:
+                with metrics.span("echo.wait_fresh"):
+                    b = self._queue.get(timeout=timeout)
+            except queue.Empty:
+                return False
+            if b is self._DONE:
+                self._inner_done = True
+                return False
+            self._insert_fresh(b)
+            got = True
+        return got
+
+    # -- draw composition -----------------------------------------------------
+
+    def _compose_draw(self) -> np.ndarray | None:
+        """Pick a batch of slot indices honoring the echo budget, or
+        None when the reservoir can't currently supply one (empty,
+        saturated, or short of the fresh floor).
+
+        Sampling is without replacement from the multiset of remaining
+        per-slot draws, so no slot can ever exceed ``max_echo_factor``
+        uses — not even within one batch."""
+        b = self.batch_size
+        if not b:
+            return None
+        slots = np.flatnonzero(self._filled)
+        if not len(slots):
+            return None
+        rem = np.maximum(self.max_echo_factor - self._use[slots], 0)
+        budget = int(rem.sum())
+        fresh = slots[self._use[slots] == 0]
+        need_fresh = math.ceil(self.min_fresh_fraction * b)
+        if budget < b:
+            return None
+        if len(fresh) < need_fresh:
+            if not self._inner_done:
+                return None
+            # stream over: drain the remaining budget without the floor
+            need_fresh = len(fresh)
+        picks = []
+        if need_fresh:
+            chosen = self._np_rng.choice(
+                fresh, size=need_fresh, replace=False
+            )
+            picks.append(chosen)
+            rem[np.searchsorted(slots, chosen)] -= 1
+        rest = b - need_fresh
+        if rest:
+            pool = np.repeat(slots, rem)
+            picks.append(self._np_rng.choice(pool, size=rest, replace=False))
+        return self._np_rng.permutation(np.concatenate(picks))
+
+    # -- iteration ------------------------------------------------------------
+
+    def __iter__(self):
+        if self.warm_start:
+            self._warm_fill()
+        if self._thread is None:
+            self._stop.clear()
+            self._thread = threading.Thread(
+                target=self._drain, name="blendjax-echo-drain", daemon=True
+            )
+            self._thread.start()
+        return self._draws()
+
+    def _draws(self):
+        waiting = False
+        while True:
+            if self._stop.is_set():
+                # stop() from another thread (error-path teardown) must
+                # end an in-flight iteration too: the drain thread skips
+                # its _DONE sentinel once stopped, so waiting for one
+                # here would spin on Empty polls forever.
+                return
+            self._poll_fresh(block=False)
+            if self._inner_error is not None:
+                # A crashed stream is NOT a clean end of stream: raise
+                # promptly instead of riding the EOS drain path — which
+                # would emit up to capacity * max_echo_factor purely-
+                # echoed samples (with the fresh floor silently
+                # relaxed) from a dead pipeline before surfacing it.
+                raise self._inner_error
+            idx = self._compose_draw()
+            if idx is None:
+                if self._inner_done and self._queue.empty():
+                    return
+                if not waiting and self._filled.any():
+                    # Budget exhausted with frames resident: the echo
+                    # mitigation has hit its cap — counted once per
+                    # wait episode, the doctor's saturation evidence.
+                    waiting = True
+                    self.saturated_waits += 1
+                    metrics.count("echo.saturated_waits")
+                self._poll_fresh(block=True)
+                continue
+            waiting = False
+            batch = self.reservoir.sample(idx)
+            # Accounting runs on the HOST index vector — the device
+            # batch is never materialized here (BJX108). idx is host
+            # numpy from _compose_draw, so these int()s are not device
+            # syncs despite BJX106's call-result heuristic. Fresh
+            # counts FIRST USES: a slot drawn twice in one batch is one
+            # fresh + one echo, so fresh can never exceed inserts.
+            # bjx: ignore[BJX106]
+            uniq = np.unique(idx)
+            # bjx: ignore[BJX106]
+            fresh_n = int((self._use[uniq] == 0).sum())
+            np.add.at(self._use, idx, 1)
+            # one locked registry call for the whole age vector — B
+            # individual observes per draw would serialize lock round
+            # trips into the same thread that dispatches training
+            metrics.observe_many(
+                "echo.sample_age_s", time.monotonic() - self._t_insert[idx]
+            )
+            self.steps += 1
+            self.fresh += fresh_n
+            self.echoed += len(idx) - fresh_n
+            metrics.count("echo.fresh", fresh_n)
+            metrics.count("echo.echoed", len(idx) - fresh_n)
+            # Derived gauges read back the REGISTRY counters, not the
+            # lifetime instance stats: after a mid-run metrics.reset()
+            # (bench's measured-window reset) the gauges must agree
+            # with the windowed echo.* counters in the same snapshot —
+            # the same reset-vs-instance-state mismatch PR 4 fixed for
+            # train.inflight_hwm.
+            f = metrics.counter_value("echo.fresh")
+            drawn = f + metrics.counter_value("echo.echoed")
+            metrics.gauge(
+                "echo.unique_fraction",
+                round(f / drawn, 4) if drawn else 0.0,
+            )
+            metrics.gauge(
+                "echo.factor",
+                round(
+                    drawn / max(metrics.counter_value("echo.inserted"), 1),
+                    4,
+                ),
+            )
+            yield batch
+
+    # -- warm start -----------------------------------------------------------
+
+    def _warm_fill(self) -> None:
+        """Pre-fill the reservoir from a recording through the full
+        replay decode path (tile/pal recordings decode bit-exact;
+        lineage stamps are stripped by ``ReplayStream``), so the first
+        draw never waits on a live render."""
+        from blendjax.data.pipeline import StreamDataPipeline
+
+        if self.batch_size is None:
+            raise ValueError(
+                "warm_start needs a known batch_size (pass batch_size= "
+                "or wrap a StreamDataPipeline)"
+            )
+        warm = StreamDataPipeline.from_recording(
+            self.warm_start,
+            batch_size=self.batch_size,
+            allow_pickle=self.warm_start_allow_pickle,
+        )
+        budget = math.ceil(self.capacity / self.batch_size)
+        with warm:
+            it = iter(warm)
+            for _ in range(budget):
+                try:
+                    self._insert_fresh(next(it))
+                except StopIteration:
+                    break
+        logger.info(
+            "warm-started reservoir with %d samples from %r",
+            int(self._filled.sum()), self.warm_start,
+        )
+
+    # -- lifecycle / observability --------------------------------------------
+
+    @property
+    def stats(self) -> dict:
+        drawn = self.fresh + self.echoed
+        return {
+            "steps": self.steps,
+            "inserted": self.inserted,
+            "fresh": self.fresh,
+            "echoed": self.echoed,
+            "saturated_waits": self.saturated_waits,
+            "reservoir_fill": int(self._filled.sum()),
+            "unique_fraction": (
+                round(self.fresh / drawn, 4) if drawn else None
+            ),
+            "echo_factor": (
+                round(drawn / self.inserted, 4) if self.inserted else None
+            ),
+        }
+
+    def doctor(self, driver=None):
+        """Stall-doctor verdict for the echoing pipeline (delegates to
+        the wrapped pipeline's doctor when it has one, so prefetch
+        bounds and queue gauges feed the diagnosis; the ``echo.*``
+        counters this class emits drive the echo-mitigated /
+        echo-saturated arms)."""
+        inner = getattr(self.pipeline, "doctor", None)
+        if inner is not None:
+            return inner(driver)
+        from blendjax.obs import diagnose_current
+
+        stats = getattr(driver, "stats", driver)
+        return diagnose_current(driver=stats)
+
+    def stop(self) -> None:
+        self._stop.set()
+        stop = getattr(self.pipeline, "stop", None)
+        if stop is not None:
+            stop()
+        if self._thread is not None:
+            self._thread.join(timeout=10.0)
+            self._thread = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+
+
+def default_echo_augment(image_key: str = "image",
+                         points_key: str | None = None):
+    """The stock per-draw decorrelation chain (built lazily so this
+    module stays importable without jax): photometric color jitter
+    always — label-safe for any task — plus paired flip + small crop
+    when ``points_key`` names a (B, P, 2) pixel-coordinate field whose
+    labels transform alongside the image. Returns ``fn(rng, batch) ->
+    batch`` for :class:`SampleReservoir`."""
+
+    def augment(rng, batch):
+        import functools
+
+        from blendjax.ops.augment import (
+            color_jitter,
+            make_batch_augment,
+            random_crop_with_points,
+            random_flip_with_points,
+        )
+
+        ops = [color_jitter]
+        if points_key is not None:
+            ops = [
+                random_flip_with_points,
+                functools.partial(random_crop_with_points, pad=2),
+                color_jitter,
+            ]
+        fn = make_batch_augment(
+            *ops, image_key=image_key, points_key=points_key
+        )
+        return fn(rng, batch)
+
+    return augment
+
+
+__all__ = ["SampleReservoir", "EchoingPipeline", "default_echo_augment"]

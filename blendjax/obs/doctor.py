@@ -23,12 +23,20 @@ wire-bound      the consumer starves (``ingest.queue_wait`` high) AND
                 not the producers
 producer-bound  the consumer starves but frames arrive FRESH: producers
                 simply don't render fast enough
+echo-saturated  a data-echoing pipeline's draw loop blocked on its echo
+                budget (``echo.saturated_waits`` / ``echo.wait_fresh``):
+                echoing already absorbs all it may — raise producers,
+                reservoir capacity, or ``max_echo_factor``
 ==============  ============================================================
 
 plus ``balanced`` (no single stage dominates — the healthy verdict) and
 ``idle`` (no span data yet). The discriminator between wire- and
 producer-bound is frame lineage (:mod:`blendjax.obs.lineage`): identical
-queue-wait symptoms, opposite staleness signatures.
+queue-wait symptoms, opposite staleness signatures. A starving consumer
+whose ``echo.*`` counters show an active, unsaturated reservoir is
+reported producer-bound with an "echo-mitigated" reason — the step rate
+is being sustained by echoing, and the advice shifts from "the run is
+starving" to "fresh-data diversity is the limit".
 
 All inputs are plain dicts so synthetic fixtures exercise every verdict
 without sockets or devices (``tests/test_obs.py``).
@@ -45,6 +53,7 @@ VERDICTS = (
     "decode-bound",
     "wire-bound",
     "producer-bound",
+    "echo-saturated",
     "balanced",
     "idle",
 )
@@ -112,8 +121,12 @@ def diagnose(
     decode = _total(spans, "decode.dispatch")
     train = _total(spans, "train.dispatch")
     ring = _total(spans, "driver.ring_wait")
+    # Echoing pipelines starve in their own span: the draw loop blocked
+    # waiting for fresh frames (the inner consumer's queue_wait accrues
+    # concurrently in the drain thread).
+    ewait = _total(spans, "echo.wait_fresh")
 
-    busy = recv + qwait + place + throttle + decode + train + ring
+    busy = recv + qwait + place + throttle + decode + train + ring + ewait
     shares = {
         "ingest.recv": recv,
         "ingest.queue_wait": qwait,
@@ -122,6 +135,7 @@ def diagnose(
         "decode.dispatch": decode,
         "train.dispatch": train,
         "driver.ring_wait": ring,
+        "echo.wait_fresh": ewait,
     }
     if busy <= 0.0:
         return Verdict(
@@ -195,6 +209,7 @@ def diagnose(
         shares["ingest.recv"], shares["ingest.queue_wait"],
         shares["feed.place"], shares["feed.throttle_wait"],
         shares["train.dispatch"], shares["driver.ring_wait"],
+        shares["echo.wait_fresh"],
     )
     if shares["decode.dispatch"] > 0.30 and shares["decode.dispatch"] >= others:
         return Verdict(
@@ -214,13 +229,21 @@ def diagnose(
     if backpressured and shares["ingest.queue_wait"] < 0.15:
         return step_verdict()
 
-    # 4/5. consumer starving: gate on ingest.queue_wait ALONE — it is
-    #      the consumer-observed wait. ingest.recv accrues concurrently
-    #      in N worker threads (N shards blocked in recv can bank ~N x
-    #      wall of span time), so using it as evidence would
-    #      misclassify a healthy sharded run as starving; it only
-    #      corroborates via the reason string.
-    if shares["ingest.queue_wait"] > 0.30:
+    # 4/5. consumer starving: gate on ingest.queue_wait (the consumer-
+    #      observed wait) or echo.wait_fresh (the echoing draw loop's
+    #      own starvation span) — NOT ingest.recv, which accrues
+    #      concurrently in N worker threads (N shards blocked in recv
+    #      can bank ~N x wall of span time) and would misclassify a
+    #      healthy sharded run as starving; it only corroborates via
+    #      the reason string.
+    starving = (
+        shares["ingest.queue_wait"] > 0.30
+        or shares["echo.wait_fresh"] > 0.30
+    )
+    echo_fresh = int(counters.get("echo.fresh", 0))
+    echo_echoed = int(counters.get("echo.echoed", 0))
+    echo_active = echo_fresh + echo_echoed > 0
+    if starving:
         if staleness_p95_s is not None and staleness_p95_s >= stale_wire_s:
             return Verdict(
                 "wire-bound",
@@ -236,12 +259,46 @@ def diagnose(
             f"{staleness_p95_s * 1e3:.0f} ms old (p95)"
             if staleness_p95_s is not None else "unstamped"
         )
+        if echo_active:
+            # The echo arm: same producer-shaped starvation, but a data-
+            # echoing reservoir sits between it and the step. Saturated
+            # (the draw loop blocked on its budget) means echoing already
+            # gives all it may; unsaturated means the step rate is being
+            # sustained and fresh-data diversity is the real limit.
+            sat = int(counters.get("echo.saturated_waits", 0))
+            factor = round(
+                (echo_fresh + echo_echoed) / max(echo_fresh, 1), 2
+            )
+            if sat > 0 or shares["echo.wait_fresh"] > 0.30:
+                return Verdict(
+                    "echo-saturated",
+                    f"echo budget exhausted {sat} times "
+                    f"(wait_fresh share={shares['echo.wait_fresh']:.0%}, "
+                    f"echo factor {factor}): the reservoir can't echo "
+                    "any further under its budget",
+                    "raise producer instances, reservoir capacity, or "
+                    "max_echo_factor",
+                    shares,
+                )
+            return Verdict(
+                "producer-bound",
+                f"producer-bound, echo-mitigated: frames arrive fresh "
+                f"({fresh}) at a fraction of the step rate, and the "
+                f"reservoir echoes each {factor}x to keep the step fed "
+                f"(unique fraction "
+                f"{echo_fresh / (echo_fresh + echo_echoed):.0%})",
+                "launch more producer instances for fresh-data "
+                "diversity; the step rate itself is already sustained",
+                shares,
+            )
         return Verdict(
             "producer-bound",
             f"consumer starving (queue_wait share="
             f"{shares['ingest.queue_wait']:.0%}) while frames arrive "
             f"fresh ({fresh}): producers don't render fast enough",
-            "launch more producer instances or cheapen the scene/render",
+            "launch more producer instances or cheapen the scene/render "
+            "— or absorb the gap with data echoing "
+            "(blendjax.data.EchoingPipeline)",
             shares,
         )
 

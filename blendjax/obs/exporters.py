@@ -68,7 +68,11 @@ def prometheus_text(report: dict | None = None,
     their source unit (seconds for spans).
     """
     if report is None:
-        report = registry.report()
+        # include_buckets: the native-histogram buckets come from the
+        # SAME locked snapshot as the counters/gauges/spans, so a page
+        # can never pair one snapshot's counters with another's
+        # histogram series.
+        report = registry.report(include_buckets=True)
     if lineage_report is None:
         from blendjax.obs.lineage import lineage
 
@@ -85,9 +89,14 @@ def prometheus_text(report: dict | None = None,
         lines.append(f"# TYPE {pn} gauge")
         lines.append(f"{pn} {_num(report['gauges'][name])}")
 
-    # Native histograms need the raw buckets, which the summary dict
-    # doesn't carry — take a locked bucket snapshot from the registry.
-    hists = registry.histogram_buckets()
+    # Native histograms need the raw buckets: prefer the ones carried
+    # in the report snapshot itself (same lock acquisition as the
+    # counters above); a caller-provided report without them falls
+    # back to a fresh locked snapshot from ``registry`` — consistent
+    # only if that is the registry the report came from.
+    hists = report.get("histogram_buckets")
+    if hists is None:
+        hists = registry.histogram_buckets()
     for name in sorted(hists):
         buckets, count, total = hists[name]
         pn = _prom_name(name)

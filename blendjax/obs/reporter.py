@@ -64,17 +64,27 @@ class StatsReporter:
         self.last_verdict = verdict
         self.log.info("%s", verdict.render())
         if self._jsonl is not None:
-            self._jsonl.write(
-                report,
-                extra={
-                    "doctor": {
-                        "kind": verdict.kind,
-                        "reason": verdict.reason,
-                        "shares": verdict.shares,
-                    },
-                    "lineage": self.lineage.report(),
+            extra = {
+                "doctor": {
+                    "kind": verdict.kind,
+                    "reason": verdict.reason,
+                    "shares": verdict.shares,
                 },
-            )
+                "lineage": self.lineage.report(),
+            }
+            # Echoing runs get their accounting surfaced beside the
+            # verdict (fresh/echoed counters sum exactly to drawn
+            # samples; the echo-mitigated/saturated arms read these).
+            echo = {
+                k: v
+                for src in (report.get("counters", {}),
+                            report.get("gauges", {}))
+                for k, v in src.items()
+                if k.startswith("echo.")
+            }
+            if echo:
+                extra["echo"] = echo
+            self._jsonl.write(report, extra=extra)
         return verdict
 
     def _run(self) -> None:

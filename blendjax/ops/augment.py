@@ -147,6 +147,78 @@ def random_crop_with_points(rng, images, points, pad: int = 4):
     return jax.vmap(one)(keys, padded, jnp.asarray(points))
 
 
+def make_batch_augment(*ops, image_key: str = "image",
+                       points_key: str | None = None):
+    """Lift image augmentation ops to whole batch DICTS, keeping
+    spatial labels consistent with the images — the form the data-
+    echoing reservoir applies per draw (``blendjax.data.echo``).
+
+    Each op draws from an independent fold of the key, like
+    :func:`make_augment`. Ops come in two shapes, told apart by their
+    required-parameter count:
+
+    - ``op(rng, images)`` — photometric/unpaired (2 required params):
+      applied to ``batch[image_key]`` alone.
+    - ``op(rng, images, points)`` — paired (3 required params, e.g.
+      :func:`random_flip_with_points`): applied to the image AND the
+      ``batch[points_key]`` labels together, so geometric ops can't
+      desynchronize supervision. Requires ``points_key``.
+
+    Fields other than ``image_key``/``points_key`` pass through
+    untouched; a batch missing ``image_key`` is returned unchanged.
+
+    >>> aug = make_batch_augment(random_flip_with_points, color_jitter,
+    ...                          points_key="xy")
+    >>> batch_out = jax.jit(aug)(key, {"image": imgs, "xy": pts})
+    """
+    import inspect
+
+    def n_required(op):
+        empty = inspect.Parameter.empty
+        positional = (
+            inspect.Parameter.POSITIONAL_ONLY,
+            inspect.Parameter.POSITIONAL_OR_KEYWORD,
+        )
+        return sum(
+            1 for p in inspect.signature(op).parameters.values()
+            if p.default is empty and p.kind in positional
+        )
+
+    paired = tuple(n_required(op) >= 3 for op in ops)
+    if any(paired) and points_key is None:
+        raise ValueError(
+            "paired ops (rng, images, points) need points_key= to name "
+            "the label field they co-transform"
+        )
+
+    def augment(rng, batch):
+        if image_key not in batch:
+            return batch
+        images = batch[image_key]
+        points = batch.get(points_key) if points_key is not None else None
+        if points is None and any(paired):
+            # Fail at the misconfiguration, not as an opaque TypeError
+            # deep inside a paired op's jit trace (e.g. the reservoir
+            # dropped the label field as a lead-mismatched sidecar).
+            raise KeyError(
+                f"paired augmentation needs batch[{points_key!r}], which "
+                f"is missing (batch fields: {sorted(batch)})"
+            )
+        for i, (op, pair) in enumerate(zip(ops, paired)):
+            key = jax.random.fold_in(rng, i)
+            if pair:
+                images, points = op(key, images, points)
+            else:
+                images = op(key, images)
+        out = dict(batch)
+        out[image_key] = images
+        if points is not None:
+            out[points_key] = points
+        return out
+
+    return augment
+
+
 def make_augment(*ops):
     """Compose augmentation ops into one ``fn(rng, images)``; each op
     draws from an independent fold of the key.
@@ -173,4 +245,5 @@ __all__ = [
     "random_flip_with_points",
     "random_crop_with_points",
     "make_augment",
+    "make_batch_augment",
 ]

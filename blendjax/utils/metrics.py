@@ -163,6 +163,14 @@ class Metrics:
         with self._lock:
             self.counters[name] += n
 
+    def counter_value(self, name: str) -> int:
+        """Locked read of one counter's current value — for writers
+        that derive a gauge from counters they also emit (the value
+        then stays consistent with the counters in the same snapshot,
+        across any ``reset()``)."""
+        with self._lock:
+            return self.counters.get(name, 0)
+
     def gauge(self, name: str, value) -> None:
         # Locked like everything else: a bare dict store is GIL-atomic,
         # but report()'s consistent snapshot needs writers excluded.
@@ -182,6 +190,16 @@ class Metrics:
         concurrent observers never lose a count)."""
         with self._lock:
             self._hists[name].observe(value)
+
+    def observe_many(self, name: str, values) -> None:
+        """Record a batch of samples into one histogram under a SINGLE
+        lock acquisition — for hot loops that produce a vector of
+        observations per iteration (e.g. the echo reservoir's per-draw
+        sample ages): one lock round trip instead of len(values)."""
+        with self._lock:
+            h = self._hists[name]
+            for v in values:
+                h.observe(v)
 
     @contextlib.contextmanager
     def span(self, name: str):
@@ -257,12 +275,16 @@ class Metrics:
                 for k, h in self._hists.items()
             }
 
-    def report(self) -> dict:
+    def report(self, include_buckets: bool = False) -> dict:
         # One lock acquisition for the WHOLE snapshot: counters, gauges,
         # spans, and histograms are mutually consistent (no worker can
-        # bump a counter between the copies).
+        # bump a counter between the copies). ``include_buckets`` adds
+        # the raw cumulative-bucket view under the SAME lock, so an
+        # exporter can render native histograms from the same snapshot
+        # as the counters beside them (a separate histogram_buckets()
+        # call races spans recorded in between).
         with self._lock:
-            return {
+            out = {
                 "counters": dict(self.counters),
                 "gauges": dict(self.gauges),
                 "spans": self._spans_locked(),
@@ -270,6 +292,12 @@ class Metrics:
                     k: h.summary() for k, h in self._hists.items()
                 },
             }
+            if include_buckets:
+                out["histogram_buckets"] = {
+                    k: (h.cumulative_buckets(), h.count, h.total)
+                    for k, h in self._hists.items()
+                }
+            return out
 
     def reset(self) -> None:
         with self._lock:

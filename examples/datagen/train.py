@@ -54,6 +54,26 @@ def main():
         help="driver loss-fetch cadence (steps) when --inflight > 0",
     )
     ap.add_argument(
+        "--echo", type=int, default=0, metavar="FACTOR",
+        help="data echoing for producer-bound runs (docs/performance.md "
+        "'Echoing past a producer-bound pipeline'): hold decoded "
+        "samples in a device-resident reservoir and draw train batches "
+        "at the STEP rate, re-augmented per draw, each sample reused "
+        "at most FACTOR times (0 = off). Incompatible with --chunk > 1 "
+        "(the reservoir echoes per-batch decoded samples); photometric "
+        "re-augmentation only, since this task's labels are pixel "
+        "coordinates",
+    )
+    ap.add_argument(
+        "--echo-capacity", type=int, default=256,
+        help="reservoir size in samples when --echo > 0",
+    )
+    ap.add_argument(
+        "--echo-warm-start", default=None, metavar="PATH",
+        help="pre-fill the reservoir from a .bjr recording before live "
+        "frames arrive (step 0 never blocks on the first render)",
+    )
+    ap.add_argument(
         "--metrics-port", type=int, default=None,
         help="serve Prometheus text at http://127.0.0.1:PORT/metrics "
         "while training (0 picks a free port; blendjax.obs.exporters) "
@@ -118,9 +138,29 @@ def main():
 
         augment = make_augment(color_jitter)
     chunk = args.chunk if args.encoding in ("tile", "pal") else 1
-    use_driver = args.inflight > 0 and args.encoding in ("tile", "pal")
+    echo_mode = args.echo > 0
+    if echo_mode and chunk > 1:
+        ap.error("--echo needs a per-batch decoded pipeline: drop --chunk")
+    use_fused = (
+        args.inflight > 0 and args.encoding in ("tile", "pal")
+        and not echo_mode
+    )
     driver = None
-    if use_driver:
+    if echo_mode:
+        # Data echoing: the reservoir feeds a plain supervised step on
+        # decoded batches (the per-draw re-augmentation lives INSIDE
+        # the reservoir's gather jit, so --augment's in-step chain is
+        # not also applied); --inflight > 0 additionally keeps step
+        # dispatches in flight — still one train dispatch per step.
+        step = make_supervised_step(mesh=mesh, batch_sharding=sharding)
+        if args.inflight > 0:
+            from blendjax.train import TrainDriver
+
+            driver = TrainDriver(
+                step, state, inflight=args.inflight,
+                sync_every=args.sync_every,
+            )
+    elif use_fused:
         # Fused decode + async overlap: exactly one device dispatch per
         # step, up to --inflight of them outstanding, loss fetched every
         # --sync-every steps (docs/performance.md).
@@ -151,7 +191,19 @@ def main():
         # superbatches are (K', B, ...) and K' can run short on a
         # group flush; count what actually arrived
         shp = batch["image"].shape
-        return shp[0] * shp[1] if chunk > 1 or use_driver else shp[0]
+        return shp[0] * shp[1] if chunk > 1 or use_fused else shp[0]
+
+    def wrap_echo(pipe):
+        if not echo_mode:
+            return pipe
+        from blendjax.data import EchoingPipeline
+
+        return EchoingPipeline(
+            pipe, capacity=args.echo_capacity,
+            max_echo_factor=args.echo,
+            warm_start=args.echo_warm_start,
+            warm_start_allow_pickle=args.allow_pickle,
+        )
 
     def run_steps(batches):
         nonlocal state
@@ -159,7 +211,7 @@ def main():
         for i, batch in enumerate(batches):
             if i >= args.steps:
                 break
-            if use_driver:
+            if driver is not None:
                 driver.submit(batch)
             else:
                 fields = {"image": batch["image"], "xy": batch["xy"]}
@@ -171,7 +223,7 @@ def main():
                     loss = loss[-1] if getattr(loss, "ndim", 0) else loss
                     print(f"step {i}: loss={float(loss):.5f}")
             n += batch_count(batch)
-        if use_driver:
+        if driver is not None:
             state, final = driver.finish()
             if final is not None:  # None = zero batches submitted
                 print(f"final loss={final:.5f}  driver={driver.stats}")
@@ -187,11 +239,11 @@ def main():
             # like epochs.
             pipe = StreamDataPipeline.from_recording(
                 args.replay, batch_size=args.batch, sharding=sharding,
-                loop=True, chunk=chunk, emit_packed=use_driver,
+                loop=True, chunk=chunk, emit_packed=use_fused,
                 allow_pickle=args.allow_pickle,
             )
-            with pipe:
-                run_steps(iter(pipe))
+            with wrap_echo(pipe) as source:
+                run_steps(iter(source))
             return
 
         producer_args = ["--shape", str(h), str(w)]
@@ -206,16 +258,19 @@ def main():
             seed=0,
             instance_args=[producer_args] * args.instances,
         ) as launcher:
-            with StreamDataPipeline(
+            pipe = StreamDataPipeline(
                 launcher.addresses["DATA"],
                 batch_size=args.batch,
                 sharding=sharding,
                 chunk=chunk,
-                emit_packed=use_driver,
+                emit_packed=use_fused,
                 record_path_prefix=args.record,
-            ) as pipe:
-                run_steps(iter(pipe))
-                print(pipe.doctor(driver).render())
+            )
+            with wrap_echo(pipe) as source:
+                run_steps(iter(source))
+                if echo_mode:
+                    print(f"echo={source.stats}")
+                print(source.doctor(driver).render())
     finally:
         if reporter is not None:
             reporter.stop()  # final tick logs the closing verdict

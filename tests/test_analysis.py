@@ -337,6 +337,95 @@ def test_bjx107_inline_suppression():
     assert rule_ids(src, relpath="blendjax/data/pipeline.py") == []
 
 
+# -- BJX108 reservoir-host-materialization -----------------------------------
+
+RESERVOIR_FETCH = """
+    # bjx: driver-hot-path
+    import numpy as np
+
+    def draw(reservoir, idx):
+        batch = reservoir.sample(idx)
+        imgs = np.asarray(batch["image"])
+        loss = float(batch["xy"])
+        return imgs, loss
+"""
+
+
+def test_bjx108_flags_host_fetch_of_sample_result():
+    got = findings(RESERVOIR_FETCH, select=["BJX108"])
+    assert [f.rule for f in got] == ["BJX108"] * 2
+    assert "numpy.asarray()" in got[0].message
+    assert "'batch'" in got[0].message
+
+
+def test_bjx108_flags_direct_nesting_and_constructed_locals():
+    src = """
+        # bjx: driver-hot-path
+        import numpy as np
+        from blendjax.data.echo import SampleReservoir
+
+        def insert_and_peek(batches, idx):
+            res = SampleReservoir(64)
+            for b in batches:
+                res.insert(b)
+            return np.asarray(res.sample(idx))
+
+        def peek_item(self, idx):
+            return self.reservoir.gather(idx)["image"].item()
+    """
+    got = findings(src, select=["BJX108"])
+    assert [f.rule for f in got] == ["BJX108"] * 2
+    assert {"insert_and_peek", "peek_item"} == {
+        f.message.split("'")[1] for f in got
+    }
+
+
+def test_bjx108_negatives_host_indices_and_unmarked_modules():
+    # the sanctioned shape: accounting on the HOST-chosen index vector,
+    # device batch never materialized
+    clean = """
+        # bjx: driver-hot-path
+        import numpy as np
+
+        def draw(reservoir, use, rng, b):
+            idx = rng.choice(np.flatnonzero(use < 8), size=b)
+            batch = reservoir.sample(idx)
+            fresh = int((use[idx] == 0).sum())
+            np.add.at(use, idx, 1)
+            return batch, fresh
+    """
+    assert rule_ids(clean, select=["BJX108"]) == []
+    # a fetch BEFORE the sample assignment reads an unrelated value
+    one_behind = """
+        # bjx: driver-hot-path
+        import numpy as np
+
+        def draw(reservoir, idx, batch):
+            host = np.asarray(batch)
+            batch = reservoir.sample(idx)
+            return host, batch
+    """
+    assert rule_ids(one_behind, select=["BJX108"]) == []
+    # same fetch outside driver hot paths: silent (eval/test code may
+    # materialize freely)
+    assert rule_ids(
+        RESERVOIR_FETCH.replace("# bjx: driver-hot-path", ""),
+        select=["BJX108"],
+    ) == []
+
+
+def test_bjx108_inline_suppression():
+    src = """
+        # bjx: driver-hot-path
+        import numpy as np
+
+        def debug_draw(reservoir, idx):
+            batch = reservoir.sample(idx)
+            return np.asarray(batch["image"])  # bjx: ignore[BJX108]
+    """
+    assert rule_ids(src, select=["BJX108"]) == []
+
+
 # -- BJX103 unsafe-deserialization ------------------------------------------
 
 
@@ -725,7 +814,7 @@ def test_cli_exit_codes_and_json(tmp_path):
     assert ok.returncode == 0
     for rule_id in (
         "BJX101", "BJX102", "BJX103", "BJX104", "BJX105", "BJX106",
-        "BJX107",
+        "BJX107", "BJX108",
     ):
         assert rule_id in ok.stdout
 
@@ -751,7 +840,7 @@ def test_syntax_error_reports_bjx000():
 def test_every_rule_registered():
     assert set(all_rules()) == {
         "BJX101", "BJX102", "BJX103", "BJX104", "BJX105", "BJX106",
-        "BJX107",
+        "BJX107", "BJX108",
     }
 
 

@@ -286,11 +286,13 @@ def test_gated_row_retries_once_after_midrow_collapse():
     import bench
 
     clock = _Clock()
-    # attempt 1: pre fit, post collapsed AND the jitter re-probe still
-    # collapsed (a real mid-row flap); attempt 2: fit holds
+    # attempt 1: pre fit, post collapsed AND BOTH decayed re-probes
+    # still collapsed (a real mid-row flap); attempt 2: fit holds
     row = bench.run_gated_row(
         _row_fn([500.0, 510.0], clock),
-        _probe_seq([FIT, COLLAPSED, COLLAPSED, FIT, FIT], clock),
+        _probe_seq(
+            [FIT, COLLAPSED, COLLAPSED, COLLAPSED, FIT, FIT], clock
+        ),
         headline_fit=True, degraded=False, budget=180.0,
         poll_sleep=12.0, clock=clock, sleep=clock.sleep,
     )
@@ -315,6 +317,73 @@ def test_gated_row_single_jitter_sample_cannot_invalidate():
     assert row["fit_window"] is True
     assert row["img_s"] == 500.0  # no re-measurement needed
     assert row["weather"]["post"]["jitter_discarded"] == 12.0
+
+
+def test_gated_row_decaying_bar_accepts_jittered_reprobe():
+    """A post sample under the full fit bar but above the decayed
+    re-probe bar (teardown jitter, not a collapse) keeps the window
+    fit: re-probe 1 judges at 0.9x the bar, re-probe 2 at 0.81x — the
+    BENCH_r05 mode where one re-probe at the full bar still
+    invalidated `utilization` with `invalid: "weather"`."""
+    import bench
+
+    clock = _Clock()
+    near_fit = {"fit": False, "rtt_s": 0.1, "h2d_MB_s": 33.0}
+    # 33.0 fails the 35.0 bar and the first decayed bar (31.5 passes!)
+    # -> accepted on re-probe 1 with the relaxed-bar stamp
+    row = bench.run_gated_row(
+        _row_fn([500.0], clock),
+        _probe_seq([FIT, near_fit, near_fit], clock),
+        headline_fit=True, degraded=False, budget=180.0,
+        poll_sleep=12.0, clock=clock, sleep=clock.sleep,
+    )
+    assert row["fit_window"] is True
+    assert row["img_s"] == 500.0  # no re-measurement needed
+    post = row["weather"]["post"]
+    assert post["relaxed_bar_MB_s"] == 31.5  # 35.0 * 0.9
+    assert post["jitter_discarded"] == 33.0
+    # a genuinely collapsed window fails every decayed bar and the
+    # discarded samples are all preserved
+    clock2 = _Clock()
+    row2 = bench.run_gated_row(
+        _row_fn([500.0], clock2),
+        _probe_seq([FIT, COLLAPSED], clock2),
+        headline_fit=True, degraded=False, budget=10.0, attempts=1,
+        poll_sleep=12.0, clock=clock2, sleep=clock2.sleep,
+    )
+    assert row2["fit_window"] is False
+    assert "jitter_discarded" not in row2["weather"]["post"]
+
+
+def test_utilization_row_partial_instead_of_invalid():
+    """Cross-window utilization publishes a one-sided lower bound with
+    an explicit `partial` flag — never the old `invalid: "weather"`
+    wholesale discard (the recurring r05 outcome)."""
+    import bench
+
+    fit_alone = {"img_s": 1000.0, "fit_window": True}
+    assert bench.utilization_row(500.0, fit_alone, True) == 0.5
+    p = bench.utilization_row(500.0, fit_alone, False)
+    assert p["partial"] is True and p["one_sided"] == 0.5
+    assert p["reason"] == "weather"
+    assert p["headline_fit"] is False and p["step_alone_fit"] is True
+    # unfit headline deflates the numerator: the figure is a floor
+    assert p["bound"] == "lower"
+    p2 = bench.utilization_row(
+        500.0, {"img_s": 1000.0, "fit_window": False}, True
+    )
+    assert p2["partial"] is True and p2["step_alone_fit"] is False
+    # unfit step-alone deflates the DENOMINATOR: the figure can only
+    # overstate utilization — it must publish as an upper bound
+    assert p2["bound"] == "upper"
+    p3 = bench.utilization_row(
+        500.0, {"img_s": 1000.0, "fit_window": False}, False
+    )
+    assert p3["bound"] == "unknown"
+    assert all("invalid" not in x for x in (p, p2, p3))
+    assert bench.utilization_row(500.0, {}, True)["invalid"] == (
+        "step_alone_failed"
+    )
 
 
 def test_gated_row_degraded_skips_probes_entirely():
@@ -376,6 +445,37 @@ def test_live_overlap_row_shape(monkeypatch):
         assert row[leg]["steps_in_flight_hwm"] <= 3
     assert row["value"] == pytest.approx(
         row["inflight3"]["img_s"] / row["inflight1"]["img_s"], rel=1e-3
+    )
+
+
+def test_live_echo_row_shape(monkeypatch):
+    """The data-echoing A/B row runs the off and echo legs for real
+    through pipeline + reservoir + TrainDriver and reports the record's
+    contracts: exact echo accounting (fresh + echoed == steps * batch),
+    exactly one train dispatch per driver step, unique fraction, and
+    the step-rate ratio. Bench shapes shrunk for the CPU mesh like the
+    rows above."""
+    import bench
+
+    monkeypatch.setattr(bench, "SHAPE", (64, 64))
+    monkeypatch.setattr(bench, "_TILE_ARGS", ["16"])
+    monkeypatch.setattr(bench, "TILE_CAPACITY", "16")
+    row = bench.measure_live_echo(
+        items=16, time_cap=10.0, factors=(4,), capacity=64
+    )
+    assert row["off"]["step_img_s"] > 0
+    assert row["echo4"]["step_img_s"] > 0
+    assert row["accounting_exact"] is True
+    assert row["dispatch_per_step"] == 1.0
+    leg = row["echo4"]
+    assert leg["max_echo_factor"] == 4
+    assert 0.0 < leg["unique_fraction"] <= 1.0
+    assert leg["echo_counters"]["echo.fresh"] + leg["echo_counters"][
+        "echo.echoed"
+    ] == leg["steps"] * bench.BATCH
+    assert row["off"]["unique_fraction"] == 1.0
+    assert row["value"] == pytest.approx(
+        row["echo4"]["step_img_s"] / row["off"]["step_img_s"], abs=5e-4
     )
 
 

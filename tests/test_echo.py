@@ -1,0 +1,511 @@
+"""Data echoing (PR 5): device-resident sample reservoir + on-device
+re-augmentation for producer-bound pipelines.
+
+- reservoir ring semantics are deterministic under jit (insert order,
+  wraparound, gather) and the donated insert never reallocates the
+  device buffers,
+- the echo budget is enforced exactly: no sample is ever drawn more
+  than ``max_echo_factor`` times, ``min_fresh_fraction`` holds per
+  batch, and ``echo.fresh + echo.echoed == steps * batch`` exactly,
+- echoed draws decorrelate via the fused augmentation chain while
+  spatial labels transform consistently with their images,
+- the step loop never blocks while echo budget remains, sustains a
+  step rate >= 4x the producer frame rate at ``max_echo_factor=8``,
+  and composes with ``TrainDriver`` at exactly one dispatch per step,
+- warm-start pre-fills the reservoir from a recording,
+- the stall doctor reports the echo-mitigated / echo-saturated arms.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import optax  # noqa: E402
+
+from blendjax.data.echo import (  # noqa: E402
+    EchoingPipeline,
+    SampleReservoir,
+)
+from blendjax.obs import diagnose  # noqa: E402
+from blendjax.utils.metrics import metrics as reg  # noqa: E402
+
+B, H, W = 4, 8, 8
+
+
+def _batch(i: int, b: int = B) -> dict:
+    rng = np.random.default_rng(100 + i)
+    return {
+        "image": rng.integers(0, 255, (b, H, W, 4), np.uint8),
+        "xy": (rng.random((b, 8, 2)) * H).astype(np.float32),
+    }
+
+
+def _batches(n: int, delay: float = 0.0, b: int = B):
+    for i in range(n):
+        if delay:
+            time.sleep(delay)
+        yield _batch(i, b)
+
+
+# -- SampleReservoir ----------------------------------------------------------
+
+
+def test_reservoir_ring_insert_gather_deterministic():
+    res = SampleReservoir(capacity=8, augment=None)
+    rows = [_batch(i) for i in range(3)]  # 12 samples into 8 slots
+    slots = [res.insert(r) for r in rows]
+    assert slots[0].tolist() == [0, 1, 2, 3]
+    assert slots[1].tolist() == [4, 5, 6, 7]
+    assert slots[2].tolist() == [0, 1, 2, 3]  # wrapped
+    assert res.size == 8 and res.inserts == 12
+    got = res.gather(np.arange(8))
+    # slots 0-3 hold batch 2 (overwrote batch 0), 4-7 hold batch 1
+    np.testing.assert_array_equal(
+        np.asarray(got["image"][:4]), rows[2]["image"]
+    )
+    np.testing.assert_array_equal(
+        np.asarray(got["image"][4:]), rows[1]["image"]
+    )
+    np.testing.assert_array_equal(np.asarray(got["xy"][:4]), rows[2]["xy"])
+    # gather is a pure read: repeated gathers agree
+    again = res.gather(np.arange(8))
+    np.testing.assert_array_equal(
+        np.asarray(got["image"]), np.asarray(again["image"])
+    )
+
+
+def test_reservoir_donated_insert_keeps_buffers_stable():
+    """The ring is preallocated once and updated in place (donated
+    scatter): the device buffer pointer never changes across inserts —
+    no per-step reallocation of a potentially multi-GB reservoir."""
+    res = SampleReservoir(capacity=16, augment=None)
+    ptrs = set()
+    for i in range(6):
+        res.insert(_batch(i))
+        ptrs.add(res._buffers["image"].unsafe_buffer_pointer())
+    assert len(ptrs) == 1, ptrs
+
+
+def test_reservoir_validates_structure_and_trims_oversize():
+    res = SampleReservoir(capacity=4, augment=None)
+    res.insert(_batch(0))
+    with pytest.raises(ValueError, match="fields"):
+        res.insert({"image": _batch(1)["image"]})
+    with pytest.raises(ValueError, match="reservoir holds"):
+        res.insert({
+            "image": np.zeros((4, H, W, 3), np.uint8),
+            "xy": np.zeros((4, 8, 2), np.float32),
+        })
+    # an oversized batch keeps only its newest `capacity` rows
+    big = {
+        "image": np.arange(6 * H * W * 4, dtype=np.uint8).reshape(
+            6, H, W, 4
+        ),
+        "xy": np.tile(
+            np.arange(6, dtype=np.float32)[:, None, None], (1, 8, 2)
+        ),
+    }
+    slots = res.insert(big)
+    assert len(slots) == 4
+    got = res.gather(np.sort(slots))
+    assert sorted(np.asarray(got["xy"])[:, 0, 0].tolist()) == [2, 3, 4, 5]
+
+
+def test_sample_augment_decorrelates_and_replays_deterministically():
+    from blendjax.data.echo import default_echo_augment
+
+    # the photometric chain EchoingPipeline installs by default
+    res = SampleReservoir(
+        capacity=4, augment=default_echo_augment(), rng=7
+    )
+    res.insert(_batch(0))
+    a = res.sample(np.array([1, 1, 2, 2]))
+    b = res.sample(np.array([1, 1, 2, 2]))
+    # two draws of the SAME slots differ (per-draw key fold) ...
+    assert not np.array_equal(np.asarray(a["image"]), np.asarray(b["image"]))
+    # ... while the labels stay untouched by the photometric default
+    np.testing.assert_array_equal(np.asarray(a["xy"]), np.asarray(b["xy"]))
+    # and the whole sequence replays exactly for the same rng seed
+    res2 = SampleReservoir(
+        capacity=4, augment=default_echo_augment(), rng=7
+    )
+    res2.insert(_batch(0))
+    a2 = res2.sample(np.array([1, 1, 2, 2]))
+    b2 = res2.sample(np.array([1, 1, 2, 2]))
+    np.testing.assert_array_equal(
+        np.asarray(a["image"]), np.asarray(a2["image"])
+    )
+    np.testing.assert_array_equal(
+        np.asarray(b["image"]), np.asarray(b2["image"])
+    )
+
+
+def test_paired_batch_augment_keeps_points_consistent():
+    """Geometric echo augmentation must transform spatial labels WITH
+    the image: a point marking a bright pixel keeps marking it through
+    flip + crop."""
+    import functools
+
+    from blendjax.ops.augment import (
+        make_batch_augment,
+        random_crop_with_points,
+        random_flip_with_points,
+    )
+
+    rng = np.random.default_rng(3)
+    images = np.zeros((B, 16, 16, 4), np.uint8)
+    pts = np.zeros((B, 1, 2), np.float32)
+    for i in range(B):
+        x, y = rng.integers(4, 12, 2)
+        images[i, y, x] = 255
+        pts[i, 0] = (x, y)
+    aug = make_batch_augment(
+        random_flip_with_points,
+        functools.partial(random_crop_with_points, pad=2),
+        points_key="xy",
+    )
+    out = jax.jit(aug)(jax.random.key(0), {"image": images, "xy": pts})
+    oi = np.asarray(out["image"])
+    op = np.asarray(out["xy"])
+    moved = 0
+    for i in range(B):
+        x, y = np.round(op[i, 0]).astype(int)
+        if not (0 <= x < 16 and 0 <= y < 16):
+            continue  # crop pushed the point off-frame: nothing to check
+        assert oi[i, y, x, 0] == 255, (i, x, y)
+        if (x, y) != tuple(np.round(pts[i, 0]).astype(int)):
+            moved += 1
+    # at least one sample actually transformed (key 0 flips ~half)
+    assert moved >= 1
+
+
+def test_batch_augment_requires_points_key_for_paired_ops():
+    from blendjax.ops.augment import (
+        make_batch_augment,
+        random_flip_with_points,
+    )
+
+    with pytest.raises(ValueError, match="points_key"):
+        make_batch_augment(random_flip_with_points)
+    # a configured points_key whose field is missing from the batch
+    # fails AT the misconfiguration, not as an opaque jit-trace error
+    aug = make_batch_augment(random_flip_with_points, points_key="xy")
+    with pytest.raises(KeyError, match="xy"):
+        aug(jax.random.key(0), {"image": np.zeros((2, 8, 8, 4), np.uint8)})
+
+
+def test_observe_many_matches_per_sample_observes():
+    from blendjax.utils.metrics import Metrics
+
+    a, b = Metrics(), Metrics()
+    vals = np.random.default_rng(0).random(64) * 10
+    for v in vals:
+        a.observe("x", v)
+    b.observe_many("x", vals)
+    assert a.histograms()["x"] == b.histograms()["x"]
+
+
+# -- EchoingPipeline: budget + accounting -------------------------------------
+
+
+def test_echo_budget_exact_accounting_and_4x_rate():
+    """The acceptance contract: with a rate-limited producer and
+    ``max_echo_factor=8``, the pipeline emits steps at >= 4x the
+    producer frame rate (here exactly 8x: every sample is drawn
+    exactly its full budget), ``echo.fresh + echo.echoed ==
+    steps * batch`` EXACTLY, and no sample exceeds the cap."""
+    reg.reset()
+    frames = 6 * B  # 24 samples, all resident (capacity 32: no eviction)
+    with EchoingPipeline(
+        _batches(6, delay=0.02), capacity=32, max_echo_factor=8,
+        augment=None,
+    ) as pipe:
+        steps = sum(1 for _ in pipe)
+    st = pipe.stats
+    assert st["inserted"] == frames
+    assert st["steps"] == steps
+    # exact accounting, at any interleaving of drain vs draw
+    assert st["fresh"] + st["echoed"] == steps * B
+    counters = reg.report()["counters"]
+    assert counters["echo.fresh"] == st["fresh"]
+    assert counters["echo.echoed"] == st["echoed"]
+    assert counters["echo.fresh"] + counters["echo.echoed"] == steps * B
+    # every inserted sample drawn exactly its full budget -> 8x rate
+    assert steps * B == frames * 8
+    assert (pipe._use[pipe._filled] <= 8).all()
+    assert st["fresh"] == frames  # each sample fresh exactly once
+    assert st["unique_fraction"] == round(frames / (steps * B), 4)
+
+
+def test_min_fresh_fraction_honored_per_batch():
+    with EchoingPipeline(
+        _batches(10), capacity=64, max_echo_factor=4,
+        min_fresh_fraction=0.5, augment=None,
+    ) as pipe:
+        it = iter(pipe)
+        prev = 0
+        for batch in it:
+            delta = pipe.fresh - prev
+            prev = pipe.fresh
+            # the floor holds on every live batch; only the post-stream
+            # drain (inner done, fresh exhausted) may relax it
+            if not (pipe._inner_done and delta < 2):
+                assert delta >= 2, delta
+    assert pipe.fresh + pipe.echoed == pipe.steps * B
+    assert (pipe._use[pipe._filled] <= 4).all()
+
+
+def test_steps_do_not_block_while_echo_budget_remains():
+    """With one batch resident and budget left, draws proceed without a
+    single fresh frame arriving — the producer is released only after
+    the budget is spent, and only then does the loop wait."""
+    release = threading.Event()
+
+    def source():
+        yield _batch(0)
+        release.wait(timeout=10)
+        yield _batch(1)
+
+    reg.reset()
+    with EchoingPipeline(
+        source(), capacity=8, max_echo_factor=8, augment=None,
+    ) as pipe:
+        it = iter(pipe)
+        for _ in range(8):  # 4 samples x budget 8 = 8 draws of B=4
+            next(it)
+        assert pipe.stats["inserted"] == B  # never needed batch 1
+        assert pipe.stats["saturated_waits"] == 0
+        release.set()
+        next(it)  # budget spent: this draw needed fresh frames
+        assert pipe.stats["inserted"] == 2 * B
+    assert pipe.stats["saturated_waits"] >= 1
+    assert reg.report()["counters"]["echo.saturated_waits"] >= 1
+
+
+def test_stop_unblocks_a_saturated_draw_loop():
+    """stop() from another thread must terminate a consumer parked in
+    the saturated wait: the drain thread skips its _DONE sentinel once
+    stopped, so the draw loop has to watch the stop flag itself."""
+
+    def source():
+        yield _batch(0)
+        threading.Event().wait(10)  # a producer that never ends
+
+    pipe = EchoingPipeline(
+        source(), capacity=8, max_echo_factor=1, augment=None,
+    )
+    it = iter(pipe)
+    next(it)  # 4 samples x budget 1 = exactly one draw; now saturated
+    tail = []
+    t = threading.Thread(
+        target=lambda: tail.append(sum(1 for _ in it)), daemon=True
+    )
+    t.start()
+    time.sleep(0.3)  # let the consumer park in the saturated wait
+    pipe.stop()
+    t.join(timeout=5)
+    assert not t.is_alive()
+    assert tail == [0]
+
+
+def test_inner_pipeline_error_surfaces_promptly():
+    """A crashed stream must raise within the next draw or two — not
+    after the whole remaining echo budget (capacity * factor samples)
+    has been drained with the fresh floor silently relaxed."""
+
+    def source():
+        yield _batch(0)
+        raise RuntimeError("socket died")
+
+    with EchoingPipeline(
+        source(), capacity=64, max_echo_factor=1000,
+        min_fresh_fraction=0.5, augment=None,
+    ) as pipe:
+        it = iter(pipe)
+        drawn = 0
+        with pytest.raises(RuntimeError, match="socket died"):
+            for _ in it:
+                drawn += 1
+    # far below the 4 * 1000 / 4 = 1000 draws the budget would allow
+    assert drawn <= 3, drawn
+
+
+def test_partial_masked_tails_are_not_echoed():
+    reg.reset()
+
+    def source():
+        yield _batch(0)
+        yield {**_batch(1, b=2), "_mask": np.array([1, 0], np.float32)}
+
+    with EchoingPipeline(
+        source(), capacity=8, max_echo_factor=2, augment=None,
+    ) as pipe:
+        sum(1 for _ in pipe)
+    assert pipe.stats["inserted"] == B  # the masked tail was skipped
+    assert reg.report()["counters"]["echo.skipped_partial"] == 1
+
+
+# -- integration: StreamDataPipeline + TrainDriver ---------------------------
+
+
+def _items(n: int, delay: float = 0.0):
+    for i in range(n):
+        if delay:
+            time.sleep(delay)
+        rng = np.random.default_rng(i)
+        yield {
+            "image": rng.integers(0, 255, (H, W, 4), np.uint8),
+            "xy": (rng.random((8, 2)) * H).astype(np.float32),
+        }
+
+
+def test_echo_over_stream_pipeline_driver_one_dispatch_per_step():
+    """End to end: StreamDataPipeline -> EchoingPipeline ->
+    TrainDriver. Exactly ONE train dispatch per step
+    (dispatch_per_step == 1.0), zero standalone decode dispatches,
+    exact echo accounting, and the step count outruns the frame count
+    by the full echo factor."""
+    from blendjax.data import StreamDataPipeline
+    from blendjax.models import CubeRegressor
+    from blendjax.train import (
+        TrainDriver,
+        make_supervised_step,
+        make_train_state,
+    )
+
+    reg.reset()
+    s0 = make_train_state(
+        CubeRegressor(), np.zeros((B, H, W, 4), np.uint8),
+        optimizer=optax.sgd(0.01),
+    )
+    step = make_supervised_step(donate=False)
+    drv = TrainDriver(step, s0, inflight=2, sync_every=0)
+    inner = StreamDataPipeline(_items(4 * B), batch_size=B)
+    with EchoingPipeline(
+        inner, capacity=32, max_echo_factor=8,
+    ) as pipe:
+        state, final = drv.run(pipe)
+    st = pipe.stats
+    assert st["inserted"] == 4 * B
+    assert drv.stats["steps"] == st["steps"] == 4 * 8
+    assert st["fresh"] + st["echoed"] == st["steps"] * B
+    spans = reg.spans()
+    assert spans["train.dispatch"]["count"] == drv.stats["steps"]
+    assert "decode.dispatch" not in spans
+    dispatch_per_step = (
+        spans["train.dispatch"]["count"]
+        + spans.get("decode.dispatch", {}).get("count", 0)
+    ) / drv.stats["steps"]
+    assert dispatch_per_step == 1.0
+    assert "echo.insert" in spans and "echo.sample" in spans
+    assert isinstance(final, float) and np.isfinite(final)
+    assert int(state.step) == drv.stats["steps"]
+    # reservoir age histogram fed through the exact Histogram
+    hists = reg.histograms()
+    assert hists["echo.sample_age_s"]["count"] == st["steps"] * B
+
+
+def test_echoing_pipeline_rejects_packed_and_chunked_pipelines():
+    from blendjax.data import StreamDataPipeline
+
+    chunked = StreamDataPipeline(_items(4), batch_size=2, chunk=2)
+    with pytest.raises(ValueError, match="chunk=1"):
+        EchoingPipeline(chunked)
+    packed = StreamDataPipeline(_items(4), batch_size=2, emit_packed=True)
+    with pytest.raises(ValueError, match="chunk=1"):
+        EchoingPipeline(packed)
+
+
+# -- warm start ---------------------------------------------------------------
+
+
+def test_warm_start_prefills_reservoir_from_recording(tmp_path):
+    from blendjax.data import FileRecorder
+    from blendjax.transport.wire import encode_message
+
+    path = str(tmp_path / "warm.bjr")
+    with FileRecorder(path) as rec:
+        for item in _items(2 * B):
+            rec.save(encode_message(item))
+
+    blocked = threading.Event()
+
+    def live_source():
+        blocked.wait(timeout=10)
+        return
+        yield  # pragma: no cover - empty live stream
+
+    with EchoingPipeline(
+        live_source(), capacity=8, max_echo_factor=2, batch_size=B,
+        augment=None, warm_start=path,
+    ) as pipe:
+        it = iter(pipe)
+        first = next(it)  # step 0: no live frame ever arrived
+        assert np.asarray(first["image"]).shape == (B, H, W, 4)
+        assert pipe.stats["inserted"] == 2 * B
+        assert pipe.stats["reservoir_fill"] == 8
+        blocked.set()
+        rest = sum(1 for _ in it)
+    # warm samples carry the full echo budget: 8 resident x factor 2
+    assert (1 + rest) * B == 8 * 2
+    assert pipe.fresh + pipe.echoed == pipe.steps * B
+
+
+def test_warm_start_requires_batch_size():
+    with pytest.raises(ValueError, match="batch_size"):
+        iter(EchoingPipeline(iter(()), warm_start="nope.bjr"))
+
+
+# -- doctor: echo arms --------------------------------------------------------
+
+
+def _report(spans=None, counters=None, gauges=None):
+    return {
+        "spans": {
+            k: {"count": 10, "total_s": v} for k, v in (spans or {}).items()
+        },
+        "counters": counters or {},
+        "gauges": gauges or {},
+        "histograms": {},
+    }
+
+
+def test_doctor_producer_bound_echo_mitigated():
+    v = diagnose(_report(
+        spans={"ingest.queue_wait": 6.0, "train.dispatch": 1.0},
+        counters={"echo.fresh": 100, "echo.echoed": 700},
+    ))
+    assert v.kind == "producer-bound"
+    assert "echo-mitigated" in v.reason
+    assert "8.0x" in v.reason or "8.0" in v.reason
+    assert "fresh-data diversity" in v.advice
+
+
+def test_doctor_echo_saturated_on_budget_exhaustion():
+    v = diagnose(_report(
+        spans={"ingest.queue_wait": 6.0, "train.dispatch": 1.0},
+        counters={"echo.fresh": 100, "echo.echoed": 700,
+                  "echo.saturated_waits": 5},
+    ))
+    assert v.kind == "echo-saturated"
+    assert "raise producer" in v.advice
+    # the echoing loop's own starvation span is sufficient evidence
+    # even when the inner consumer's queue_wait share is small
+    v2 = diagnose(_report(
+        spans={"echo.wait_fresh": 6.0, "train.dispatch": 1.0},
+        counters={"echo.fresh": 10, "echo.echoed": 70},
+    ))
+    assert v2.kind == "echo-saturated"
+
+
+def test_doctor_plain_producer_bound_unchanged_without_echo():
+    v = diagnose(_report(
+        spans={"ingest.queue_wait": 6.0, "train.dispatch": 1.0},
+    ))
+    assert v.kind == "producer-bound"
+    assert "echo-mitigated" not in v.reason
+    # ... and now points at the echo lever
+    assert "EchoingPipeline" in v.advice

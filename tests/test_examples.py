@@ -74,6 +74,18 @@ def test_datagen_train_pal_chunk(monkeypatch, capsys):
     assert "step 0: loss=" in out and "images/sec" in out
 
 
+def test_datagen_train_echo(monkeypatch, capsys):
+    run_main(
+        monkeypatch, "datagen/train.py",
+        "--steps", "6", "--instances", "1", "--batch", "8",
+        "--shape", "64", "64", "--echo", "4", "--echo-capacity", "32",
+    )
+    out = capsys.readouterr().out
+    assert "step 0: loss=" in out and "images/sec" in out
+    assert "echo={" in out and "'fresh':" in out
+    assert "doctor:" in out
+
+
 def test_datagen_train_record_then_replay(monkeypatch, capsys, tmp_path):
     prefix = str(tmp_path / "rec")
     run_main(
