@@ -73,6 +73,19 @@ LIVE_OVERLAP = os.environ.get("BLENDJAX_BENCH_LIVE_OVERLAP", "1") == "1"
 LIVE_OVERLAP_INFLIGHT = int(
     os.environ.get("BLENDJAX_BENCH_LIVE_OVERLAP_INFLIGHT", "4")
 )
+# Distributed frame tracing (blendjax.obs.trace): producers stamp every
+# Nth message with a `_trace` context the consumer stages append to;
+# driver rows complete the records at step retirement and report them
+# under stages["trace"]. Smaller than the library default (64) because
+# a bench window is short; bench-smoke shrinks it further so at least
+# one sampled frame completes end-to-end inside the tiny CI window
+# (CI-asserted). 0 disables stamping.
+TRACE_EVERY = int(os.environ.get("BLENDJAX_BENCH_TRACE_EVERY", "8"))
+# Optional Chrome-trace export of the completed frame traces (flow
+# arrows producer lane -> consumer lanes): written after each driver
+# row that completed records, so the file holds the LAST such row's
+# window (the artifact bench-smoke uploads).
+TRACE_EXPORT = os.environ.get("BLENDJAX_BENCH_TRACE_EXPORT", "")
 # Data-echoing A/B row (docs/performance.md "Echoing past a
 # producer-bound pipeline"): echo off vs max_echo_factor in {4, 16} on
 # the live stream — live img/s INTO the step, unique fraction, final
@@ -308,6 +321,7 @@ def measure(encoding: str, chunk: int, items: int, time_cap: float,
     )
     from blendjax.obs import diagnose
     from blendjax.obs.lineage import lineage
+    from blendjax.obs.trace import tracer
     from blendjax.utils.metrics import metrics as reg
 
     tile_args = (
@@ -339,11 +353,16 @@ def measure(encoding: str, chunk: int, items: int, time_cap: float,
     if driver_inflight is not None:
         # Async overlap path: fused decode+step (one dispatch per step)
         # with up to `inflight` dispatches outstanding. inflight=1 is
-        # the serialized A/B baseline on the identical program.
+        # the serialized A/B baseline on the identical program. On v5e
+        # the driver also maintains the live train.mfu gauge (the
+        # always-on version of this file's bench-time MFU rows).
+        fpi = _live_flops_per_image(model, loss_fn)
         step = make_fused_tile_step(loss_fn=loss_fn)
         driver = TrainDriver(
             step, state, inflight=driver_inflight,
             sync_every=driver_sync_every,
+            flops_per_image=fpi,
+            peak_flops=V5E_PEAK_FLOPS if fpi else None,
         )
     elif chunk > 1 and FUSED:
         step = make_fused_tile_step(loss_fn=loss_fn)
@@ -381,7 +400,8 @@ def measure(encoding: str, chunk: int, items: int, time_cap: float,
         instance_args=[
             ["--shape", str(SHAPE[0]), str(SHAPE[1]), "--batch", str(BATCH),
              "--encoding", encoding, "--tile", *tile_args, "--tile-rgba",
-             "--tile-capacity", tile_capacity]
+             "--tile-capacity", tile_capacity,
+             "--trace-every", str(TRACE_EVERY)]
         ] * instances,
     ) as launcher:
         def batch_images(sb):
@@ -451,6 +471,7 @@ def measure(encoding: str, chunk: int, items: int, time_cap: float,
 
             reg.reset()  # stage spans cover the measured window only
             lineage.reset()  # staleness/gap lineage too (same window)
+            tracer.reset()  # completed frame traces too (same window)
             drv0 = dict(driver.stats) if driver is not None else None
             images = 0
             t_next = t_step = 0.0
@@ -574,12 +595,37 @@ def measure(encoding: str, chunk: int, items: int, time_cap: float,
                 k: v for k, v in report["gauges"].items()
                 if k.startswith(("ingest.", "feed.", "train.", "echo."))
             },
+            # Observe-only histograms (spans already carry their own
+            # percentiles above): the driver's device-timeline step
+            # histogram, trace transitions, staleness, echo ages.
+            "histograms": {
+                k: {
+                    "count": v["count"],
+                    "p50": round(v["p50"], 4),
+                    "p95": round(v["p95"], 4),
+                    "p99": round(v["p99"], 4),
+                    "max": round(v["max"], 4),
+                }
+                for k, v in report["histograms"].items()
+                if k.startswith(("train.", "trace.", "wire.", "echo."))
+            },
             # Per-producer frame lineage: e2e staleness percentiles,
             # exact seq gap/reorder counts, latest piggybacked producer
             # telemetry (render span, publish rate) — the fleet view.
             "lineage": lineage_report,
             "doctor": verdict.render(),
+            # Distributed frame traces completed inside the measured
+            # window (driver rows only — completion happens at step
+            # retirement): per-transition percentiles, end-to-end stage
+            # completeness, mono ordering. Non-driver rows report
+            # completed == 0 (their sampled contexts never reach a
+            # terminal stage).
+            "trace": tracer.report(),
         }
+        if TRACE_EXPORT and tracer.records():
+            from blendjax.obs.exporters import write_chrome_trace
+
+            write_chrome_trace(TRACE_EXPORT)
     return result
 
 
@@ -781,6 +827,27 @@ def measure_pipelined_ceiling(chunk: int, items: int = 512,
 # public spec) — the denominator weather can't move (VERDICT r3 next
 # #7: a FLOPs-based MFU row beside the throughput-ratio utilization).
 V5E_PEAK_FLOPS = 197e12
+
+
+_FLOPS_MEMO: dict = {}
+
+
+def _live_flops_per_image(model, loss_fn) -> float | None:
+    """``flops_per_image`` for a live driver's ``train.mfu`` gauge,
+    memoized per model class (one extra lowering per class per bench
+    run); None off-v5e (the gauge's peak denominator is chip-specific)
+    or when the cost analysis fails."""
+    if not _is_v5e():
+        return None
+    key = type(model).__name__
+    if key not in _FLOPS_MEMO:
+        try:
+            _FLOPS_MEMO[key] = measure_model_flops(
+                model=model, loss_fn=loss_fn, label=key
+            )["flops_per_image"]
+        except Exception:
+            _FLOPS_MEMO[key] = None
+    return _FLOPS_MEMO[key]
 
 
 def _is_v5e() -> bool:
@@ -1037,6 +1104,13 @@ def measure_live_overlap(chunk: int, items: int | None = None,
             "decode_dispatch_count": decode_calls,
             "train_dispatch_count": train_calls,
         }
+        if n != 1:
+            # the inflight-N leg's completed frame traces (driver rows
+            # retire every submitted batch, so a sampled frame that
+            # reached the step is guaranteed to complete) — the
+            # bench-smoke CI job asserts end-to-end completeness and
+            # monotonic stage ordering on this report
+            row["trace"] = leg.get("stages", {}).get("trace")
     one, many = row["inflight1"], row[f"inflight{inflight}"]
     row["decode_dispatch_eliminated"] = (
         one["decode_dispatch_count"] == 0
@@ -1090,14 +1164,22 @@ def measure_live_echo(items: int | None = None, time_cap: float = 25.0,
     mesh = create_mesh({"data": -1})
     sharding = batch_sharding(mesh)
 
+    from blendjax.obs.trace import tracer
+
     def leg(factor: int | None) -> dict:
         reg.reset()
+        tracer.reset()
         state = make_train_state(
             CubeRegressor(), np.zeros((BATCH, *SHAPE, 4), np.uint8),
             mesh=mesh,
         )
         step = make_supervised_step(mesh=mesh, batch_sharding=sharding)
-        driver = TrainDriver(step, state, inflight=inflight, sync_every=16)
+        fpi = _live_flops_per_image(CubeRegressor(), None)
+        driver = TrainDriver(
+            step, state, inflight=inflight, sync_every=16,
+            flops_per_image=fpi,
+            peak_flops=V5E_PEAK_FLOPS if fpi else None,
+        )
         with PythonProducerLauncher(
             script=producer, num_instances=1, named_sockets=["DATA"],
             seed=0, proto="ipc",
@@ -1105,7 +1187,8 @@ def measure_live_echo(items: int | None = None, time_cap: float = 25.0,
                 ["--shape", str(SHAPE[0]), str(SHAPE[1]),
                  "--batch", str(BATCH), "--encoding", ENCODING,
                  "--tile", *_TILE_ARGS, "--tile-rgba",
-                 "--tile-capacity", TILE_CAPACITY]
+                 "--tile-capacity", TILE_CAPACITY,
+                 "--trace-every", str(TRACE_EVERY)]
             ],
         ) as launcher:
             pipe = StreamDataPipeline(
@@ -1158,6 +1241,11 @@ def measure_live_echo(items: int | None = None, time_cap: float = 25.0,
             "host_blocks": driver.stats["host_blocks"]
             - drv0["host_blocks"],
         }
+        # Frame traces that completed in this leg (echo legs carry the
+        # full recv -> decode -> reservoir -> step chain; sampled
+        # frames that die unechoed in the reservoir simply don't
+        # complete — expected for sampled tracing).
+        out["trace"] = tracer.report()
         if echo is not None:
             st = echo.stats
             fresh = st["fresh"] - e0["fresh"]

@@ -10,5 +10,6 @@ from blendjax.analysis.rules import (  # noqa: F401  (registration side effects)
     purity,
     reservoir_sync,
     resource_leak,
+    wall_clock,
     zmq_affinity,
 )

@@ -17,6 +17,7 @@ import time
 import numpy as np
 
 from blendjax.data.schema import StreamSchema
+from blendjax.obs.trace import TRACE_KEY, TRACES_KEY, stage as trace_stage
 from blendjax.utils.logging import get_logger
 from blendjax.utils.metrics import metrics
 
@@ -270,6 +271,10 @@ class HostIngest:
         # consumers that handle variable leading dims should ask for it.
         self.emit_partial_final = bool(emit_partial_final)
         self._queue: queue.Queue = queue.Queue(maxsize=prefetch)
+        # Sampled frame-trace contexts popped off items since the last
+        # emitted batch; they ride the next batch dict under `_traces`
+        # (single ingest thread — no lock needed).
+        self._pending_traces: list = []
         self._warned_prebatch = False
         self._error: BaseException | None = None
         self._thread: threading.Thread | None = None
@@ -287,6 +292,9 @@ class HostIngest:
         return passthrough_batch(item, self.schema, self.batch_size)
 
     def _emit(self, batch) -> None:
+        if self._pending_traces:
+            batch[TRACES_KEY] = self._pending_traces
+            self._pending_traces = []
         # Occupancy gauge pair: the instantaneous depth plus its
         # high-water mark, so bench output can tell backpressure (queue
         # pinned at `prefetch`, producers outrunning the consumer) from
@@ -321,6 +329,14 @@ class HostIngest:
                         break
                 if self._stop.is_set():
                     break
+                # Frame trace: pop the sampled context BEFORE schema
+                # inference/validation sees the item (it is a publish
+                # stamp, not a data field) and stamp the hand-off to
+                # batch assembly; it rides the next emitted batch.
+                tr = item.pop(TRACE_KEY, None)
+                if tr is not None:
+                    trace_stage(tr, "batch")
+                    self._pending_traces.append(tr)
                 if item.pop("_prebatched", False):
                     # Opaque producer-assembled batch (e.g. tile-delta
                     # messages, whose per-batch field shapes vary with

@@ -52,6 +52,11 @@ import time
 
 import numpy as np
 
+from blendjax.obs.trace import (
+    TRACES_KEY,
+    pop_traces as trace_pop,
+    stage as trace_stage,
+)
 from blendjax.utils.logging import get_logger
 from blendjax.utils.metrics import metrics
 
@@ -331,6 +336,11 @@ class EchoingPipeline:
         self._use = np.zeros(self.capacity, np.int64)
         self._t_insert = np.zeros(self.capacity, np.float64)
         self._filled = np.zeros(self.capacity, bool)
+        # Sampled frame traces parked while their batch sits in the
+        # reservoir: keyed by the batch's first slot, delivered (once)
+        # on the first draw touching that slot. Tiny — one entry per
+        # traced batch still resident.
+        self._slot_traces: dict = {}
         self._queue: queue.Queue = queue.Queue(maxsize=2)
         self._thread: threading.Thread | None = None
         self._stop = threading.Event()
@@ -415,7 +425,22 @@ class EchoingPipeline:
             )
         if self.batch_size is None:
             self.batch_size = int(lead)
+        trs = trace_pop(batch)
         slots = self.reservoir.insert(fields)
+        if self._slot_traces:
+            # Overwritten slots evict any still-parked trace with their
+            # frame (it will never complete — sampled tracing accepts
+            # losing frames that die in the reservoir).
+            for s in slots:
+                self._slot_traces.pop(int(s), None)
+        if trs:
+            for tr in trs:
+                trace_stage(tr, "reservoir_insert")
+            # insert() returns HOST numpy indices by contract (that is
+            # its whole point — sync-free accounting), so this int() is
+            # a host int of a host value, not a device fetch.
+            # bjx: ignore[BJX108]
+            self._slot_traces[int(slots[0])] = trs
         self._use[slots] = 0
         self._t_insert[slots] = time.monotonic()
         self._filled[slots] = True
@@ -544,6 +569,19 @@ class EchoingPipeline:
                 continue
             waiting = False
             batch = self.reservoir.sample(idx)
+            if self._slot_traces:
+                # First draw touching a traced batch's anchor slot
+                # releases its traces into the emitted batch (host dict
+                # ops only — no device values involved).
+                out_traces = []
+                for s in set(int(i) for i in idx):
+                    trs = self._slot_traces.pop(s, None)
+                    if trs:
+                        out_traces.extend(trs)
+                if out_traces:
+                    for tr in out_traces:
+                        trace_stage(tr, "reservoir_sample")
+                    batch[TRACES_KEY] = out_traces
             # Accounting runs on the HOST index vector — the device
             # batch is never materialized here (BJX108). idx is host
             # numpy from _compose_draw, so these int()s are not device

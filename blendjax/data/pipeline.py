@@ -20,6 +20,7 @@ import threading
 
 import numpy as np
 
+from blendjax.obs.trace import TRACES_KEY, stamp_batch as trace_stamp_batch
 from blendjax.utils.logging import get_logger
 from blendjax.utils.metrics import metrics
 
@@ -94,9 +95,9 @@ class DeviceFeeder:
         jax = _require_jax()
         out = {}
         for k, v in batch.items():
-            if k == "_meta" or isinstance(v, (int, float)) or getattr(
-                v, "ndim", -1
-            ) == 0:
+            if k in ("_meta", TRACES_KEY) or isinstance(
+                v, (int, float)
+            ) or getattr(v, "ndim", -1) == 0:
                 # Host-side sidecars: per-item provenance and scalars —
                 # plain ints AND rank-0 numpy values (the wire codec
                 # preserves either form of a producer's ``btid`` stamp)
@@ -197,6 +198,9 @@ class DeviceFeeder:
                         jax.block_until_ready(oldest)
             with metrics.span("feed.place"):
                 db = self._place(hb)
+            # Frame trace: the host->device transfer was dispatched for
+            # every field of this batch (fast no-op when untraced).
+            trace_stamp_batch(db, "place")
             if self.throttle:
                 window.append(self._largest(db))
             return db
@@ -898,6 +902,7 @@ class TileStreamDecoder:
                 fields.update(rest)
                 if meta is not None:
                     fields["_meta"] = meta
+                trace_stamp_batch(fields, "decode")
                 yield fields
                 continue
             if plan is not None and plan[0] == "mhchunk":
@@ -909,6 +914,7 @@ class TileStreamDecoder:
                     )
                 self._pin_superbatch(fields)
                 fields["_meta"] = rests
+                trace_stamp_batch(fields, "decode")
                 yield fields
                 continue
             if plan is not None and plan[0] == "pal":
@@ -928,6 +934,7 @@ class TileStreamDecoder:
                         fields[k] = jax.device_put(v, s)
                 db.update(rest)
                 db.update(fields)
+                trace_stamp_batch(db, "decode")
                 yield db
                 continue
             if plan is not None and plan[0] == "palchunk":
@@ -953,6 +960,7 @@ class TileStreamDecoder:
                 self._pin_superbatch(fields)
                 db["_meta"] = rests
                 db.update(fields)
+                trace_stamp_batch(db, "decode")
                 yield db
                 continue
             if plan is not None and plan[0] == "raw1":
@@ -987,6 +995,7 @@ class TileStreamDecoder:
                 self._pin_superbatch(fields)
                 db["_meta"] = rests
                 db.update(fields)
+                trace_stamp_batch(db, "decode")
                 yield db
                 continue
             if plan is not None:
@@ -1011,6 +1020,7 @@ class TileStreamDecoder:
                         fields[k] = jax.device_put(v, s)
                 db.update(rest)
                 db.update(fields)
+                trace_stamp_batch(db, "decode")
             yield db
 
 

@@ -51,6 +51,7 @@ from blendjax.data.batcher import (
     prebatched_lead,
 )
 from blendjax.data.schema import StreamSchema
+from blendjax.obs.trace import TRACE_KEY, TRACES_KEY, stage as trace_stage
 from blendjax.utils.logging import get_logger
 from blendjax.utils.metrics import metrics
 
@@ -62,13 +63,18 @@ class _PendingBatch:
     countdown. Slots fill concurrently and out of order; the writer that
     completes the last slot emits the batch."""
 
-    __slots__ = ("buffers", "meta", "remaining", "lock")
+    __slots__ = ("buffers", "meta", "remaining", "lock", "traces")
 
     def __init__(self, buffers: dict, batch_size: int):
         self.buffers = buffers
         self.meta: list = [None] * batch_size
         self.remaining = batch_size
         self.lock = threading.Lock()
+        # Sampled frame-trace contexts riding this batch. Every append
+        # happens between a writer's reserve() and its write(), so all
+        # appends happen-before the completing write observes
+        # remaining == 0 — the completed batch carries every trace.
+        self.traces: list = []
 
 
 class ParallelBatchAssembler:
@@ -134,6 +140,8 @@ class ParallelBatchAssembler:
             return None
         batch = dict(pending.buffers)
         batch["_meta"] = pending.meta
+        if pending.traces:
+            batch[TRACES_KEY] = pending.traces
         return batch
 
     def add(self, item: dict):
@@ -158,6 +166,8 @@ class ParallelBatchAssembler:
         }
         batch["_meta"] = pending.meta[:filled]
         batch["_partial"] = True
+        if pending.traces:
+            batch[TRACES_KEY] = pending.traces
         return batch
 
 
@@ -287,6 +297,12 @@ class ShardedHostIngest:
         return self._assembler
 
     def _consume(self, idx: int, item: dict) -> None:
+        # Frame trace: pop the sampled context before schema machinery
+        # sees the item, stamp the batch hand-off, and attach it to
+        # whatever batch this item lands in below.
+        tr = item.pop(TRACE_KEY, None)
+        if tr is not None:
+            trace_stage(tr, "batch")
         if item.pop("_prebatched", False):
             lead = prebatched_lead(item)
             if lead != self.batch_size and not self._warned_prebatch:
@@ -299,6 +315,8 @@ class ShardedHostIngest:
                 )
             self._shard_items[idx] += lead
             metrics.count("ingest.items", lead)
+            if tr is not None:
+                item[TRACES_KEY] = [tr]
             self._emit(idx, item)
             return
         batched = bool(item.pop("_batched", False))
@@ -310,6 +328,8 @@ class ShardedHostIngest:
             if whole is not None:
                 self._shard_items[idx] += self.batch_size
                 metrics.count("ingest.items", self.batch_size)
+                if tr is not None:
+                    whole[TRACES_KEY] = [tr]
                 self._emit(idx, whole)
                 return
             items = batched_views(item)  # size mismatch: split
@@ -321,6 +341,12 @@ class ShardedHostIngest:
             self._shard_items[idx] += 1
             metrics.count("ingest.items")
             pending, slot = assembler.reserve()
+            if tr is not None:
+                # attach once, to the batch holding this item's first
+                # slot (the trace describes the message, not a row)
+                with pending.lock:
+                    pending.traces.append(tr)
+                tr = None
             batch = assembler.write(pending, slot, one)
             if batch is not None:
                 self._emit(idx, batch)

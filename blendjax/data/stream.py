@@ -18,6 +18,7 @@ import time
 from blendjax import constants
 from blendjax.data.replay import FileRecorder
 from blendjax.obs.lineage import lineage
+from blendjax.obs.trace import TRACE_KEY, stage as trace_stage
 from blendjax.transport import DataReceiverSocket, ReceiveTimeoutError
 from blendjax.utils.logging import get_logger
 
@@ -206,6 +207,12 @@ class RemoteStream:
                 # numbering lands whole on one shard socket, so
                 # round-robin partitioning can't fake a gap.
                 lineage.ingest(msg, track_gaps=self.track_gaps)
+                # Distributed frame trace: stamp the consumer-side
+                # arrival on the sampled subset (one dict lookup per
+                # message off the sampled path — no allocations).
+                tr = msg.get(TRACE_KEY)
+                if tr is not None:
+                    trace_stage(tr, "recv")
                 yield self.item_transform(msg)
                 n += 1
         finally:

@@ -23,6 +23,7 @@ import torch.utils.data as tud
 
 from blendjax import constants
 from blendjax.data.stream import RemoteStream
+from blendjax.obs.trace import TRACE_KEY
 
 
 class RemoteIterableDataset(tud.IterableDataset):
@@ -83,6 +84,11 @@ class RemoteIterableDataset(tud.IterableDataset):
         transform = self.item_transform or (lambda x: x)
         consecutive_skips = 0
         for msg in stream:
+            # Sampled frame-trace contexts end here: a torch consumer
+            # has no terminal stage to complete the record, and torch's
+            # default_collate requires uniform keys across items (one
+            # stamped item in a batch raises KeyError).
+            msg.pop(TRACE_KEY, None)
             batched = bool(msg.pop("_batched", False)) | bool(
                 msg.pop("_prebatched", False)
             )

@@ -13,7 +13,16 @@ The observability layer over the streaming stack (docs/observability.md):
   span events.
 - :mod:`blendjax.obs.reporter` — ``StatsReporter``, the background
   thread that logs a doctor verdict (and optionally archives
-  snapshots) on an interval.
+  snapshots) on an interval — and, with SLOs configured, evaluates
+  them each tick and triggers the flight recorder on breach.
+- :mod:`blendjax.obs.trace` — distributed frame tracing: sampled
+  ``_trace`` contexts stamped producer-side ride each frame through
+  recv → batch → decode → (reservoir) → step; the collector turns
+  completed records into per-transition histograms and cross-process
+  Chrome-trace lanes with flow arrows.
+- :mod:`blendjax.obs.watchdog` — declarative ``Slo`` rules evaluated
+  per reporter tick with sustained-breach windows, plus the
+  ``FlightRecorder`` that dumps a bounded evidence bundle on breach.
 
 Import-cheap by design: nothing here pulls jax, zmq, or numpy, so
 producer processes (Blender's Python) can export their own metrics.
@@ -42,8 +51,24 @@ from blendjax.obs.lineage import (  # noqa: F401
     strip_stamps,
 )
 from blendjax.obs.reporter import StatsReporter  # noqa: F401
+from blendjax.obs.trace import (  # noqa: F401
+    TRACE_KEY,
+    FrameTraceCollector,
+    tracer,
+)
+from blendjax.obs.watchdog import (  # noqa: F401
+    FlightRecorder,
+    Slo,
+    SloWatchdog,
+)
 
 __all__ = [
+    "TRACE_KEY",
+    "FrameTraceCollector",
+    "tracer",
+    "FlightRecorder",
+    "Slo",
+    "SloWatchdog",
     "DEFAULT_STALE_WIRE_S",
     "VERDICTS",
     "Verdict",

@@ -143,6 +143,9 @@ class _Handler(BaseHTTPRequestHandler):
     server: "MetricsHTTPServer"
 
     def do_GET(self):  # noqa: N802 (http.server API)
+        if self.path.split("?", 1)[0] == "/healthz":
+            self._serve_health()
+            return
         try:
             body = prometheus_text(registry=self.server.registry).encode()
         except Exception as e:  # never take the scrape target down
@@ -156,6 +159,31 @@ class _Handler(BaseHTTPRequestHandler):
         self.end_headers()
         self.wfile.write(body)
 
+    def _serve_health(self) -> None:
+        """``/healthz``: 200 when the configured health source says
+        healthy (or when none is configured — an exporter without SLOs
+        is a metrics endpoint, not a judge), 503 on an active SLO
+        breach. The body is the health source's full state as JSON, so
+        a fleet controller gets the breaching rules, not just a bit."""
+        health = self.server.health
+        try:
+            state = health() if callable(health) else None
+        except Exception as e:
+            self.send_response(500)
+            self.end_headers()
+            self.wfile.write(repr(e).encode())
+            return
+        if state is None:
+            state = {"healthy": True, "slo": "unconfigured"}
+        elif not isinstance(state, dict):
+            state = {"healthy": bool(state)}
+        body = json.dumps(state, default=str).encode()
+        self.send_response(200 if state.get("healthy", True) else 503)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
     def log_message(self, *args):  # silence per-scrape stderr spam
         del args
 
@@ -163,14 +191,17 @@ class _Handler(BaseHTTPRequestHandler):
 class MetricsHTTPServer(ThreadingHTTPServer):
     """Prometheus scrape target on a daemon thread. ``port=0`` picks a
     free port; read it back from :attr:`port`. Close with
-    :meth:`close`."""
+    :meth:`close`. ``health`` is an optional zero-arg callable (e.g.
+    ``StatsReporter.health``) returning a dict with a ``healthy`` key:
+    it backs the ``/healthz`` endpoint (200/503) beside ``/metrics``."""
 
     daemon_threads = True
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
-                 registry: Metrics = metrics):
+                 registry: Metrics = metrics, health=None):
         super().__init__((host, port), _Handler)
         self.registry = registry
+        self.health = health
         self.port = self.server_address[1]
         self._thread = threading.Thread(
             target=self.serve_forever, name="blendjax-metrics-http",
@@ -187,17 +218,31 @@ class MetricsHTTPServer(ThreadingHTTPServer):
 
 
 def start_http_exporter(port: int = 0, host: str = "127.0.0.1",
-                        registry: Metrics = metrics) -> MetricsHTTPServer:
-    """``curl http://host:port/metrics`` while the pipeline runs."""
-    return MetricsHTTPServer(host=host, port=port, registry=registry).start()
+                        registry: Metrics = metrics,
+                        health=None) -> MetricsHTTPServer:
+    """``curl http://host:port/metrics`` (and ``/healthz``, when a
+    ``health`` source is given) while the pipeline runs."""
+    return MetricsHTTPServer(
+        host=host, port=port, registry=registry, health=health
+    ).start()
 
 
 class JsonlExporter:
     """Append timestamped report snapshots to a JSONL file (one JSON
-    object per line; safe to tail while the run is live)."""
+    object per line; safe to tail while the run is live).
 
-    def __init__(self, path: str):
+    ``rotate_bytes`` bounds the archive: once the file reaches that
+    size it is rotated to ``<path>.1`` (older generations shift to
+    ``.2`` … ``.<keep>``, the oldest deleted), so a long run's
+    ``run_stats.jsonl`` can no longer grow without limit. ``None``
+    (the default here; :class:`blendjax.obs.reporter.StatsReporter`
+    turns rotation on) keeps the historical append-forever behavior."""
+
+    def __init__(self, path: str, rotate_bytes: int | None = None,
+                 keep: int = 3):
         self.path = path
+        self.rotate_bytes = int(rotate_bytes) if rotate_bytes else None
+        self.keep = max(1, int(keep))
         d = os.path.dirname(os.path.abspath(path))
         os.makedirs(d, exist_ok=True)
         self._lock = threading.Lock()
@@ -211,17 +256,39 @@ class JsonlExporter:
         if extra:
             rec.update(extra)
         line = json.dumps(rec, default=str)
-        with self._lock, open(self.path, "a", encoding="utf-8") as f:
-            f.write(line + "\n")
+        with self._lock:
+            with open(self.path, "a", encoding="utf-8") as f:
+                f.write(line + "\n")
+                size = f.tell()
+            if self.rotate_bytes and size >= self.rotate_bytes:
+                self._rotate_locked()
+
+    def _rotate_locked(self) -> None:
+        # shift .1 -> .2 ... .<keep-1> -> .<keep> (overwriting the
+        # oldest), then the live file becomes .1 — a fresh append
+        # starts the next generation.
+        for i in range(self.keep - 1, 0, -1):
+            src = f"{self.path}.{i}"
+            if os.path.exists(src):
+                os.replace(src, f"{self.path}.{i + 1}")
+        os.replace(self.path, f"{self.path}.1")
 
 
 def chrome_trace(events: list | None = None,
-                 registry: Metrics = metrics) -> dict:
+                 registry: Metrics = metrics,
+                 frame_traces=None) -> dict:
     """Span events → a Chrome trace object (``traceEvents`` with
     ``ph: "X"`` complete events, microsecond timestamps on the
     ``perf_counter`` clock). Load in ui.perfetto.dev beside a
     ``jax.profiler`` trace of the same window to line host-side ingest
-    stages up with device activity."""
+    stages up with device activity.
+
+    Completed distributed frame traces (:mod:`blendjax.obs.trace`) are
+    merged in as cross-process lanes with producer→consumer flow
+    arrows: pass a :class:`~blendjax.obs.trace.FrameTraceCollector` as
+    ``frame_traces``, or leave the default — exporting the process-wide
+    registry pulls the process-wide ``tracer`` in automatically
+    (``frame_traces=False`` opts out)."""
     if events is None:
         events = registry.span_events()
     pid = os.getpid()
@@ -237,15 +304,21 @@ def chrome_trace(events: list | None = None,
         }
         for name, t0, dur, tid in events
     ]
+    if frame_traces is None and registry is metrics:
+        from blendjax.obs.trace import tracer as frame_traces
+    if frame_traces:
+        trace_events.extend(frame_traces.chrome_events())
     return {"traceEvents": trace_events, "displayTimeUnit": "ms"}
 
 
 def write_chrome_trace(path: str, events: list | None = None,
-                       registry: Metrics = metrics) -> int:
+                       registry: Metrics = metrics,
+                       frame_traces=None) -> int:
     """Write the Chrome trace JSON; returns the event count. Requires
     event recording to have been on (``metrics.enable_span_events()``)
-    — without it the trace is valid but empty."""
-    obj = chrome_trace(events, registry=registry)
+    or completed frame traces in the collector — without either the
+    trace is valid but empty."""
+    obj = chrome_trace(events, registry=registry, frame_traces=frame_traces)
     d = os.path.dirname(os.path.abspath(path))
     os.makedirs(d, exist_ok=True)
     with open(path, "w", encoding="utf-8") as f:

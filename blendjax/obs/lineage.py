@@ -38,6 +38,11 @@ from __future__ import annotations
 import threading
 import time
 
+# The sampled frame-trace context is a publish stamp too: strip_stamps
+# removes it on replay — recorded wall stamps would read as hours of
+# wire latency in the trace histograms. Imported from its defining
+# module so a rename can never desynchronize the strip list.
+from blendjax.obs.trace import TRACE_KEY
 from blendjax.utils.metrics import Histogram, metrics
 
 # Wire keys (stamped by DataPublisherSocket, popped here). Underscored
@@ -48,7 +53,8 @@ PUB_WALL_KEY = "_pub_wall"
 PUB_MONO_KEY = "_pub_mono"
 TELEMETRY_KEY = "_telemetry"
 
-_STAMP_KEYS = (SEQ_KEY, PUB_WALL_KEY, PUB_MONO_KEY, TELEMETRY_KEY)
+_STAMP_KEYS = (SEQ_KEY, PUB_WALL_KEY, PUB_MONO_KEY, TELEMETRY_KEY,
+               TRACE_KEY)
 
 
 def strip_stamps(msg: dict) -> dict:

@@ -5,9 +5,20 @@ stall doctor over it, logs the one-line verdict, and (optionally)
 appends the full snapshot to a JSONL archive — the always-on version of
 what ``bench.py`` stamps into its stage breakdowns, for long training
 runs that never go through the bench harness.
+
+Since the SLO watchdog landed the reporter is also the evaluation
+cadence for declarative health rules: pass ``slos=[...]`` (specs or
+:class:`~blendjax.obs.watchdog.Slo` objects) and each tick checks them
+against the fresh snapshot; a sustained breach triggers the
+:class:`~blendjax.obs.watchdog.FlightRecorder` (``flight_dir=...``)
+with the reporter's last-K history ring as evidence, and
+:meth:`health` backs the HTTP exporter's ``/healthz`` (200/503).
 """
 
 from __future__ import annotations
+
+import collections
+import time
 
 import threading
 
@@ -20,17 +31,31 @@ from blendjax.utils.logging import get_logger
 
 logger = get_logger("obs")
 
+# Default JSONL archive bound: ~64 MiB per generation, 3 generations
+# kept. A 10s-tick run writes a few KB per line, so this is weeks of
+# history — while an unbounded archive on a long-lived trainer is a
+# disk-full incident waiting (the pre-rotation behavior).
+DEFAULT_ROTATE_BYTES = 64 * 1024 * 1024
+
 
 class StatsReporter:
-    """Periodic doctor verdict + optional JSONL snapshot archive.
+    """Periodic doctor verdict + optional JSONL snapshot archive,
+    SLO evaluation, and breach-triggered flight recording.
 
-    >>> rep = StatsReporter(interval_s=10, jsonl_path="run_stats.jsonl")
+    >>> rep = StatsReporter(
+    ...     interval_s=10, jsonl_path="run_stats.jsonl",
+    ...     slos=["rate(wire.seq_gaps) == 0",
+    ...           "p95(wire.e2e_staleness_s) <= 0.5 @ 30"],
+    ...     flight_dir="flight-records",
+    ... )
     >>> rep.start()
-    ... # train ...
+    ... # train ...  (serve rep.health via start_http_exporter(health=...))
     >>> rep.stop()
 
     ``driver_stats`` may be a zero-arg callable returning a
     ``TrainDriver.stats`` dict so ring-full blocks feed the diagnosis.
+    ``history`` bounds the ring of recent (snapshot, verdict) pairs the
+    flight recorder dumps on a breach.
     """
 
     def __init__(
@@ -41,13 +66,43 @@ class StatsReporter:
         jsonl_path: str | None = None,
         driver_stats=None,
         log=logger,
+        slos=None,
+        flight_dir: str | None = None,
+        flight_profile_s: float = 0.0,
+        history: int = 32,
+        jsonl_rotate_bytes: int | None = DEFAULT_ROTATE_BYTES,
+        jsonl_keep: int = 3,
     ):
         self.interval_s = float(interval_s)
         self.registry = registry
         self.lineage = lineage
         self.driver_stats = driver_stats
         self.log = log
-        self._jsonl = JsonlExporter(jsonl_path) if jsonl_path else None
+        self._jsonl = (
+            JsonlExporter(
+                jsonl_path, rotate_bytes=jsonl_rotate_bytes,
+                keep=jsonl_keep,
+            )
+            if jsonl_path else None
+        )
+        # Last-K (snapshot, verdict) ring — always on (cheap: K dict
+        # refs), so a flight record has history even when the breach
+        # lands on the first watchdog tick after a long healthy run.
+        self.history: collections.deque = collections.deque(
+            maxlen=max(1, int(history))
+        )
+        self.watchdog = None
+        if slos:
+            from blendjax.obs.watchdog import SloWatchdog
+
+            self.watchdog = SloWatchdog(slos)
+        self.flight = None
+        if flight_dir:
+            from blendjax.obs.watchdog import FlightRecorder
+
+            self.flight = FlightRecorder(
+                flight_dir, profile_s=flight_profile_s
+            )
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
         self.last_verdict = None
@@ -63,6 +118,17 @@ class StatsReporter:
         )
         self.last_verdict = verdict
         self.log.info("%s", verdict.render())
+        self.history.append({
+            "t": time.time(),
+            "doctor": {
+                "kind": verdict.kind,
+                "reason": verdict.reason,
+                "shares": verdict.shares,
+            },
+            "report": report,
+        })
+        if self.watchdog is not None:
+            self._evaluate_slos(report, verdict)
         if self._jsonl is not None:
             extra = {
                 "doctor": {
@@ -72,6 +138,8 @@ class StatsReporter:
                 },
                 "lineage": self.lineage.report(),
             }
+            if self.watchdog is not None:
+                extra["slo"] = self.watchdog.state()
             # Echoing runs get their accounting surfaced beside the
             # verdict (fresh/echoed counters sum exactly to drawn
             # samples; the echo-mitigated/saturated arms read these).
@@ -86,6 +154,55 @@ class StatsReporter:
                 extra["echo"] = echo
             self._jsonl.write(report, extra=extra)
         return verdict
+
+    def _evaluate_slos(self, report: dict, verdict) -> None:
+        result = self.watchdog.evaluate(report, verdict=verdict)
+        # Registry mirrors: the gauge is the scrapeable health bit, the
+        # counter the lifetime breach count — both constant names.
+        self.registry.gauge("slo.breached", 0 if result["healthy"] else 1)
+        if result["newly_breached"]:
+            self.registry.count(
+                "slo.breach_events", len(result["newly_breached"])
+            )
+            names = [s["slo"] for s in result["newly_breached"]]
+            self.log.warning(
+                "SLO breach: %s (values %s)",
+                names,
+                {s["slo"]: s["value"] for s in result["newly_breached"]},
+            )
+            if self.flight is not None:
+                try:
+                    self.flight.dump(
+                        reason=f"slo-breach: {'; '.join(names)}",
+                        history=list(self.history),
+                        lineage_report=self.lineage.report(),
+                        slo_states=result["states"],
+                        registry=self.registry,
+                    )
+                except Exception:
+                    # evidence capture must never take the reporter down
+                    self.log.exception("flight-record dump failed")
+        for spec in result["newly_recovered"]:
+            self.log.info("SLO recovered: %s", spec)
+
+    # -- health (the /healthz source) -----------------------------------------
+
+    @property
+    def healthy(self) -> bool:
+        return self.watchdog is None or self.watchdog.healthy
+
+    def health(self) -> dict:
+        """State dict for the HTTP exporter's ``/healthz`` endpoint:
+        ``start_http_exporter(health=reporter.health)``."""
+        out = {
+            "healthy": self.healthy,
+            "verdict": getattr(self.last_verdict, "kind", None),
+        }
+        if self.watchdog is None:
+            out["slo"] = "unconfigured"
+        else:
+            out["slo"] = self.watchdog.state()
+        return out
 
     def _run(self) -> None:
         # wait-first loop: a reporter started beside an empty pipeline

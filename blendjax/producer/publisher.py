@@ -26,11 +26,15 @@ class DataPublisher(DataPublisherSocket):
         compress_min_bytes: int = DEFAULT_COMPRESS_MIN_BYTES,
         lineage: bool = True,
         telemetry_every: int = 64,
+        trace_every: int = 64,
     ):
         # lineage/telemetry_every: publish-time stamps + the periodic
         # producer-metrics piggyback (docs/observability.md) — on by
         # default so every producer in a fleet shows up in the
         # consumer's staleness/gap/telemetry view without opting in.
+        # trace_every: sampled distributed frame tracing (every Nth
+        # message carries a `_trace` context downstream stages stamp in
+        # place — docs/observability.md "Tracing a frame"; 0 disables).
         super().__init__(
             bind_addr,
             btid=btid,
@@ -42,4 +46,5 @@ class DataPublisher(DataPublisherSocket):
             compress_min_bytes=compress_min_bytes,
             lineage=lineage,
             telemetry_every=telemetry_every,
+            trace_every=trace_every,
         )

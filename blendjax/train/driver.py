@@ -25,9 +25,16 @@ from __future__ import annotations
 # outputs inside this module's dispatch loops)
 
 import collections
+import time
 
 import numpy as np
 
+from blendjax.obs.trace import (
+    TERMINAL_STAGE,
+    pop_traces as trace_pop,
+    stage as trace_stage,
+    tracer,
+)
 from blendjax.utils.metrics import metrics
 
 
@@ -58,23 +65,44 @@ class TrainDriver:
     step on the fused path), ``inflight_hwm`` (steps-in-flight
     high-water mark), ``host_blocks`` (genuine ring-full waits — near
     zero when the device keeps up), ``syncs`` (periodic loss fetches).
+
+    Device-timeline metrics: each ring entry is timed dispatch ->
+    retirement (the moment the completion poll/fetch observes it done),
+    feeding the ``train.step_device_ms`` histogram — an upper bound on
+    per-step device latency that converges on it while the ring cycles
+    (a finished entry is examined again within one submit). Given
+    ``flops_per_image`` (the bench measures it via
+    ``compiled.cost_analysis()`` — ``measure_model_flops``) and
+    ``peak_flops``, retirements additionally maintain a live
+    ``train.mfu`` gauge (retired images/s x flops_per_image /
+    peak_flops over ~1 s windows), so MFU is an always-on run metric
+    the SLO watchdog can bound, not just a bench artifact.
     """
 
     def __init__(self, step, state, inflight: int = 4,
                  sync_every: int = 32, pad_partial: bool = True,
-                 buckets=None):
+                 buckets=None, flops_per_image: float | None = None,
+                 peak_flops: float | None = None):
         self.step = step
         self.state = state
         self.inflight = max(1, int(inflight))
         self.sync_every = max(0, int(sync_every or 0))
         self.pad_partial = bool(pad_partial)
         self.buckets = buckets
+        self.flops_per_image = (
+            float(flops_per_image) if flops_per_image else None
+        )
+        self.peak_flops = float(peak_flops) if peak_flops else None
+        # ring entries: [loss, t_dispatch_mono, images, traces]
         self._pending: collections.deque = collections.deque()
         self.losses: list = []
         self.steps = 0
         self.dispatches = 0
         self.inflight_hwm = 0
         self.host_blocks = 0
+        self.images_retired = 0
+        self._mfu_mark: tuple | None = None  # (t_mono, images_retired)
+        self._t_first_dispatch: float | None = None
 
     # -- ring ----------------------------------------------------------------
 
@@ -86,6 +114,36 @@ class TrainDriver:
 
         return transfer_done(arr)
 
+    def _retire(self, entry) -> None:
+        """Account one completed ring entry: the dispatch->retirement
+        device-timeline histogram, the live MFU gauge, and the terminal
+        stamp of any frame trace riding the entry. Host bookkeeping
+        only — the loss value itself is NOT fetched here."""
+        _loss, t0, images, traces = entry
+        now = time.monotonic()
+        metrics.observe("train.step_device_ms", (now - t0) * 1e3)
+        self.images_retired += images
+        if self.flops_per_image and self.peak_flops:
+            if self._mfu_mark is None:
+                self._mfu_mark = (now, self.images_retired)
+            else:
+                t_mark, img_mark = self._mfu_mark
+                dt = now - t_mark
+                if dt >= 1.0:
+                    rate = (self.images_retired - img_mark) / dt
+                    metrics.gauge(
+                        "train.mfu",
+                        round(
+                            rate * self.flops_per_image / self.peak_flops,
+                            6,
+                        ),
+                    )
+                    self._mfu_mark = (now, self.images_retired)
+        if traces:
+            for tr in traces:
+                trace_stage(tr, TERMINAL_STAGE)
+                tracer.complete(tr)
+
     def _block_oldest(self) -> None:
         """Retire the oldest in-flight entry, blocking if needed. A
         block is counted only when genuine (the entry wasn't already
@@ -93,16 +151,17 @@ class TrainDriver:
         has finished and this is a free pop."""
         import jax
 
-        loss = self._pending.popleft()
-        if self._is_done(loss):
-            return
-        self.host_blocks += 1
-        # Registry mirror of the instance stat: the stall doctor
-        # (blendjax.obs.doctor) reads plain metrics snapshots, and a
-        # genuine ring-full block is its strongest step-bound signal.
-        metrics.count("train.host_blocks")
-        with metrics.span("driver.ring_wait"):
-            jax.block_until_ready(loss)
+        entry = self._pending.popleft()
+        if not self._is_done(entry[0]):
+            self.host_blocks += 1
+            # Registry mirror of the instance stat: the stall doctor
+            # (blendjax.obs.doctor) reads plain metrics snapshots, and
+            # a genuine ring-full block is its strongest step-bound
+            # signal.
+            metrics.count("train.host_blocks")
+            with metrics.span("driver.ring_wait"):
+                jax.block_until_ready(entry[0])
+        self._retire(entry)
 
     def _sync_oldest(self) -> None:
         """Periodic loss fetch (the designed host-sync point): the
@@ -110,11 +169,44 @@ class TrainDriver:
         least, because everything newer stays dispatched."""
         if not self._pending:
             return
-        loss = self._pending.popleft()
+        entry = self._pending.popleft()
         with metrics.span("driver.loss_sync"):
-            self.losses.append(float(np.asarray(loss).reshape(-1)[-1]))
+            self.losses.append(
+                float(np.asarray(entry[0]).reshape(-1)[-1])
+            )
+        self._retire(entry)
 
     # -- dispatch ------------------------------------------------------------
+
+    @staticmethod
+    def _batch_images(batch) -> int:
+        """Images this batch trains on — for the MFU gauge. Packed
+        chunk groups count K' rows x the per-batch lead from `_spec`;
+        decoded (K, B, H, W, C) superbatches count K*B; plain batches
+        their leading dim. Shape reads only — no device values."""
+        packed = batch.get("_packed")
+        if packed is not None:
+            spec = batch.get("_spec") or ()
+            lead = next(
+                (s[0] for n, _d, s, *_r in spec if n == "xy"), None
+            )
+            if lead is None:
+                lead = max(
+                    (s[0] for _n, _d, s, *_r in spec if s), default=1
+                )
+            return int(packed.shape[0]) * int(lead)
+        img = batch.get("image")
+        if img is not None and getattr(img, "ndim", 0) >= 4:
+            shp = img.shape
+            return int(shp[0] * shp[1]) if img.ndim >= 5 else int(shp[0])
+        lead = next(
+            (
+                v.shape[0] for k, v in batch.items()
+                if not k.startswith("_") and getattr(v, "ndim", 0) >= 1
+            ),
+            0,
+        )
+        return int(lead)
 
     def submit(self, batch) -> None:
         """Dispatch one step without waiting on its result."""
@@ -125,9 +217,19 @@ class TrainDriver:
             from blendjax.data.batcher import pad_to_bucket
 
             batch = pad_to_bucket(batch, buckets=self.buckets)
+        # Frame traces must come OFF the batch before the step call:
+        # a trace dict is host-side metadata no jit can consume (the
+        # same contract as `_meta`, which the step builders filter).
+        traces = trace_pop(batch)
+        if traces:
+            for tr in traces:
+                trace_stage(tr, "step_dispatch")
+        images = self._batch_images(batch)
+        if self._t_first_dispatch is None:
+            self._t_first_dispatch = time.monotonic()
         pending = self._pending
-        while pending and self._is_done(pending[0]):
-            pending.popleft()  # completion tracking: free retires
+        while pending and self._is_done(pending[0][0]):
+            self._retire(pending.popleft())  # completion tracking
         while len(pending) >= self.inflight:
             self._block_oldest()
         with metrics.span("train.dispatch"):
@@ -135,7 +237,7 @@ class TrainDriver:
         metrics.count("train.dispatches")
         self.dispatches += 1
         self.steps += 1
-        pending.append(m["loss"])
+        pending.append([m["loss"], time.monotonic(), images, traces])
         if len(pending) > self.inflight_hwm:
             self.inflight_hwm = len(pending)
         # Registry mirror runs UNCONDITIONALLY (gauge_max is already a
@@ -154,9 +256,28 @@ class TrainDriver:
         see docs/performance.md measurement hygiene)."""
         if not self._pending:
             return self.losses[-1] if self.losses else None
-        newest = self._pending.pop()
-        self._pending.clear()
-        val = float(np.asarray(newest).reshape(-1)[-1])
+        newest = self._pending[-1]
+        val = float(np.asarray(newest[0]).reshape(-1)[-1])
+        # the fetch transitively completed every older entry: retire
+        # them all (device-timeline accounting + trace terminal stamps)
+        while self._pending:
+            self._retire(self._pending.popleft())
+        # Whole-run MFU at the drain barrier: the windowed gauge in
+        # _retire needs >=1 s between retirements, so a short run (or
+        # a drain landing mid-window) would otherwise end without one.
+        if (
+            self.flops_per_image and self.peak_flops
+            and self.images_retired and self._t_first_dispatch is not None
+        ):
+            dt = max(time.monotonic() - self._t_first_dispatch, 1e-9)
+            metrics.gauge(
+                "train.mfu",
+                round(
+                    (self.images_retired / dt) * self.flops_per_image
+                    / self.peak_flops,
+                    6,
+                ),
+            )
         self.losses.append(val)
         return val
 
@@ -182,4 +303,5 @@ class TrainDriver:
             "inflight_hwm": self.inflight_hwm,
             "host_blocks": self.host_blocks,
             "syncs": len(self.losses),
+            "images_retired": self.images_retired,
         }

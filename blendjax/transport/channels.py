@@ -160,6 +160,7 @@ class DataPublisherSocket(_Channel):
         compress_min_bytes: int = DEFAULT_COMPRESS_MIN_BYTES,
         lineage: bool = True,
         telemetry_every: int = 64,
+        trace_every: int = 64,
     ):
         self.codec = codec
         self.btid = btid
@@ -180,6 +181,15 @@ class DataPublisherSocket(_Channel):
         # lineage=False restores the pre-telemetry wire shape.
         self.lineage = bool(lineage)
         self.telemetry_every = int(telemetry_every) if lineage else 0
+        # Distributed frame tracing (blendjax.obs.trace): every
+        # trace_every-th message additionally carries a `_trace` context
+        # — trace id, producer btid/pid, and a growing list of
+        # [stage, t_mono, t_wall] stamps each downstream stage appends
+        # in place. Off the sampled path the cost is one modulo check;
+        # trace_every=0 disables stamping entirely (and lineage=False
+        # implies it, like telemetry).
+        self.trace_every = int(trace_every) if lineage else 0
+        self._pid = os.getpid()
         self._seq = 0
         self._created_wall = time.time()
         self._tel_mark = (0, self._created_wall)  # (seq, wall) at last snapshot
@@ -209,6 +219,17 @@ class DataPublisherSocket(_Channel):
         data["_pub_mono"] = time.monotonic()
         if self.telemetry_every and self._seq % self.telemetry_every == 0:
             data["_telemetry"] = self._telemetry_snapshot()
+        if self.trace_every and self._seq % self.trace_every == 0:
+            # Sampled end-to-end frame trace (blendjax.obs.trace): the
+            # shape is inlined (not imported) so producer processes —
+            # Blender's Python — need nothing beyond this module. The
+            # trace id is globally unique per (producer pid, seq).
+            data["_trace"] = {
+                "id": f"{self.btid}-{self._pid}-{self._seq}",
+                "btid": self.btid,
+                "pid": self._pid,
+                "stages": [["publish", time.monotonic(), time.time()]],
+            }
         self._seq += 1
         return data
 

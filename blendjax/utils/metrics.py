@@ -313,17 +313,50 @@ class Metrics:
 metrics = Metrics()
 
 
+# jax.profiler supports exactly ONE active trace per process;
+# start_trace raises on a second. The SLO watchdog's flight recorder
+# may fire a capture at any moment — possibly inside a user's own open
+# trace — so activation is tracked under a module lock and a nested
+# trace degrades to a logged no-op instead of killing the run.
+_trace_lock = threading.Lock()
+_trace_active = False
+
+
 @contextlib.contextmanager
 def trace(logdir: str):
     """JAX profiler trace around a code block; view in TensorBoard/XProf.
 
+    Reentrancy-safe: if a trace is already active in this process (the
+    profiler allows only one), the nested call logs a warning and runs
+    the block untraced instead of raising out of
+    ``jax.profiler.start_trace`` — so a watchdog-triggered capture can
+    never take down a run that was already being profiled.
+
     >>> with trace("/tmp/profile"):
     ...     for batch in pipeline: step(state, batch)
     """
+    global _trace_active
     import jax
 
-    jax.profiler.start_trace(logdir)
-    try:
+    with _trace_lock:
+        already = _trace_active
+        if not already:
+            _trace_active = True
+    if already:
+        from blendjax.utils.logging import get_logger
+
+        get_logger("metrics").warning(
+            "jax profiler trace already active: nested trace(%r) "
+            "degrades to a no-op", logdir,
+        )
         yield
+        return
+    try:
+        jax.profiler.start_trace(logdir)
+        try:
+            yield
+        finally:
+            jax.profiler.stop_trace()
     finally:
-        jax.profiler.stop_trace()
+        with _trace_lock:
+            _trace_active = False

@@ -64,6 +64,12 @@ def main() -> None:
         "across a producer fleet => one consumer decode compilation and "
         "unbroken chunk groups). 0 = per-stream high-water mark.",
     )
+    parser.add_argument(
+        "--trace-every", type=int, default=64,
+        help="stamp every Nth published message with a sampled "
+        "distributed-trace context (blendjax.obs.trace; "
+        "docs/observability.md 'Tracing a frame'). 0 disables.",
+    )
     opts = parser.parse_args(remainder)
 
     scene = CubeScene(shape=tuple(opts.shape), seed=args.btseed)
@@ -82,7 +88,8 @@ def main() -> None:
             parser.error("--encoding tile requires --batch > 1")
         h, w = opts.shape
         pub = DataPublisher(
-            args.btsockets["DATA"], btid=args.btid, lingerms=10000, send_hwm=2
+            args.btsockets["DATA"], btid=args.btid, lingerms=10000,
+            send_hwm=2, trace_every=opts.trace_every,
         )
         if len(opts.tile) > 2:
             parser.error("--tile takes one side or two (rows cols) values")
@@ -130,7 +137,7 @@ def main() -> None:
             parser.error("--encoding pal requires --batch > 1")
         pub = DataPublisher(
             args.btsockets["DATA"], btid=args.btid, lingerms=10000,
-            send_hwm=2,
+            send_hwm=2, trace_every=opts.trace_every,
         )
         b, (h, w) = opts.batch, opts.shape
         buf = {
@@ -195,7 +202,7 @@ def main() -> None:
         send_hwm = 2
         pub = DataPublisher(
             args.btsockets["DATA"], btid=args.btid, lingerms=10000,
-            send_hwm=send_hwm,
+            send_hwm=send_hwm, trace_every=opts.trace_every,
         )
         b, (h, w) = opts.batch, opts.shape
         pool = [
@@ -234,7 +241,10 @@ def main() -> None:
                 pub.publish(_batched=True, **{k: v[:i] for k, v in buf.items()})
 
     else:
-        pub = DataPublisher(args.btsockets["DATA"], btid=args.btid, lingerms=10000)
+        pub = DataPublisher(
+            args.btsockets["DATA"], btid=args.btid, lingerms=10000,
+            trace_every=opts.trace_every,
+        )
 
         def publish(frame: int) -> None:
             pub.publish(**scene.observation(frame))

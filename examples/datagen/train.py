@@ -83,7 +83,33 @@ def main():
         "--trace-export", default=None, metavar="PATH",
         help="record pipeline span events and write a Chrome/Perfetto "
         "trace JSON to PATH at exit (load in ui.perfetto.dev beside a "
-        "jax.profiler trace)",
+        "jax.profiler trace); completed distributed frame traces are "
+        "merged in as producer/consumer lanes with flow arrows",
+    )
+    ap.add_argument(
+        "--trace-every", type=int, default=64, metavar="N",
+        help="producers stamp every Nth message with a sampled "
+        "distributed-trace context; each pipeline stage appends its "
+        "timestamp and the driver completes the record at step "
+        "retirement (docs/observability.md 'Tracing a frame'). "
+        "0 disables stamping",
+    )
+    ap.add_argument(
+        "--slo", action="append", default=None, metavar="RULE",
+        help="declarative SLO rule, repeatable — e.g. "
+        "'rate(ingest.items) >= 50', 'p95(wire.e2e_staleness_s) <= 0.5 "
+        "@ 30', 'rate(wire.seq_gaps) == 0', 'doctor != wire-bound' — "
+        "evaluated every reporter tick (10s); breaches log, flip "
+        "/healthz to 503 (with --metrics-port), and trigger the flight "
+        "recorder (with --flight-dir). See docs/observability.md "
+        "'SLOs and the flight recorder'",
+    )
+    ap.add_argument(
+        "--flight-dir", default=None, metavar="DIR",
+        help="on a sustained SLO breach, dump a bounded diagnostic "
+        "bundle here: recent metrics snapshots + doctor verdicts, the "
+        "lineage report, span events + frame traces as one Chrome "
+        "trace, and the breaching rule states (needs --slo)",
     )
     ap.add_argument(
         "--augment", action="store_true",
@@ -108,15 +134,28 @@ def main():
     )
 
     # Observability (docs/observability.md): a live Prometheus scrape
-    # target + periodic doctor verdicts, and/or a Chrome-trace of the
-    # pipeline spans — torn down in the finally below.
+    # target + periodic doctor verdicts, SLO watchdog + flight
+    # recorder, and/or a Chrome-trace of the pipeline spans — torn
+    # down in the finally below.
     exporter = reporter = None
-    if args.metrics_port is not None:
+    if args.flight_dir and not args.slo:
+        ap.error("--flight-dir needs at least one --slo rule to breach")
+    if args.metrics_port is not None or args.slo:
         from blendjax.obs import StatsReporter, start_http_exporter
 
-        exporter = start_http_exporter(port=args.metrics_port)
-        print(f"metrics: http://127.0.0.1:{exporter.port}/metrics")
-        reporter = StatsReporter(interval_s=10.0).start()
+        reporter = StatsReporter(
+            interval_s=10.0, slos=args.slo, flight_dir=args.flight_dir,
+        ).start()
+        if args.metrics_port is not None:
+            # /healthz serves 200/503 from the reporter's SLO state —
+            # the machine-readable health bit beside /metrics.
+            exporter = start_http_exporter(
+                port=args.metrics_port, health=reporter.health
+            )
+            print(
+                f"metrics: http://127.0.0.1:{exporter.port}/metrics  "
+                f"health: http://127.0.0.1:{exporter.port}/healthz"
+            )
     if args.trace_export:
         from blendjax.utils.metrics import metrics as _metrics
 
@@ -246,7 +285,8 @@ def main():
                 run_steps(iter(source))
             return
 
-        producer_args = ["--shape", str(h), str(w)]
+        producer_args = ["--shape", str(h), str(w),
+                         "--trace-every", str(args.trace_every)]
         if args.encoding in ("tile", "pal"):
             producer_args += [
                 "--batch", str(args.batch), "--encoding", args.encoding,

@@ -1,0 +1,67 @@
+#!/usr/bin/env python
+"""CI gate: a bench-exported Chrome trace must show at least one
+sampled frame as CONNECTED flow events across distinct producer and
+consumer process lanes (docs/observability.md "Tracing a frame").
+
+Checks, on ``traceEvents``:
+
+- non-empty and JSON-parseable (the load itself);
+- at least one flow pair — an ``s`` (start) and ``f`` (finish) event
+  sharing an ``id`` on DIFFERENT pids: the producer → consumer arrow;
+- ``frame_trace`` stage-transition slices (``ph: "X"``) exist, each
+  with a non-negative duration;
+- every pid appearing in a frame-trace event has a ``process_name``
+  metadata record, so the lanes are labeled in the viewer.
+
+Usage: ``python scripts/check_frame_trace.py TRACE.json``
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+from collections import defaultdict
+
+
+def main(path: str) -> None:
+    with open(path, encoding="utf-8") as f:
+        trace = json.load(f)
+    events = trace.get("traceEvents") or []
+    assert events, f"{path}: empty traceEvents"
+
+    flows: dict = defaultdict(lambda: {"s": set(), "f": set()})
+    slices = []
+    named_pids = set()
+    frame_pids = set()
+    for e in events:
+        ph = e.get("ph")
+        if ph in ("s", "f"):
+            flows[e["id"]][ph].add(e["pid"])
+            frame_pids.add(e["pid"])
+        elif ph == "X" and e.get("cat") == "frame_trace":
+            slices.append(e)
+            frame_pids.add(e["pid"])
+        elif ph == "M" and e.get("name") == "process_name":
+            named_pids.add(e["pid"])
+
+    connected = [
+        fid for fid, v in flows.items()
+        if v["s"] and v["f"] and v["s"] != v["f"]
+    ]
+    assert connected, (
+        f"{path}: no flow pair crosses process lanes "
+        f"(flows: {dict(flows)})"
+    )
+    assert slices, f"{path}: no frame_trace stage slices"
+    bad = [e for e in slices if e.get("dur", 0) < 0]
+    assert not bad, f"{path}: negative-duration slices: {bad[:3]}"
+    unnamed = frame_pids - named_pids
+    assert not unnamed, f"{path}: unlabeled process lanes: {unnamed}"
+    print(
+        f"{path}: OK — {len(connected)} cross-lane frame flow(s), "
+        f"{len(slices)} stage slices, {len(frame_pids)} lanes"
+    )
+
+
+if __name__ == "__main__":
+    main(sys.argv[1] if len(sys.argv) > 1 else "bench-frame-trace.json")

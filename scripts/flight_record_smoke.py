@@ -1,0 +1,66 @@
+#!/usr/bin/env python
+"""CI smoke: drive the real reporter → watchdog → flight-recorder
+chain through a synthetic sustained breach and verify the bundle
+parses (docs/observability.md "SLOs and the flight recorder").
+
+No producers, no jax: a private registry is fed healthy counters for a
+few manual reporter ticks, then starved so ``rate(ingest.items) >= 50``
+breaches; the dump must contain parseable ``breach.json``,
+``snapshots.jsonl`` (with doctor verdicts), ``lineage.json``, and
+``trace.json`` (a loadable Chrome trace). The hermetic pytest suite
+covers the live producer-kill version; this script exists so the CI
+artifact upload always has a real bundle to ship.
+
+Usage: ``python scripts/flight_record_smoke.py [OUT_DIR]``
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+from blendjax.obs.reporter import StatsReporter
+from blendjax.utils.metrics import Metrics
+
+
+def main(out_dir: str) -> None:
+    reg = Metrics()
+    reg.enable_span_events()
+    rep = StatsReporter(
+        interval_s=3600.0,  # ticked manually below, never by thread
+        registry=reg,
+        slos=["rate(ingest.items) >= 50"],
+        flight_dir=out_dir,
+    )
+    # healthy ticks: ~100 items/s between evaluations
+    reg.count("ingest.items", 100)
+    with reg.span("ingest.recv"):
+        pass
+    rep.tick()
+    reg.count("ingest.items", 100)
+    rep.tick()
+    # starvation: no new items -> rate 0 < 50 -> breach + dump
+    rep.tick()
+    assert rep.healthy is False, rep.health()
+    assert rep.watchdog.state()["breached"], rep.watchdog.state()
+
+    bundles = sorted(
+        d for d in os.listdir(out_dir) if d.startswith("flight-")
+    )
+    assert bundles, f"no bundle written under {out_dir}"
+    bundle = os.path.join(out_dir, bundles[-1])
+    breach = json.load(open(os.path.join(bundle, "breach.json")))
+    assert breach["slo"], breach
+    snaps = [
+        json.loads(line)
+        for line in open(os.path.join(bundle, "snapshots.jsonl"))
+    ]
+    assert snaps and all("doctor" in s for s in snaps), snaps[:1]
+    trace = json.load(open(os.path.join(bundle, "trace.json")))
+    assert "traceEvents" in trace, sorted(trace)
+    print(f"{bundle}: OK — {len(snaps)} snapshots, breach parsed")
+
+
+if __name__ == "__main__":
+    main(sys.argv[1] if len(sys.argv) > 1 else "flight-records")

@@ -837,10 +837,104 @@ def test_syntax_error_reports_bjx000():
     assert [f.rule for f in got] == ["BJX000"]
 
 
+# -- BJX109 wall-clock-duration ----------------------------------------------
+
+
+def test_bjx109_flags_wall_clock_duration_in_hot_path():
+    src = """
+        # bjx: hot-path
+        import time
+
+        def recv_loop(work):
+            t0 = time.time()
+            work()
+            return time.time() - t0
+    """
+    got = findings(src, select=["BJX109"])
+    assert [f.rule for f in got] == ["BJX109"]
+    assert "time.monotonic" in got[0].message
+
+
+def test_bjx109_checks_driver_modules_by_basename_and_marker():
+    src = """
+        import time
+
+        def ring_wait():
+            start = time.time()
+            return time.time() - start
+    """
+    assert rule_ids(src, relpath="driver.py", select=["BJX109"]) == [
+        "BJX109"
+    ]
+    marked = "# bjx: driver-hot-path\n" + textwrap.dedent(src)
+    got = analyze_source(marked, "echo.py", select={"BJX109"})
+    assert [f.rule for f in got] == ["BJX109"]
+
+
+def test_bjx109_negatives_wire_stamps_mixed_clocks_and_unmarked():
+    # cross-process staleness math: one side comes off the message,
+    # not a local wall-clock read — the sanctioned pattern
+    wire = """
+        # bjx: hot-path
+        import time
+
+        def ingest(msg):
+            now = time.time()
+            return now - float(msg["_pub_wall"])
+    """
+    assert rule_ids(wire, select=["BJX109"]) == []
+    # mixed clocks (the chrome-trace timebase offset) are not a
+    # wall-wall duration
+    mixed = """
+        # bjx: hot-path
+        import time
+
+        def offset():
+            return time.perf_counter() - time.time()
+    """
+    assert rule_ids(mixed, select=["BJX109"]) == []
+    # unmarked modules are out of scope (eval/bench code times with
+    # wall clocks freely)
+    unmarked = """
+        import time
+
+        def f():
+            t0 = time.time()
+            return time.time() - t0
+    """
+    assert rule_ids(unmarked, select=["BJX109"]) == []
+
+
+def test_bjx109_monotonic_durations_stay_clean():
+    src = """
+        # bjx: hot-path
+        import time
+
+        def recv_loop(work):
+            t0 = time.monotonic()
+            work()
+            return time.monotonic() - t0
+    """
+    assert rule_ids(src, select=["BJX109"]) == []
+
+
+def test_bjx109_inline_suppression():
+    src = """
+        # bjx: hot-path
+        import time
+
+        def f(work):
+            t0 = time.time()
+            work()
+            return time.time() - t0  # bjx: ignore[BJX109]
+    """
+    assert rule_ids(src, select=["BJX109"]) == []
+
+
 def test_every_rule_registered():
     assert set(all_rules()) == {
         "BJX101", "BJX102", "BJX103", "BJX104", "BJX105", "BJX106",
-        "BJX107", "BJX108",
+        "BJX107", "BJX108", "BJX109",
     }
 
 

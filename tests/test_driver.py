@@ -378,3 +378,48 @@ def test_driver_run_drives_fused_pipeline_one_dispatch_per_step():
     assert spans["train.dispatch"]["count"] == drv.stats["dispatches"]
     assert "decode.dispatch" not in spans
     assert isinstance(final, float) and np.isfinite(final)
+
+
+def test_driver_device_timeline_and_mfu_land_in_report():
+    """Acceptance: a live driver run populates train.step_device_ms
+    percentiles and (given flops_per_image + peak_flops) a train.mfu
+    gauge in Metrics.report() — MFU as an always-on run metric, not a
+    bench artifact."""
+    from blendjax.models import CubeRegressor
+    from blendjax.train import make_supervised_step, make_train_state
+
+    rng = np.random.default_rng(13)
+    batch = {
+        "image": rng.integers(0, 255, (8, 16, 16, 4), np.uint8),
+        "xy": (rng.random((8, 8, 2)) * 16).astype(np.float32),
+    }
+    s0 = make_train_state(
+        CubeRegressor(), batch["image"], optimizer=optax.sgd(0.01)
+    )
+    reg.reset()
+    drv = TrainDriver(
+        make_supervised_step(donate=False), s0, inflight=2,
+        sync_every=0, flops_per_image=1e9, peak_flops=197e12,
+    )
+    for _ in range(6):
+        drv.submit(dict(batch))
+    drv.finish()
+    report = reg.report()
+    h = report["histograms"]["train.step_device_ms"]
+    assert h["count"] == 6  # every ring entry retired exactly once
+    for q in ("p50", "p95", "p99"):
+        assert h[q] >= 0, h
+    assert drv.stats["images_retired"] == 6 * 8
+    # whole-run MFU published at the drain barrier (short runs would
+    # otherwise end inside the 1s gauge window)
+    assert report["gauges"]["train.mfu"] > 0
+    # without the flops hints the gauge is absent, the histogram stays
+    reg.reset()
+    drv2 = TrainDriver(
+        make_supervised_step(donate=False), s0, inflight=2, sync_every=0
+    )
+    drv2.submit(dict(batch))
+    drv2.finish()
+    report = reg.report()
+    assert "train.mfu" not in report["gauges"]
+    assert report["histograms"]["train.step_device_ms"]["count"] == 1

@@ -1,10 +1,13 @@
 """blendjax.obs: histogram exactness, frame lineage, the stall doctor,
-and the exporters (Prometheus / JSONL / Chrome trace)."""
+the exporters (Prometheus / JSONL / Chrome trace), distributed frame
+tracing, and the SLO watchdog + flight recorder."""
 
 import json
+import os
 import re
 import threading
 import time
+import urllib.error
 import urllib.request
 
 import numpy as np
@@ -12,7 +15,10 @@ import pytest
 
 from blendjax.obs import (
     VERDICTS,
+    FlightRecorder,
     JsonlExporter,
+    Slo,
+    SloWatchdog,
     StatsReporter,
     chrome_trace,
     diagnose,
@@ -26,6 +32,16 @@ from blendjax.obs.lineage import (
     SEQ_KEY,
     FrameLineage,
     strip_stamps,
+)
+from blendjax.obs.trace import (
+    TRACE_KEY,
+    TRACES_KEY,
+    FrameTraceCollector,
+    iter_traces,
+    make_trace,
+    pop_traces,
+    stage as trace_stage,
+    stamp_batch,
 )
 from blendjax.utils.metrics import Histogram, Metrics
 
@@ -637,3 +653,607 @@ def test_stats_reporter_thread_lifecycle(tmp_path):
     time.sleep(0.2)
     rep.stop()
     assert rep.last_verdict is not None
+
+
+# -- distributed frame tracing (blendjax.obs.trace) --------------------------
+
+
+def test_publisher_trace_sampling_recv_stamp_and_replay_strip():
+    """trace_every=2: every 2nd message carries a `_trace` context the
+    stream stamps `recv` onto; the rest carry nothing, trace_every=0
+    disables stamping entirely, and strip_stamps removes the context
+    on replay (recorded wall stamps would read as hours of latency)."""
+    from blendjax.data.stream import RemoteStream
+    from blendjax.obs.lineage import lineage
+    from blendjax.transport import DataPublisherSocket
+    from blendjax.utils.metrics import metrics
+
+    metrics.reset()
+    lineage.reset()
+    for every, expect in ((2, 3), (0, 0)):
+        pub = DataPublisherSocket(
+            WILD, btid=5, telemetry_every=0, trace_every=every
+        )
+        stream = RemoteStream([pub.addr], timeoutms=5000, max_items=6)
+        t = threading.Thread(
+            target=lambda p=pub: [
+                p.publish(image=np.zeros((2, 2), np.uint8), frameid=i)
+                for i in range(6)
+            ],
+            daemon=True,
+        )
+        t.start()
+        items = list(stream)
+        t.join(timeout=5)
+        pub.close()
+        traced = [it for it in items if TRACE_KEY in it]
+        assert len(traced) == expect, (every, len(traced))
+        for it in traced:
+            tr = it[TRACE_KEY]
+            assert [s[0] for s in tr["stages"]] == ["publish", "recv"]
+            assert tr["btid"] == 5
+            assert tr["id"].startswith("5-")
+    stripped = strip_stamps({TRACE_KEY: {"id": "x"}, "frameid": 1})
+    assert TRACE_KEY not in stripped and stripped["frameid"] == 1
+
+
+def test_trace_batch_helpers_cover_meta_sidecars():
+    """stamp/iter/pop reach both the batch-level `_traces` list and
+    contexts carried inside `_meta` sidecar dicts (the tile chunk-group
+    form), and are cheap no-ops on untraced batches."""
+    tr1 = make_trace("a", btid=0, pid=1)
+    tr2 = make_trace("b", btid=0, pid=1)
+    batch = {
+        TRACES_KEY: [tr1],
+        "_meta": [{TRACES_KEY: [tr2]}, {"other": 1}],
+        "x": np.zeros(2),
+    }
+    stamp_batch(batch, "decode")
+    assert tr1["stages"][-1][0] == "decode"
+    assert tr2["stages"][-1][0] == "decode"
+    assert {t["id"] for t in iter_traces(batch)} == {"a", "b"}
+    out = pop_traces(batch)
+    assert {t["id"] for t in out} == {"a", "b"}
+    assert TRACES_KEY not in batch
+    assert TRACES_KEY not in batch["_meta"][0]
+    assert pop_traces({"x": 1}) == []
+    assert list(iter_traces({"x": 1})) == []
+
+
+def test_trace_collector_histograms_report_and_unordered_flag():
+    reg = Metrics()
+    col = FrameTraceCollector(registry=reg)
+    tr = make_trace("f-0", btid=2, pid=4242)
+    for s in ("recv", "batch", "step_dispatch", "step_retire"):
+        time.sleep(0.001)
+        trace_stage(tr, s)
+    col.complete(tr)
+    rep = col.report()
+    assert rep["completed"] == 1 and rep["kept"] == 1
+    assert rep["end_to_end"] is True and rep["unordered"] == 0
+    for m in ("trace.wire_ms", "trace.queue_ms", "trace.step_ms"):
+        assert m in rep["transitions"], rep["transitions"]
+        assert reg.histograms()[m]["count"] == 1
+        assert rep["transitions"][m]["p50_ms"] >= 0
+    # a record whose mono stamps go backwards is flagged, not dropped
+    col.complete({
+        "id": "u", "btid": 0, "pid": 1,
+        "stages": [["publish", 5.0, 5.0], ["recv", 4.0, 5.1]],
+    })
+    rep = col.report()
+    assert rep["unordered"] == 1 and rep["completed"] == 2
+    assert reg.counters["trace.unordered"] == 1
+    col.reset()
+    assert col.report()["completed"] == 0
+
+
+def test_trace_chrome_events_cross_process_flow_arrows(tmp_path):
+    """One completed record renders as stage slices split across the
+    producer's pid lane and this process's, bound by an s/f flow pair
+    sharing an id on DIFFERENT pids, with both lanes labeled — the
+    shape scripts/check_frame_trace.py gates in CI."""
+    col = FrameTraceCollector(registry=Metrics())
+    tr = make_trace("f-1", btid=3, pid=31337)
+    for s in ("recv", "batch", "step_dispatch", "step_retire"):
+        trace_stage(tr, s)
+    col.complete(tr)
+    evs = col.chrome_events()
+    starts = [e for e in evs if e["ph"] == "s"]
+    fins = [e for e in evs if e["ph"] == "f"]
+    assert len(starts) == 1 and len(fins) == 1
+    assert starts[0]["id"] == fins[0]["id"]
+    assert starts[0]["pid"] == 31337 and fins[0]["pid"] == os.getpid()
+    slices = [e for e in evs if e["ph"] == "X"]
+    assert len(slices) == 4  # one per stage transition
+    assert {e["pid"] for e in slices} == {31337, os.getpid()}
+    assert all(e["dur"] >= 0 for e in slices)
+    named = {
+        e["pid"] for e in evs
+        if e["ph"] == "M" and e["name"] == "process_name"
+    }
+    assert {31337, os.getpid()} <= named
+    # merged export: chrome_trace(frame_traces=col) carries the lanes
+    obj = chrome_trace(events=[], registry=Metrics(), frame_traces=col)
+    assert any(e.get("cat") == "frame_trace" for e in obj["traceEvents"])
+
+
+def test_frame_trace_completes_end_to_end_through_ingest_and_driver():
+    """The acceptance path, hermetic: publisher (trace_every=2) ->
+    RemoteStream -> HostIngest -> TrainDriver; every sampled frame's
+    record reaches step_retire with monotonically ordered stages, the
+    trace.* transition histograms land in Metrics.report(), and no
+    consumer-visible batch leaks a trace key to the step."""
+    from blendjax.data.batcher import HostIngest
+    from blendjax.data.stream import RemoteStream
+    from blendjax.obs.lineage import lineage
+    from blendjax.obs.trace import tracer
+    from blendjax.train.driver import TrainDriver
+    from blendjax.transport import DataPublisherSocket
+    from blendjax.utils.metrics import metrics
+
+    metrics.reset()
+    lineage.reset()
+    tracer.reset()
+    pub = DataPublisherSocket(
+        WILD, btid=7, telemetry_every=0, trace_every=2
+    )
+    stream = RemoteStream([pub.addr], timeoutms=5000, max_items=8)
+    ingest = HostIngest(stream, batch_size=4).start()
+    t = threading.Thread(
+        target=lambda: [
+            pub.publish(image=np.zeros((2, 2), np.uint8), frameid=i)
+            for i in range(8)
+        ],
+        daemon=True,
+    )
+    t.start()
+
+    class _Loss:
+        def is_ready(self):
+            return True
+
+        def __array__(self, dtype=None, copy=None):
+            return np.zeros(1, np.float32)
+
+    drv = TrainDriver(
+        lambda state, batch: (state, {"loss": _Loss()}),
+        state=0, inflight=2, sync_every=0,
+    )
+    n_batches = 0
+    for batch in ingest:
+        assert TRACE_KEY not in batch  # popped into _traces by ingest
+        drv.submit(batch)
+        n_batches += 1
+    drv.finish()
+    t.join(timeout=5)
+    pub.close()
+    assert n_batches == 2
+    rep = tracer.report()
+    assert rep["completed"] == 4  # seq 0, 2, 4, 6
+    assert rep["end_to_end"] is True
+    assert rep["unordered"] == 0
+    for m in ("trace.wire_ms", "trace.queue_ms", "trace.step_ms"):
+        assert rep["transitions"][m]["count"] == 4, (m, rep)
+    hists = metrics.report()["histograms"]
+    assert hists["trace.step_ms"]["count"] == 4
+    assert hists["train.step_device_ms"]["count"] == 2
+    tracer.reset()
+
+
+# -- SLO watchdog ------------------------------------------------------------
+
+
+def test_slo_parse_grammar():
+    s = Slo.parse("rate(wire.seq_gaps) == 0")
+    assert (s.kind, s.metric, s.op, s.threshold) == (
+        "rate", "wire.seq_gaps", "==", 0.0
+    )
+    q = Slo.parse("p95(wire.e2e_staleness_s) <= 0.5 @ 30")
+    assert q.kind == "quantile" and q.quantile == "p95"
+    assert q.threshold == 0.5 and q.sustain_s == 30.0
+    d = Slo.parse("doctor != wire-bound")
+    assert d.kind == "doctor" and d.threshold == "wire-bound"
+    g = Slo.parse("train.mfu >= 0.01")  # bare name reads as a gauge
+    assert g.kind == "gauge" and g.metric == "train.mfu"
+    c = Slo.parse("counter(slo.breach_events) <= 3")
+    assert c.kind == "counter"
+    with pytest.raises(ValueError):
+        Slo.parse("not a rule at all")
+    with pytest.raises(ValueError):
+        Slo.parse("gauge(train.mfu) >= fast")
+    with pytest.raises(ValueError):
+        Slo.parse("doctor <= 3")  # verdicts compare with == / != only
+
+
+def test_watchdog_rate_rule_sustain_window_and_recovery():
+    spec = "rate(ingest.items) >= 50 @ 10"
+    wd = SloWatchdog([spec])
+    # first call: no previous counters, rates have no evidence yet
+    r = wd.evaluate({"counters": {"ingest.items": 0}}, now=0.0)
+    assert r["healthy"] and r["states"][0]["value"] is None
+    # 100 items/s: healthy
+    r = wd.evaluate({"counters": {"ingest.items": 1000}}, now=10.0)
+    assert r["healthy"] and r["states"][0]["value"] == 100.0
+    # starved, but not yet sustained 10s: violating != breached
+    r = wd.evaluate({"counters": {"ingest.items": 1000}}, now=20.0)
+    assert r["healthy"] and not r["newly_breached"]
+    assert r["states"][0]["ok"] is False
+    assert r["states"][0]["violating_for_s"] == 0.0
+    # still starved 11s later: sustained -> breach
+    r = wd.evaluate({"counters": {"ingest.items": 1000}}, now=31.0)
+    assert not r["healthy"]
+    assert [s["slo"] for s in r["newly_breached"]] == [spec]
+    assert wd.breach_events == 1
+    assert wd.state()["breached"] == [spec]
+    # items flowing again: recovery is reported once
+    r = wd.evaluate({"counters": {"ingest.items": 9000}}, now=41.0)
+    assert r["healthy"] and r["newly_recovered"] == [spec]
+    assert wd.state()["breached"] == []
+
+
+def test_watchdog_gauge_quantile_doctor_counter_kinds():
+    wd = SloWatchdog([
+        "gauge(train.mfu) >= 0.1",
+        "p95(wire.e2e_staleness_s) <= 0.5",
+        "doctor != wire-bound",
+        "counter(wire.seq_gaps) == 0",
+    ])
+
+    class _V:
+        def __init__(self, kind):
+            self.kind = kind
+
+    healthy = {
+        "gauges": {"train.mfu": 0.2},
+        "histograms": {
+            "wire.e2e_staleness_s": {"count": 10, "p95": 0.3}
+        },
+        "counters": {"wire.seq_gaps": 0},
+    }
+    r = wd.evaluate(healthy, verdict=_V("balanced"), now=1.0)
+    assert r["healthy"]
+    sick = {
+        "gauges": {"train.mfu": 0.01},
+        "histograms": {
+            "wire.e2e_staleness_s": {"count": 10, "p95": 2.0}
+        },
+        "counters": {"wire.seq_gaps": 3},
+    }
+    r = wd.evaluate(sick, verdict=_V("wire-bound"), now=2.0)
+    assert not r["healthy"]
+    assert sum(1 for s in r["states"] if not s["ok"]) == 4
+    # one breach event per newly-breached RULE — the same total the
+    # reporter mirrors into the slo.breach_events registry counter
+    assert wd.breach_events == 4
+    # absent evidence is "no verdict", never a breach — including a
+    # rate/counter floor on a counter the pipeline has NOT created yet
+    # (slow producer spin-up must not dump a flight record)
+    wd2 = SloWatchdog(["gauge(absent) >= 1", "p95(absent) <= 1",
+                       "doctor != idle", "rate(absent) >= 50",
+                       "counter(absent) >= 1"])
+    r = wd2.evaluate({}, verdict=None, now=0.0)
+    r = wd2.evaluate({"counters": {}}, verdict=None, now=10.0)
+    assert r["healthy"]
+    assert all(s["value"] is None for s in r["states"])
+    # the moment the counter exists, rate rules bind (created during
+    # the window: the delta baselines at 0)
+    r = wd2.evaluate({"counters": {"absent": 700}}, verdict=None,
+                     now=20.0)
+    assert [s for s in r["states"] if s["slo"] == "rate(absent) >= 50"
+            ][0]["value"] == 70.0
+
+
+def test_flight_recorder_bundle_contents_and_pruning(tmp_path):
+    reg = Metrics()
+    reg.enable_span_events()
+    with reg.span("ingest.recv"):
+        pass
+    col = FrameTraceCollector(registry=reg)
+    tr = make_trace("f-9", btid=1, pid=777)
+    for s in ("recv", "batch", "step_dispatch", "step_retire"):
+        trace_stage(tr, s)
+    col.complete(tr)
+    fr = FlightRecorder(str(tmp_path), max_bundles=2)
+    history = [{"t": 1.0, "doctor": {"kind": "balanced"},
+                "report": {"counters": {}}}]
+    last = None
+    for i in range(4):
+        last = fr.dump(
+            reason=f"breach-{i}", history=history,
+            lineage_report={"1": {"received": 5}},
+            slo_states=[{"slo": "rate(x) >= 1", "ok": False}],
+            registry=reg, frame_tracer=col,
+        )
+    bundles = sorted(
+        d for d in os.listdir(tmp_path) if d.startswith("flight-")
+    )
+    assert len(bundles) == 2, bundles  # flapping SLO can't fill disk
+    assert os.path.basename(last) == bundles[-1]
+    breach = json.load(open(os.path.join(last, "breach.json")))
+    assert breach["reason"] == "breach-3"
+    assert breach["slo"][0]["slo"] == "rate(x) >= 1"
+    snaps = [json.loads(line)
+             for line in open(os.path.join(last, "snapshots.jsonl"))]
+    assert snaps[0]["doctor"]["kind"] == "balanced"
+    lin = json.load(open(os.path.join(last, "lineage.json")))
+    assert lin["1"]["received"] == 5
+    trace = json.load(open(os.path.join(last, "trace.json")))
+    assert any(
+        e.get("cat") == "frame_trace" for e in trace["traceEvents"]
+    )
+    frames = json.load(open(os.path.join(last, "frame_traces.json")))
+    assert frames["report"]["completed"] == 1
+    assert frames["records"][0]["id"] == "f-9"
+    # a restarted process resumes numbering after the surviving
+    # bundles instead of overwriting flight-0001 with a new incident
+    fr2 = FlightRecorder(str(tmp_path), max_bundles=4)
+    again = fr2.dump(reason="after-restart", history=history,
+                     registry=reg, frame_tracer=col)
+    assert os.path.basename(again) == "flight-0005"
+
+
+def test_profiler_trace_reentrancy_degrades_to_noop(monkeypatch):
+    """A watchdog-triggered capture inside a user's open trace must be
+    a logged no-op, not a second jax.profiler.start_trace (which
+    raises) — and the guard must reset so later traces still work."""
+    import jax
+
+    from blendjax.utils import metrics as um
+
+    calls = {"start": 0, "stop": 0}
+    monkeypatch.setattr(
+        jax.profiler, "start_trace",
+        lambda logdir: calls.__setitem__("start", calls["start"] + 1),
+    )
+    monkeypatch.setattr(
+        jax.profiler, "stop_trace",
+        lambda: calls.__setitem__("stop", calls["stop"] + 1),
+    )
+    with um.trace("/tmp/outer"):
+        with um.trace("/tmp/nested"):  # degrades, does not raise
+            pass
+        assert calls == {"start": 1, "stop": 0}
+    assert calls == {"start": 1, "stop": 1}
+    # the guard cleared: a fresh trace starts the profiler again
+    with um.trace("/tmp/later"):
+        pass
+    assert calls == {"start": 2, "stop": 2}
+    # ... and it clears even when start_trace itself raises
+    monkeypatch.setattr(
+        jax.profiler, "start_trace",
+        lambda logdir: (_ for _ in ()).throw(RuntimeError("boom")),
+    )
+    with pytest.raises(RuntimeError):
+        with um.trace("/tmp/broken"):
+            pass
+    monkeypatch.setattr(
+        jax.profiler, "start_trace",
+        lambda logdir: calls.__setitem__("start", calls["start"] + 1),
+    )
+    with um.trace("/tmp/after-failure"):
+        pass
+    assert calls["stop"] == 3
+
+
+# -- /healthz + JSONL rotation + concurrent scrape ---------------------------
+
+
+def _get_status(url):
+    try:
+        with urllib.request.urlopen(url, timeout=5) as resp:
+            return resp.status, json.loads(resp.read().decode())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read().decode())
+
+
+def test_healthz_flips_200_503_200_across_breach_and_recovery():
+    reg = _filled_registry()
+    rep = StatsReporter(
+        interval_s=3600, registry=reg, lineage=FrameLineage(),
+        slos=["rate(ingest.items) >= 50"],
+    )
+    srv = start_http_exporter(port=0, registry=reg, health=rep.health)
+    url = f"http://127.0.0.1:{srv.port}/healthz"
+    try:
+        code, body = _get_status(url)  # before any tick: healthy
+        assert code == 200 and body["healthy"] is True
+        reg.count("ingest.items", 1000)
+        rep.tick()  # first tick: rates have no evidence yet
+        reg.count("ingest.items", 1000)
+        rep.tick()  # plenty of flow
+        code, body = _get_status(url)
+        assert code == 200 and body["healthy"] is True
+        rep.tick()  # starved since last tick -> breach
+        code, body = _get_status(url)
+        assert code == 503 and body["healthy"] is False
+        assert body["slo"]["breached"] == ["rate(ingest.items) >= 50"]
+        assert reg.report()["gauges"]["slo.breached"] == 1
+        reg.count("ingest.items", 100000)
+        rep.tick()  # flow restored -> recovered
+        code, body = _get_status(url)
+        assert code == 200 and body["healthy"] is True
+        assert reg.report()["gauges"]["slo.breached"] == 0
+        # /metrics still serves beside /healthz
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{srv.port}/metrics", timeout=5
+        ) as resp:
+            assert resp.status == 200
+    finally:
+        srv.close()
+
+
+def test_healthz_unconfigured_exporter_stays_200():
+    srv = start_http_exporter(port=0, registry=_filled_registry())
+    try:
+        code, body = _get_status(
+            f"http://127.0.0.1:{srv.port}/healthz"
+        )
+        assert code == 200 and body["slo"] == "unconfigured"
+    finally:
+        srv.close()
+
+
+def test_http_exporter_concurrent_scrape_while_mutating():
+    """Threaded writers churning counters/gauges/histograms/spans while
+    repeated GETs hit /metrics: every response must be a 200 whose
+    every line parses (a torn snapshot shows up as a garbled line)."""
+    reg = Metrics()
+    stop = threading.Event()
+
+    def churn(seed):
+        i = seed
+        while not stop.is_set():
+            reg.count("ingest.items")
+            reg.gauge(f"g{i % 13}", i)
+            reg.observe("scrape.lat", (i % 50) / 1000 + 1e-6)
+            with reg.span("scrape.span"):
+                pass
+            i += 1
+
+    writers = [
+        threading.Thread(target=churn, args=(i,), daemon=True)
+        for i in range(4)
+    ]
+    srv = start_http_exporter(port=0, registry=reg)
+    url = f"http://127.0.0.1:{srv.port}/metrics"
+    try:
+        for w in writers:
+            w.start()
+        for _ in range(25):
+            with urllib.request.urlopen(url, timeout=5) as resp:
+                assert resp.status == 200
+                body = resp.read().decode()
+            for line in body.strip().splitlines():
+                if line.startswith("#"):
+                    assert re.match(
+                        r"^# TYPE [a-zA-Z_:][a-zA-Z0-9_:]* "
+                        r"(counter|gauge|histogram|summary)$", line
+                    ), line
+                else:
+                    assert _PROM_SAMPLE.match(line), line
+    finally:
+        stop.set()
+        for w in writers:
+            w.join(timeout=5)
+        srv.close()
+
+
+def test_jsonl_exporter_rotation_bounds_archive(tmp_path):
+    path = str(tmp_path / "run_stats.jsonl")
+    ex = JsonlExporter(path, rotate_bytes=4096, keep=3)
+    m = _filled_registry()
+    for _ in range(200):
+        ex.write(m.report())
+    files = [path] + [f"{path}.{i}" for i in (1, 2, 3)]
+    existing = [f for f in files if os.path.exists(f)]
+    assert f"{path}.1" in existing  # rotation actually happened
+    assert not os.path.exists(f"{path}.4")  # keep is a hard bound
+    # bounded: live file + keep generations, each ~rotate_bytes (+ one
+    # line of slack per generation, written before the size check)
+    total = sum(os.path.getsize(f) for f in existing)
+    assert total <= 4 * (4096 + 2048), total
+    # every surviving line, in every generation, still parses
+    for f in existing:
+        for line in open(f):
+            assert json.loads(line)["report"]["counters"]
+
+
+def test_producer_kill_breach_dumps_flight_bundle_healthz_503(tmp_path):
+    """The acceptance scenario, live: a real publisher feeding a real
+    ingest; killing the producer starves rate(ingest.items), the
+    watchdog breaches on the next tick, the flight recorder writes a
+    parseable bundle (snapshots + doctor history + Chrome trace), and
+    /healthz serves 503 while breached."""
+    from blendjax.data.batcher import HostIngest
+    from blendjax.data.stream import RemoteStream
+    from blendjax.obs.lineage import lineage
+    from blendjax.transport import DataPublisherSocket
+    from blendjax.utils.metrics import metrics
+
+    metrics.reset()
+    lineage.reset()
+    metrics.enable_span_events()
+    flight_dir = str(tmp_path / "flight")
+    pub = DataPublisherSocket(
+        WILD, btid=9, telemetry_every=0, trace_every=0
+    )
+    alive = threading.Event()
+    alive.set()
+
+    def produce():
+        i = 0
+        while alive.is_set():
+            pub.publish(
+                image=np.zeros((2, 2), np.uint8), frameid=i
+            )
+            i += 1
+            time.sleep(0.002)
+
+    producer = threading.Thread(target=produce, daemon=True)
+    stream = RemoteStream(
+        [pub.addr], timeoutms=250, on_timeout=lambda: True
+    )
+    ingest = HostIngest(stream, batch_size=4, prefetch=2).start()
+    drain_stop = threading.Event()
+
+    def drain():
+        for _ in ingest:
+            if drain_stop.is_set():
+                break
+
+    drainer = threading.Thread(target=drain, daemon=True)
+    rep = StatsReporter(
+        interval_s=3600,
+        slos=["rate(ingest.items) >= 20"],
+        flight_dir=flight_dir,
+    )
+    srv = start_http_exporter(port=0, health=rep.health)
+    url = f"http://127.0.0.1:{srv.port}/healthz"
+    try:
+        producer.start()
+        drainer.start()
+        time.sleep(0.3)
+        rep.tick()  # baseline (rates: no evidence yet) — healthy
+        time.sleep(0.3)
+        rep.tick()  # live flow, far above the floor — healthy
+        assert rep.healthy, rep.watchdog.state()
+        assert _get_status(url)[0] == 200
+        # kill the producer
+        alive.clear()
+        producer.join(timeout=5)
+        pub.close()
+        time.sleep(0.5)  # stragglers drain; then the pipe is dry
+        rep.tick()  # starved -> breach -> flight record
+        assert not rep.healthy, rep.watchdog.state()
+        code, body = _get_status(url)
+        assert code == 503
+        assert body["slo"]["breached"] == ["rate(ingest.items) >= 20"]
+        bundles = sorted(os.listdir(flight_dir))
+        assert len(bundles) == 1, bundles
+        bundle = os.path.join(flight_dir, bundles[0])
+        breach = json.load(
+            open(os.path.join(bundle, "breach.json"))
+        )
+        assert "rate(ingest.items) >= 20" in breach["reason"]
+        snaps = [
+            json.loads(line)
+            for line in open(os.path.join(bundle, "snapshots.jsonl"))
+        ]
+        # doctor history: the two healthy ticks plus the breach tick
+        assert len(snaps) == 3
+        assert all(s["doctor"]["kind"] in VERDICTS for s in snaps)
+        assert snaps[0]["report"]["counters"]["ingest.items"] > 0
+        trace = json.load(open(os.path.join(bundle, "trace.json")))
+        assert trace["traceEvents"], "span ring was on; trace is empty"
+    finally:
+        alive.clear()
+        drain_stop.set()
+        stream.request_stop()
+        srv.close()
+        try:
+            ingest.stop(timeout=10)
+        except Exception:
+            pass
+        metrics.disable_span_events()
+        metrics.reset()
+        lineage.reset()
