@@ -98,6 +98,19 @@ LIVE_ECHO_FACTORS = tuple(
         "BLENDJAX_BENCH_LIVE_ECHO_FACTORS", "4,16"
     ).split(",") if v
 )
+# Elastic producer-fleet A/B row (docs/fleet.md): a fixed fleet of 2
+# rate-capped synthetic producers vs an autoscaled fleet the
+# FleetController grows on live stall-doctor verdicts. Pure CPU (the
+# synthetic tier needs no Blender and no device step), so the row runs
+# identically on CI; it records the instance-count trajectory, the
+# scale-event log, the verdict sequence, and the two CI contracts
+# (at least one scale-up fired; wire.seq_gaps == 0 across every
+# membership change). FLEET_RATE caps each instance's frames/s so one
+# instance is a known supply increment and producer-bound is
+# reproducible on any host.
+LIVE_FLEET = os.environ.get("BLENDJAX_BENCH_LIVE_FLEET", "1") == "1"
+FLEET_RATE = float(os.environ.get("BLENDJAX_BENCH_FLEET_RATE", "40"))
+FLEET_MAX = int(os.environ.get("BLENDJAX_BENCH_FLEET_MAX", "4"))
 # The non-sparse row's codec: 'pal' (lossless full-frame palette; 4-8x
 # fewer bytes across socket AND host->device, decoded by a device
 # gather) or 'raw' (uncompressed frames). pal chunk-groups 8 batches
@@ -1290,6 +1303,176 @@ def measure_live_echo(items: int | None = None, time_cap: float = 25.0,
     return row
 
 
+def measure_live_fleet(time_cap: float = 12.0, rate: float | None = None,
+                       max_instances: int | None = None) -> dict:
+    """Elastic producer-fleet A/B on the synthetic high-rate tier
+    (docs/fleet.md): a FIXED fleet of 2 rate-capped producers vs an
+    AUTOSCALED fleet that starts at 1 and lets the
+    :class:`blendjax.fleet.FleetController` scale on live stall-doctor
+    verdicts — the closed loop the observability stack was built for.
+    Every producer is ``--rate``-capped, so each added instance buys a
+    known supply increment and the producer-bound verdict is
+    reproducible on any host (no Blender, no device step: the row runs
+    identically on CPU CI).
+
+    Each leg records img/s (whole window + the post-ramp second half),
+    the instance-count trajectory at every controller tick, the
+    scale-event log, and the run-length-compressed verdict sequence.
+    ``value`` is the autoscaled leg's settled rate over the fixed
+    leg's. A third UNTHROTTLED probe (one instance, no rate cap) shows
+    the synthetic tier driving the same pipeline OUT of producer-bound
+    — the scale-down regime Blender's ~5 img/s physically cannot
+    reach. CI asserts ``scale_ups >= 1`` and ``seq_gaps == 0``."""
+    from blendjax.data import StreamDataPipeline
+    from blendjax.fleet import FleetController, FleetPolicy, synthetic_fleet
+    from blendjax.obs.lineage import lineage
+    from blendjax.utils.metrics import metrics as reg
+
+    rate = FLEET_RATE if rate is None else rate
+    max_instances = FLEET_MAX if max_instances is None else max_instances
+    shape, batch = (32, 32), 4
+    producer_args = ["--shape", str(shape[0]), str(shape[1]),
+                     "--batch", str(batch), "--rate", str(rate)]
+
+    def compress(seq):
+        runs: list = []
+        for kind in seq:
+            if runs and runs[-1][0] == kind:
+                runs[-1][1] += 1
+            else:
+                runs.append([kind, 1])
+        return runs
+
+    def leg(autoscale: bool) -> dict:
+        reg.reset()
+        lineage.reset()
+        n_start = 1 if autoscale else 2
+        trajectory: list = []
+        verdicts: list = []
+        with synthetic_fleet(
+            n_start, shape=shape, batch=batch, rate=rate,
+            bind_grace_s=0.5,
+        ) as launcher:
+            pipe = StreamDataPipeline(
+                launcher.addresses["DATA"], batch_size=2 * batch,
+                timeoutms=30_000,
+            )
+            ctrl = FleetController(
+                launcher, connector=pipe,
+                policy=FleetPolicy(
+                    min_instances=n_start,
+                    max_instances=max_instances if autoscale else n_start,
+                    up_after=2, cooldown_s=2.0,
+                ),
+                diagnose=lambda: pipe.doctor(),
+                instance_args=producer_args,
+            )
+            with pipe:
+                it = iter(pipe)
+                next(it)  # producers up, first batch through
+                t0 = time.perf_counter()
+                n = n_half = 0
+                last_tick = 0.0
+                while True:
+                    n += int(next(it)["image"].shape[0])
+                    now = time.perf_counter() - t0
+                    if not n_half and now >= time_cap / 2:
+                        n_half = n
+                    if now - last_tick >= 0.5:
+                        last_tick = now
+                        # the controller tick runs HERE (main thread),
+                        # not ctrl.start(): deterministic trajectories
+                        # and no competing control thread in a bench
+                        d = ctrl.tick()
+                        verdicts.append(d["verdict"])
+                        trajectory.append({
+                            "t": round(now, 1),
+                            "instances": d["instances"],
+                            "verdict": d["verdict"],
+                            "action": d["action"],
+                        })
+                    if now >= time_cap:
+                        break
+                dt = time.perf_counter() - t0
+                instances_final = ctrl.state()["instances"]
+        counters = reg.report()["counters"]
+        settled = (
+            (n - n_half) / (dt - time_cap / 2) if n_half else n / dt
+        )
+        return {
+            "img_s": round(n / dt, 1),
+            # ramp excluded: the rate the fleet settled at
+            "settled_img_s": round(settled, 1),
+            "frames": n,
+            "seconds": round(dt, 2),
+            "instances_final": instances_final,
+            "trajectory": trajectory,
+            "scale_events": list(ctrl.scale_events()),
+            "verdicts": compress(verdicts),
+            "seq_gaps": int(counters.get("wire.seq_gaps", 0)),
+            "fleet_counters": {
+                k: int(v) for k, v in counters.items()
+                if k.startswith("fleet.")
+            },
+        }
+
+    def unthrottled_probe(seconds: float = 6.0,
+                          consumer_ms: float = 8.0) -> dict:
+        """One UNTHROTTLED synthetic producer (~1,100 frames/s) against
+        a consumer pinned at ``consumer_ms`` per batch (a stand-in
+        train step): supply outruns consumption, the queue pins full,
+        and the verdict must flip away from producer-bound — the
+        scale-down regime the fleet controller needs CI evidence for."""
+        reg.reset()
+        lineage.reset()
+        with synthetic_fleet(1, shape=shape, batch=batch) as launcher:
+            pipe = StreamDataPipeline(
+                launcher.addresses["DATA"], batch_size=2 * batch,
+                timeoutms=30_000,
+            )
+            with pipe:
+                it = iter(pipe)
+                next(it)
+                t0 = time.perf_counter()
+                n = 0
+                while time.perf_counter() - t0 < seconds:
+                    n += int(next(it)["image"].shape[0])
+                    time.sleep(consumer_ms / 1e3)
+                dt = time.perf_counter() - t0
+                verdict = pipe.doctor()
+        return {
+            "img_s": round(n / dt, 1),
+            "consumer_ms": consumer_ms,
+            "verdict": verdict.kind,
+            # the tier's reason to exist in CI: supply outrunning the
+            # consumer flips the verdict away from producer-bound
+            "non_producer_bound": (
+                not verdict.kind.startswith("producer-bound")
+                and verdict.kind != "echo-saturated"
+            ),
+        }
+
+    row: dict = {
+        "fixed2": leg(False),
+        "autoscaled": leg(True),
+        "unthrottled": unthrottled_probe(),
+        "rate_cap_per_instance": rate,
+        "max_instances": max_instances,
+    }
+    row["value"] = round(
+        row["autoscaled"]["settled_img_s"]
+        / max(row["fixed2"]["settled_img_s"], 1e-9), 3
+    )
+    row["scale_ups"] = len([
+        e for e in row["autoscaled"]["scale_events"]
+        if e["action"] == "scale_up"
+    ])
+    row["seq_gaps"] = max(
+        row["fixed2"]["seq_gaps"], row["autoscaled"]["seq_gaps"]
+    )
+    return row
+
+
 def measure_rl_hz(seconds: float = 3.0) -> dict:
     """Full REQ/REP rendezvous stepping rate, rendering off (the
     reference's '2000 Hz are easily achieved' row, ``Readme.md:95``;
@@ -1683,6 +1866,17 @@ def _build_record(progress: dict) -> dict:
             )
         except Exception as e:  # pragma: no cover - device flake path
             detail["live_echo"] = {"error": repr(e)[:200]}
+    if LIVE_FLEET:
+        # Elastic producer-fleet A/B (docs/fleet.md): fixed 2 producers
+        # vs controller-autoscaled, on the synthetic tier. Pure CPU —
+        # no device step and no weather window to gate on — so it runs
+        # even in degraded regimes: the evidence is instance-count
+        # trajectory + scale events + verdict transitions, not a
+        # device-link rate.
+        try:
+            detail["live_fleet"] = measure_live_fleet()
+        except Exception as e:  # pragma: no cover - spawn flake path
+            detail["live_fleet"] = {"error": repr(e)[:200]}
     if ENCODING == "tile" and INGEST_AB and not degraded:
         # Sharded-ingest A/B (same weather regime as the headline): does
         # a second recv/decode worker raise end-to-end img/s on THIS

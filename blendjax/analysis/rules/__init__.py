@@ -5,6 +5,7 @@ from __future__ import annotations
 from blendjax.analysis.rules import (  # noqa: F401  (registration side effects)
     deserialization,
     driver_sync,
+    fleet_affinity,
     hotpath,
     metric_names,
     purity,

@@ -1145,6 +1145,11 @@ class StreamDataPipeline:
 
         def on_timeout():
             with self._launcher_lock:
+                # Deliberate: this hook only runs once the stream has
+                # ALREADY stalled (recv timeout), so a bounded liveness
+                # check costs no throughput; serialized behind
+                # _launcher_lock across shards.
+                # bjx: ignore[BJX110]
                 launcher.assert_alive()  # raises (or respawns) as configured
             # All producers alive but silent: retry a bounded number of
             # times (covers slow startup/respawn), then fail fast.
@@ -1270,6 +1275,35 @@ class StreamDataPipeline:
 
     def queue_depth(self) -> int:
         return 0 if self.ingest is None else self.ingest.queue_depth()
+
+    # -- elastic membership ---------------------------------------------------
+
+    def connect(self, addr: str) -> None:
+        """Admit one producer endpoint mid-run (fleet controller /
+        remote admission): forwarded to the sharded ingest pool when
+        one is live, else to the underlying stream. Address
+        bookkeeping keeps re-iterations consistent."""
+        if self._addresses is not None and addr not in self._addresses:
+            self._addresses.append(addr)
+        target = self.ingest if hasattr(self.ingest, "connect") else self.stream
+        connect = getattr(target, "connect", None)
+        if connect is None:
+            raise RuntimeError(
+                "this pipeline's source does not support runtime "
+                "membership (opaque iterable / replay)"
+            )
+        connect(addr)
+
+    def disconnect(self, addr: str) -> None:
+        """Retire one producer endpoint mid-run. Drain first: retire
+        the producer, keep receiving through a grace window, THEN
+        disconnect — zmq drops messages still queued on the pipe."""
+        if self._addresses is not None and addr in self._addresses:
+            self._addresses.remove(addr)
+        target = self.ingest if hasattr(self.ingest, "disconnect") else self.stream
+        disconnect = getattr(target, "disconnect", None)
+        if disconnect is not None:
+            disconnect(addr)
 
     def doctor(self, driver=None):
         """One-line bottleneck verdict for the live pipeline

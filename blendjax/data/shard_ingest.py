@@ -243,6 +243,41 @@ class ShardedHostIngest:
         """Current prefetch-queue occupancy (observability gauge)."""
         return self._queue.qsize()
 
+    # -- elastic membership --------------------------------------------------
+
+    def connect(self, addr: str) -> None:
+        """Admit a producer endpoint into the pool at runtime: the
+        least-loaded shard takes it (each shard stream applies the op
+        from its own iterating thread — BJX104 holds). Per-producer
+        seq tracking stays sound: the new producer's WHOLE stream lands
+        on exactly one shard socket, like the round-robin partition."""
+        owner = self._addr_owner(addr)
+        if owner is not None:
+            return  # already a member
+        shard = min(
+            (s for s in self.streams if hasattr(s, "connect")),
+            key=lambda s: len(getattr(s, "addresses", ())),
+            default=None,
+        )
+        if shard is None:
+            raise RuntimeError(
+                "no shard stream supports runtime connect()"
+            )
+        shard.connect(addr)
+
+    def disconnect(self, addr: str) -> None:
+        """Retire a producer endpoint from whichever shard owns it
+        (no-op when unknown — e.g. already retired)."""
+        owner = self._addr_owner(addr)
+        if owner is not None:
+            owner.disconnect(addr)
+
+    def _addr_owner(self, addr: str):
+        for s in self.streams:
+            if addr in getattr(s, "addresses", ()):
+                return s
+        return None
+
     # -- worker side ---------------------------------------------------------
 
     def _emit(self, idx: int, batch) -> None:

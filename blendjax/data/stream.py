@@ -13,6 +13,7 @@ from __future__ import annotations
 # bjx: hot-path (the live receive loop: BJX102 flags any blocking
 # device sync added to this module)
 
+import collections
 import time
 
 from blendjax import constants
@@ -106,6 +107,58 @@ class RemoteStream:
             num_workers == 1 if track_gaps is None else bool(track_gaps)
         )
         self._stop_requested = False
+        # Elastic membership (fleet controller): connect/disconnect are
+        # callable from ANY thread, but ZMQ sockets are single-thread —
+        # ops are queued here and applied by the iterating thread at
+        # its next poll slice (<= 250 ms away). deque append/popleft
+        # are GIL-atomic, so no lock is needed.
+        self._membership_ops: collections.deque = collections.deque()
+
+    # -- elastic membership -------------------------------------------------
+
+    def connect(self, addr: str) -> None:
+        """Admit one more producer endpoint at runtime (fleet
+        controller / remote admission). Callable from any thread: the
+        op is queued and applied by the iterating thread at its next
+        poll slice (<= 250 ms away); when no iteration is live the
+        bookkeeping alone makes the next ``__iter__`` include it."""
+        if addr not in self.addresses:
+            self.addresses.append(addr)
+        self._membership_ops.append(("connect", addr))
+
+    def disconnect(self, addr: str) -> None:
+        """Retire one producer endpoint at runtime. zmq drops messages
+        still queued on the endpoint's pipe — retire the PRODUCER first
+        (drain), keep receiving through a grace window, then call this
+        (see ``blendjax.fleet.FleetController``)."""
+        if addr in self.addresses:
+            self.addresses.remove(addr)
+        self._membership_ops.append(("disconnect", addr))
+
+    def _apply_membership(self, recv) -> None:
+        """Drain queued connect/disconnect ops onto the live socket —
+        runs on the iterating thread, which owns it (BJX104). Ops
+        queued before iteration started are redundant with the
+        constructor address list and apply as no-ops (socket connect is
+        idempotent at our bookkeeping level; disconnect of a never-
+        connected addr is swallowed by the channel)."""
+        while self._membership_ops:
+            op, addr = self._membership_ops.popleft()
+            try:
+                if op == "connect":
+                    recv.connect(addr)
+                else:
+                    recv.disconnect(addr)
+            except Exception:
+                # A malformed endpoint (e.g. from a buggy remote
+                # announce) must not kill the live ingest thread far
+                # from the request that queued it.
+                logger.warning(
+                    "membership %s %r failed; skipping", op, addr,
+                    exc_info=True,
+                )
+                if op == "connect" and addr in self.addresses:
+                    self.addresses.remove(addr)
 
     def request_stop(self) -> None:
         """Ask a blocked iteration to exit at the next poll slice
@@ -128,6 +181,7 @@ class RemoteStream:
         the full timeout like a single blocking recv would."""
         deadline = time.monotonic() + self.timeoutms / 1e3
         while True:
+            self._apply_membership(recv)
             if self._stop_requested:
                 return None
             remaining_ms = (deadline - time.monotonic()) * 1e3

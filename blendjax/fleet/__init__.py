@@ -1,0 +1,50 @@
+"""blendjax.fleet — elastic producer-fleet control (docs/fleet.md).
+
+The serving tier over the launcher/stream split: N renderers, M
+consumers, graceful churn. Four pieces close the loop the
+observability stack opened:
+
+- :class:`~blendjax.fleet.controller.FleetController` — a control loop
+  that reads stall-doctor verdicts + SLO watchdog health each tick and
+  scales the producer count between ``min``/``max`` with hysteresis
+  and cooldown (``fleet.*`` metrics, bounded scale-event log);
+- elastic membership substrate — ``ProcessLauncher.add_instance`` /
+  ``retire_instance(drain=True)`` / ``respawn_instance`` and runtime
+  ``connect``/``disconnect`` on ``RemoteStream`` /
+  ``ShardedHostIngest`` / ``StreamDataPipeline``;
+- :class:`~blendjax.fleet.admission.AdmissionServer` — a REP endpoint
+  where remote render boxes announce ``{btid, data_addr, telemetry}``
+  and join the ingest set over TCP;
+- :mod:`blendjax.fleet.synthetic` — the Blender-free high-rate
+  producer tier (native rasterizer, ~1,100 frames/s), throttleable so
+  bench/CI reach both scale-up and scale-down regimes on CPU.
+
+Import-cheap: nothing here pulls jax (producer processes import the
+synthetic tier); zmq loads only when an endpoint actually opens.
+"""
+
+from __future__ import annotations
+
+from blendjax.fleet.admission import (  # noqa: F401
+    AdmissionServer,
+    announce,
+    leave,
+)
+from blendjax.fleet.controller import (  # noqa: F401
+    FleetController,
+    FleetPolicy,
+)
+from blendjax.fleet.synthetic import (  # noqa: F401
+    SYNTHETIC_PRODUCER,
+    synthetic_fleet,
+)
+
+__all__ = [
+    "AdmissionServer",
+    "announce",
+    "leave",
+    "FleetController",
+    "FleetPolicy",
+    "SYNTHETIC_PRODUCER",
+    "synthetic_fleet",
+]

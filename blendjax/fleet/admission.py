@@ -1,0 +1,169 @@
+"""Remote producer admission: announce over TCP, join the ingest set.
+
+The launcher can only scale producers it spawns on THIS machine. The
+ROADMAP topology — a render farm feeding a TPU pod — needs the inverse
+flow: a producer that already exists (on another box) announces itself
+and the consumer admits it. One REP socket beside the data channel
+(``bind_addr='primaryip'`` exposes it off-host, exactly like the data
+sockets) speaks a two-verb protocol:
+
+- ``{"op": "announce", "btid": ..., "data_addr": ..., "telemetry": {}}``
+  → the consumer connects ``data_addr`` into its ingest fan-in
+  (``pipeline.connect``; the socket op is applied by the ingest
+  thread), registers the btid with frame lineage, and replies
+  ``{"ok": true}``. Lineage starts tracking at the producer's first
+  observed seq, so joining mid-run never reads as a drop storm.
+- ``{"op": "leave", "btid": ...}`` → scheduled departure: the address
+  stays connected through the controller's drain grace window (the
+  producer's final linger flush is still in flight), then disconnects
+  and retires from lineage.
+
+The producer side is one call — :func:`announce` (and :func:`leave`) —
+built on the existing :class:`~blendjax.transport.channels.RpcClient`;
+``blendjax/fleet/synthetic.py --announce ADDR`` shows the full
+standalone-producer flow. Payloads decode with ``allow_pickle=False``:
+this endpoint faces the network.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from blendjax.utils.logging import get_logger
+from blendjax.utils.metrics import metrics
+
+logger = get_logger("fleet")
+
+_POLL_MS = 250
+
+
+class AdmissionServer:
+    """Registration endpoint for remote producers (REP, bind).
+
+    ``on_announce(btid, data_addr, telemetry) -> dict`` and
+    ``on_leave(btid) -> dict`` are the policy hooks — a
+    :class:`~blendjax.fleet.controller.FleetController` wires its
+    ``admit_remote``/``retire_remote``; tests wire plain recorders.
+    The zmq socket is created ON the serving thread (BJX104), so
+    :meth:`start` blocks briefly until the bound address is known;
+    read it from :attr:`addr` (wildcard ports resolve at bind).
+    """
+
+    def __init__(
+        self,
+        bind_addr: str = "tcp://127.0.0.1:0",
+        on_announce=None,
+        on_leave=None,
+    ):
+        self.bind_addr = bind_addr
+        self.on_announce = on_announce
+        self.on_leave = on_leave
+        self.addr: str | None = None
+        self._ready = threading.Event()
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self._startup_error: BaseException | None = None
+
+    def _handle(self, req: dict) -> dict:
+        op = req.get("op")
+        if op == "announce":
+            btid = req.get("btid")
+            data_addr = req.get("data_addr")
+            if btid is None or not data_addr:
+                return {"ok": False, "error": "announce needs btid + data_addr"}
+            metrics.count("fleet.announce_requests")
+            if self.on_announce is None:
+                return {"ok": False, "error": "no admission policy attached"}
+            return self.on_announce(
+                btid, str(data_addr), req.get("telemetry") or {}
+            )
+        if op == "leave":
+            if self.on_leave is None:
+                return {"ok": False, "error": "no admission policy attached"}
+            return self.on_leave(req.get("btid"))
+        return {"ok": False, "error": f"unknown op {op!r}"}
+
+    def _serve(self) -> None:
+        from blendjax.transport.channels import RpcServer
+
+        try:
+            server = RpcServer(self.bind_addr, allow_pickle=False)
+        except BaseException as e:  # bad bind addr: surface in start()
+            self._startup_error = e
+            self._ready.set()
+            raise
+        self.addr = server.addr
+        self._ready.set()
+        try:
+            while not self._stop.is_set():
+                req = server.recv(timeoutms=_POLL_MS)
+                if req is None:
+                    continue
+                try:
+                    reply = self._handle(req)
+                except Exception as e:  # policy error: reply, keep serving
+                    logger.exception("admission handler failed")
+                    reply = {"ok": False, "error": repr(e)[:200]}
+                server.reply(**reply)
+        finally:
+            server.close()
+
+    def start(self, timeout: float = 5.0) -> "AdmissionServer":
+        assert self._thread is None, "already started"
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._serve, name="blendjax-fleet-admission", daemon=True
+        )
+        self._thread.start()
+        if not self._ready.wait(timeout):
+            raise RuntimeError("admission server did not bind in time")
+        if self._startup_error is not None:
+            raise self._startup_error
+        logger.info("fleet admission endpoint: %s", self.addr)
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+
+    def __enter__(self) -> "AdmissionServer":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+
+def announce(server_addr: str, btid, data_addr: str,
+             telemetry: dict | None = None,
+             timeoutms: int = 5000) -> dict:
+    """Producer-side: register ``data_addr`` with a consumer's
+    admission endpoint; returns the reply dict (``{"ok": True}`` on
+    admission). Raises :class:`~blendjax.transport.ReceiveTimeoutError`
+    when the endpoint is unreachable — callers should retry with
+    backoff (the consumer may still be starting)."""
+    from blendjax.transport.channels import RpcClient
+
+    client = RpcClient(server_addr, timeoutms=timeoutms, allow_pickle=False)
+    try:
+        return client.call(
+            op="announce", btid=btid, data_addr=data_addr,
+            telemetry=telemetry or {},
+        )
+    finally:
+        client.close()
+
+
+def leave(server_addr: str, btid, timeoutms: int = 5000) -> dict:
+    """Producer-side graceful departure: ask the consumer to retire
+    this btid after its drain grace window. Publish the tail and
+    ``term_context()`` BEFORE exiting — the window exists so that
+    flush lands."""
+    from blendjax.transport.channels import RpcClient
+
+    client = RpcClient(server_addr, timeoutms=timeoutms, allow_pickle=False)
+    try:
+        return client.call(op="leave", btid=btid)
+    finally:
+        client.close()

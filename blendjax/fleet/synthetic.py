@@ -1,0 +1,264 @@
+"""Blender-free high-rate producer tier (and its launch helper).
+
+Blender renders the bench scene at ~5 img/s per instance; the native
+C++ rasterizer behind :class:`blendjax.producer.sim.CubeScene` renders
+it at ~1,100 frames/s (PARITY r2) on the same fake-runtime stack
+``blendjax.testing`` exercises. This module turns that into a
+first-class producer tier, because the fleet controller needs BOTH
+regimes on demand:
+
+- **scale-down / step-bound**: CPU CI and the bench can't drive a
+  consumer into step-bound with Blender (the 150x supply gap, BENCH
+  r05); a couple of unthrottled synthetic producers can.
+- **scale-up / producer-bound**: ``--rate N`` caps each instance at N
+  frames/s, so a deliberately starved fleet exercises the controller's
+  scale-up path deterministically — each added instance buys a known
+  supply increment.
+
+Run it three ways:
+
+1. ``synthetic_fleet(n, ...)`` — a configured
+   :class:`~blendjax.launcher.PythonProducerLauncher` (what the bench,
+   tests, and ``examples/datagen/train.py --synthetic-producers`` use);
+2. via any launcher: ``python .../fleet/synthetic.py -- <handshake>``;
+3. standalone on a remote render box::
+
+       python -m blendjax.fleet.synthetic --bind tcp://0.0.0.0:0 \\
+           --btid render-box-7 --announce tcp://consumer:5555
+
+   which binds its own data socket and registers with the consumer's
+   :class:`~blendjax.fleet.admission.AdmissionServer`.
+
+SIGTERM drains gracefully (the launcher's ``retire_instance(drain=
+True)`` contract): finish the in-flight frame, ship the partial batch,
+and ``term_context()`` so the socket flush completes — zero in-flight
+frames lost across a scale-down.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import sys
+import time
+
+import numpy as np
+
+SYNTHETIC_PRODUCER = os.path.abspath(__file__)
+
+# Default geometry: small enough that one instance saturates a CPU-CI
+# consumer, big enough that the batch still exercises the real codec.
+DEFAULT_SHAPE = (64, 64)
+DEFAULT_BATCH = 8
+
+
+def announce_addr(bound_addr: str) -> str:
+    """The address a producer should ANNOUNCE for a socket bound at
+    ``bound_addr``. zmq's LAST_ENDPOINT resolves wildcard PORTS but
+    keeps a wildcard HOST — announcing ``tcp://0.0.0.0:PORT`` would
+    have the remote consumer connect to ITSELF. Substitute the primary
+    IP, like the launcher's ``bind_addr='primaryip'`` mode."""
+    from blendjax.utils import get_primary_ip
+
+    proto, _, rest = bound_addr.partition("://")
+    host, _, port = rest.rpartition(":")
+    if host in ("0.0.0.0", "*", "::", "[::]"):
+        return f"{proto}://{get_primary_ip()}:{port}"
+    return bound_addr
+
+
+def synthetic_fleet(num_instances: int = 1, shape=DEFAULT_SHAPE,
+                    batch: int = DEFAULT_BATCH, rate: float = 0.0,
+                    frames: int = -1, trace_every: int = 0,
+                    extra_args=None, **launcher_kwargs):
+    """A ready-to-enter :class:`~blendjax.launcher.
+    PythonProducerLauncher` over ``num_instances`` synthetic producers.
+    ``rate`` caps each instance's frames/s (0 = as fast as the
+    rasterizer goes); remaining kwargs pass through to the launcher
+    (``seed``, ``proto``, ``bind_addr``, ...)."""
+    from blendjax.launcher import PythonProducerLauncher
+
+    args = [
+        "--shape", str(shape[0]), str(shape[1]),
+        "--batch", str(batch),
+        "--frames", str(frames),
+        "--rate", str(rate),
+        "--trace-every", str(trace_every),
+        *[str(a) for a in (extra_args or [])],
+    ]
+    launcher_kwargs.setdefault("named_sockets", ["DATA"])
+    return PythonProducerLauncher(
+        script=SYNTHETIC_PRODUCER,
+        num_instances=num_instances,
+        instance_args=[list(args) for _ in range(num_instances)],
+        **launcher_kwargs,
+    )
+
+
+def _parse(argv):
+    from blendjax.launcher import parse_launch_args
+
+    try:
+        args, remainder = parse_launch_args(argv)
+    except ValueError:
+        # Standalone mode: no launcher handshake in argv — everything
+        # after the program name is ours.
+        args, remainder = None, list(argv[1:])
+    parser = argparse.ArgumentParser(
+        description="blendjax synthetic high-rate producer"
+    )
+    parser.add_argument("--shape", nargs=2, type=int,
+                        default=list(DEFAULT_SHAPE))
+    parser.add_argument("--batch", type=int, default=DEFAULT_BATCH)
+    parser.add_argument("--frames", type=int, default=-1)
+    parser.add_argument(
+        "--rate", type=float, default=0.0,
+        help="cap frames/s per instance (0 = unthrottled) — the knob "
+        "that makes producer-bound regimes reproducible",
+    )
+    parser.add_argument("--trace-every", type=int, default=0)
+    parser.add_argument(
+        "--bind", default=None, metavar="ADDR",
+        help="standalone mode: bind the data socket here (wildcard "
+        "port ok) instead of taking it from the launcher handshake",
+    )
+    parser.add_argument(
+        "--btid", default=None,
+        help="standalone mode: producer id announced to the consumer",
+    )
+    parser.add_argument(
+        "--announce", default=None, metavar="ADDR",
+        help="register with a consumer's fleet admission endpoint "
+        "(blendjax.fleet.AdmissionServer) after binding",
+    )
+    parser.add_argument("--seed", type=int, default=0)
+    opts = parser.parse_args(remainder)
+    return args, opts
+
+
+def main(argv=None) -> int:
+    from blendjax.producer import AnimationController, DataPublisher
+    from blendjax.producer.sim import CubeScene, SimEngine
+    from blendjax.transport import term_context
+
+    args, opts = _parse(sys.argv if argv is None else argv)
+    launcher_mode = args is not None and "DATA" in (args.btsockets or {})
+    if not launcher_mode and not opts.bind:
+        raise SystemExit(
+            "synthetic producer needs a launcher handshake (-btsockets "
+            "DATA=...) or --bind ADDR for standalone mode"
+        )
+    btid = args.btid if launcher_mode else (opts.btid or os.getpid())
+    seed = args.btseed if launcher_mode else opts.seed
+    bind_addr = args.btsockets["DATA"] if launcher_mode else opts.bind
+
+    h, w = opts.shape
+    b = opts.batch
+    scene = CubeScene(shape=(h, w), seed=seed)
+    ctrl = AnimationController(SimEngine(scene))
+    pub = DataPublisher(
+        bind_addr, btid=btid, lingerms=10_000, send_hwm=2,
+        trace_every=opts.trace_every,
+    )
+
+    announced = False
+    if opts.announce:
+        from blendjax.fleet.admission import announce
+
+        data_addr = announce_addr(pub.addr)
+        # retry briefly — the consumer's endpoint may still be
+        # coming up.
+        for attempt in range(10):
+            try:
+                reply = announce(opts.announce, btid, data_addr)
+            except Exception:
+                reply = None
+            if reply and reply.get("ok"):
+                announced = True
+                break
+            time.sleep(0.5 * (attempt + 1))
+        if not announced:
+            pub.close()
+            raise SystemExit(
+                f"admission endpoint {opts.announce} refused or "
+                "unreachable"
+            )
+
+    # Zero-copy batch pool (cube_producer's shape): render straight
+    # into pooled buffers, publish by reference, re-render a slot only
+    # after its MessageTracker reports the IO thread done with it.
+    pool = [
+        {
+            "image": np.empty((b, h, w, 4), np.uint8),
+            "xy": np.empty((b, 8, 2), np.float32),
+            "frameid": np.empty((b,), np.int64),
+        }
+        for _ in range(4)
+    ]
+    trackers = [None] * len(pool)
+    cursor = {"slot": 0, "i": 0}
+    pace = {"t0": time.monotonic(), "frames": 0}
+
+    def publish(frame: int) -> None:
+        slot = cursor["slot"]
+        if cursor["i"] == 0 and trackers[slot] is not None:
+            trackers[slot].wait()  # backpressure: slot still in flight
+            trackers[slot] = None
+        scene.observation_into(frame, pool[slot], cursor["i"])
+        cursor["i"] += 1
+        if cursor["i"] == b:
+            trackers[slot] = pub.publish_tracked(
+                _batched=True, **pool[slot]
+            )
+            cursor["i"] = 0
+            cursor["slot"] = (slot + 1) % len(pool)
+        pace["frames"] += 1
+        if opts.rate > 0:
+            # absolute schedule (t0 + n/rate), not per-frame sleeps:
+            # sleep jitter can't accumulate into rate drift
+            due = pace["t0"] + pace["frames"] / opts.rate
+            delay = due - time.monotonic()
+            if delay > 0:
+                time.sleep(delay)
+        if 0 < opts.frames <= frame:
+            ctrl.cancel()
+
+    def flush() -> None:
+        i = cursor["i"]
+        if i > 0:
+            buf = pool[cursor["slot"]]
+            # partial tail: copy the filled prefix — the pool slot is
+            # reused, publish-by-reference would race the IO thread
+            pub.publish(
+                _batched=True, **{k: v[:i].copy() for k, v in buf.items()}
+            )
+
+    # Graceful drain on SIGTERM (retire_instance's drain contract):
+    # finish the current frame, ship the partial batch, flush the
+    # socket, exit 0 — in-flight frames survive a scale-down.
+    def _term(signum, frame_obj):
+        ctrl.cancel()
+
+    signal.signal(signal.SIGTERM, _term)
+
+    ctrl.post_frame.add(publish)
+    end = opts.frames if opts.frames > 0 else 2_147_483_647
+    try:
+        ctrl.play(frame_range=(1, end), num_episodes=-1)
+        flush()
+    finally:
+        if announced:
+            from blendjax.fleet.admission import leave
+
+            try:
+                leave(opts.announce, btid, timeoutms=2000)
+            except Exception:
+                pass  # consumer gone: nothing left to drain into
+        pub.close()
+        term_context()  # block until the tail is flushed (bounded by linger)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

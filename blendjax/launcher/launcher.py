@@ -24,6 +24,8 @@ import socket as pysocket
 import subprocess
 import sys
 import tempfile
+import threading
+import time
 
 from blendjax.launcher.arguments import format_launch_args
 from blendjax.launcher.launch_info import LaunchInfo
@@ -106,7 +108,25 @@ class ProcessLauncher:
 
     ``command`` is a callable ``(instance_index, handshake_argv) ->
     list[str]`` producing the full argv for one instance.
+
+    Elastic membership (the fleet controller's substrate): after
+    ``__enter__`` the fleet can grow and shrink at runtime —
+    :meth:`add_instance` allocates a fresh address per named socket,
+    continues the per-instance seed ladder (``seed + i``), and retries
+    allocation when the probed port is stolen before the producer
+    binds; :meth:`retire_instance` drains an instance gracefully
+    (SIGTERM, bounded wait for a clean exit so the producer's linger
+    flush delivers its tail) before killing; :meth:`scale_to` composes
+    the two. Retired slots stay in place so instance indices (== btids)
+    remain stable for lineage and respawn. All membership mutations are
+    serialized by one reentrant lock, so a controller thread and a
+    pipeline's timeout health-check can't interleave.
     """
+
+    #: add_instance retries with FRESH addresses when the producer dies
+    #: within the bind grace window (free-port probe race: the probed
+    #: port can be stolen between probe-close and producer bind).
+    BIND_RETRIES = 3
 
     def __init__(
         self,
@@ -119,6 +139,7 @@ class ProcessLauncher:
         instance_args=None,
         respawn: bool = False,
         proto: str = "tcp",
+        bind_grace_s: float = 2.0,
     ):
         assert num_instances > 0, "need at least one instance"
         self.command = command
@@ -133,10 +154,14 @@ class ProcessLauncher:
             get_primary_ip() if bind_addr == "primaryip" else bind_addr
         )
         self.start_port = start_port
+        self.bind_grace_s = float(bind_grace_s)
         self.processes: list = []
         self.launch_info: LaunchInfo | None = None
         self._argvs: list = []
         self._ipc_dir: str | None = None
+        self._lock = threading.RLock()
+        self._retired: set = set()
+        self._next_port: int | None = None
 
     # -- address plan -------------------------------------------------------
 
@@ -169,9 +194,38 @@ class ProcessLauncher:
                     p = _free_port(self.bind_addr)
                 addrs.append(f"{self.proto}://{self.bind_addr}:{p}")
             addresses[name] = addrs
+        # incremental scaling continues the deterministic ladder here
+        self._next_port = port
         return addresses
 
+    def _instance_addresses(self, index: int) -> dict:
+        """A fresh ``{name: addr}`` set for one NEW instance (the
+        incremental counterpart of :meth:`_allocate_addresses`)."""
+        if self.proto == "ipc":
+            assert self._ipc_dir is not None, "not launched"
+            return {
+                name: f"ipc://{self._ipc_dir}/{name}-{index}"
+                for name in self.named_sockets
+            }
+        sockets = {}
+        for name in self.named_sockets:
+            if self._next_port is not None:
+                p, self._next_port = self._next_port, self._next_port + 1
+            else:
+                p = _free_port(self.bind_addr)
+            sockets[name] = f"{self.proto}://{self.bind_addr}:{p}"
+        return sockets
+
     # -- lifecycle ----------------------------------------------------------
+
+    def _instance_argv(self, i: int, sockets: dict, extra=None) -> list:
+        handshake = ["--"] + format_launch_args(
+            btid=i,
+            btseed=self.seed + i,
+            btsockets=sockets,
+            extra=self.instance_args[i] if extra is None else extra,
+        )
+        return self.command(i, handshake)
 
     def __enter__(self) -> "ProcessLauncher":
         addresses = self._allocate_addresses()
@@ -179,13 +233,7 @@ class ProcessLauncher:
         try:
             for i in range(self.num_instances):
                 sockets = {n: addresses[n][i] for n in self.named_sockets}
-                handshake = ["--"] + format_launch_args(
-                    btid=i,
-                    btseed=self.seed + i,
-                    btsockets=sockets,
-                    extra=self.instance_args[i],
-                )
-                argv = self.command(i, handshake)
+                argv = self._instance_argv(i, sockets)
                 self._argvs.append(argv)
                 self.processes.append(self._spawn(argv))
                 logger.info(
@@ -231,8 +279,6 @@ class ProcessLauncher:
         # producer respawned from a pipeline's ingest thread must not
         # die with that thread; it falls back to context-manager
         # teardown. setsid stays C-level via start_new_session.
-        import threading
-
         if (
             sys.platform == "linux"
             and threading.current_thread() is threading.main_thread()
@@ -268,24 +314,39 @@ class ProcessLauncher:
 
     def poll(self) -> list:
         """Return per-instance exit codes (None = running); with
-        ``respawn=True`` dead instances are relaunched first."""
-        codes = [p.poll() for p in self.processes]
-        if self.respawn:
-            for i, code in enumerate(codes):
-                if code is not None:
-                    logger.warning(
-                        "instance %d exited with %s; respawning", i, code
-                    )
-                    self.processes[i] = self._spawn(self._argvs[i])
-                    codes[i] = None
-        return codes
+        ``respawn=True`` dead non-retired instances are relaunched
+        first. Retired slots report their exit code and are never
+        respawned."""
+        with self._lock:
+            codes = [p.poll() for p in self.processes]
+            if self.respawn:
+                for i, code in enumerate(codes):
+                    if code is not None and i not in self._retired:
+                        logger.warning(
+                            "instance %d exited with %s; respawning", i, code
+                        )
+                        self.processes[i] = self._spawn(self._argvs[i])
+                        codes[i] = None
+            return codes
+
+    def poll_processes(self) -> list:
+        """Per-instance exit codes with NO respawn side effect — the
+        fleet controller's liveness read (it owns the respawn decision
+        via :meth:`respawn_instance`)."""
+        with self._lock:
+            return [p.poll() for p in self.processes]
 
     def assert_alive(self) -> None:
-        """Raise if any instance died (reference ``launcher.py:166-171``)."""
-        if not self.processes:
-            return
-        codes = self.poll()
-        dead = {i: c for i, c in enumerate(codes) if c is not None}
+        """Raise if any non-retired instance died (reference
+        ``launcher.py:166-171``)."""
+        with self._lock:
+            if not self.processes:
+                return
+            codes = self.poll()
+            dead = {
+                i: c for i, c in enumerate(codes)
+                if c is not None and i not in self._retired
+            }
         if dead:
             raise RuntimeError(f"producer instances died (id: exitcode) {dead}")
 
@@ -293,6 +354,187 @@ class ProcessLauncher:
         """Block until all instances exit; returns exit codes
         (reference ``launcher.py:173-175``)."""
         return [p.wait() for p in self.processes]
+
+    # -- elastic membership --------------------------------------------------
+
+    @property
+    def retired(self) -> frozenset:
+        return frozenset(self._retired)
+
+    def active_indices(self) -> list:
+        """Instance indices currently part of the fleet (not retired);
+        momentarily-dead instances count — they are respawn material,
+        not departures."""
+        with self._lock:
+            return [
+                i for i in range(len(self.processes))
+                if i not in self._retired
+            ]
+
+    def active_count(self) -> int:
+        return len(self.active_indices())
+
+    def instance_sockets(self, i: int) -> dict:
+        """``{socket_name: addr}`` of one instance."""
+        assert self.launch_info is not None, "not launched"
+        return {
+            n: self.launch_info.addresses[n][i] for n in self.named_sockets
+        }
+
+    def _watch_bind(self, proc, grace_s: float):
+        """Poll a fresh spawn through the bind window; returns its exit
+        code if it died within ``grace_s`` (bind failure signature),
+        None if it is still running."""
+        deadline = time.monotonic() + max(0.0, grace_s)
+        while True:
+            code = proc.poll()
+            if code is not None:
+                return code
+            if time.monotonic() >= deadline:
+                return None
+            time.sleep(0.05)
+
+    def add_instance(self, extra_args=None, bind_grace_s: float | None = None):
+        """Grow the fleet by one instance; returns ``(index, sockets)``.
+
+        The new instance gets the next btid/seed on the ladder and a
+        fresh address per named socket. ``extra_args=None`` INHERITS
+        the highest active instance's args (a scale-up must match the
+        running fleet's shape/encoding config, or the consumer's
+        decoder meets mismatched frames mid-run); pass ``[]``
+        explicitly for a bare instance. The free-port probe is
+        inherently racy (the port is probed-then-closed before the
+        producer binds), and incremental scaling allocates one port at
+        a time — so a spawn that dies within the bind grace window is
+        retried up to ``BIND_RETRIES`` times with NEWLY probed
+        addresses instead of failing the scale-up. Deterministic
+        (``start_port``) and ipc address plans are not re-probed: an
+        early death there is a real producer failure.
+        """
+        with self._lock:
+            assert self.launch_info is not None, "not launched"
+            i = self.num_instances
+            grace = self.bind_grace_s if bind_grace_s is None else bind_grace_s
+            if extra_args is None:
+                active = self.active_indices()
+                extra_args = self.instance_args[active[-1]] if active else []
+            args = [str(a) for a in extra_args]
+            reprobe = self.start_port is None and self.proto != "ipc"
+            attempts = (self.BIND_RETRIES + 1) if reprobe else 1
+            last_code = None
+            for attempt in range(attempts):
+                sockets = self._instance_addresses(i)
+                argv = self._instance_argv(i, sockets, extra=args)
+                proc = self._spawn(argv)
+                code = self._watch_bind(proc, grace)
+                if code is None:
+                    self.num_instances += 1
+                    self.instance_args.append(args)
+                    self._argvs.append(argv)
+                    self.processes.append(proc)
+                    for name in self.named_sockets:
+                        self.launch_info.addresses[name].append(sockets[name])
+                    self.launch_info.commands.append(
+                        " ".join(map(str, argv))
+                    )
+                    self.launch_info.processes.append(proc.pid)
+                    logger.info(
+                        "added instance %d (attempt %d): %s",
+                        i, attempt + 1, " ".join(map(str, argv)),
+                    )
+                    return i, sockets
+                last_code = code
+                if attempt + 1 < attempts:
+                    logger.warning(
+                        "instance %d died with %s within %.1fs of launch "
+                        "(probed port likely stolen before bind); retrying "
+                        "with fresh addresses", i, code, grace,
+                    )
+            raise RuntimeError(
+                f"instance {i} failed to come up "
+                f"({attempts} attempt(s), last exit code {last_code})"
+            )
+
+    def retire_instance(self, i: int, drain: bool = True,
+                        timeout: float = 5.0) -> dict:
+        """Remove instance ``i`` from the fleet; returns its sockets.
+
+        ``drain=True`` sends SIGTERM to the process group and waits up
+        to ``timeout`` for a clean exit — a producer with a graceful
+        TERM handler flushes its publish queue on the way out
+        (``term_context``), so in-flight frames reach the consumer
+        instead of dying in the send queue. Only then (or with
+        ``drain=False``, immediately) is the group SIGKILLed. The slot
+        stays in place (indices == btids stay stable); ``poll``/
+        ``assert_alive``/respawn skip it from now on.
+        """
+        with self._lock:
+            if not (0 <= i < len(self.processes)):
+                raise IndexError(f"no instance {i}")
+            if i in self._retired:
+                return self.instance_sockets(i)
+            self._retired.add(i)
+            proc = self.processes[i]
+            sockets = self.instance_sockets(i)
+        if proc.poll() is None:
+            if drain:
+                try:
+                    os.killpg(os.getpgid(proc.pid), signal.SIGTERM)
+                except (ProcessLookupError, PermissionError):
+                    pass
+                try:
+                    proc.wait(timeout=timeout)
+                except subprocess.TimeoutExpired:
+                    logger.warning(
+                        "instance %d did not drain within %.1fs; killing",
+                        i, timeout,
+                    )
+            if proc.poll() is None:
+                try:
+                    os.killpg(os.getpgid(proc.pid), signal.SIGKILL)
+                except (ProcessLookupError, PermissionError):
+                    pass
+                try:
+                    proc.wait(timeout=timeout)
+                except subprocess.TimeoutExpired:
+                    pass
+        logger.info("retired instance %d (%s)", i, sockets)
+        return sockets
+
+    def respawn_instance(self, i: int):
+        """Relaunch a dead instance in place (same argv, same btid —
+        the consumer's lineage reads the fresh seq numbering as a
+        producer RESTART, not a drop storm). The fleet controller's
+        explicit counterpart of ``respawn=True``."""
+        with self._lock:
+            if i in self._retired:
+                raise ValueError(f"instance {i} is retired")
+            if self.processes[i].poll() is None:
+                return self.processes[i]
+            proc = self._spawn(self._argvs[i])
+            self.processes[i] = proc
+            self.launch_info.processes[i] = proc.pid
+            logger.warning("respawned instance %d (pid %d)", i, proc.pid)
+            return proc
+
+    def scale_to(self, n: int, extra_args=None):
+        """Grow/shrink the active fleet to ``n`` instances; returns
+        ``(added, removed)`` as lists of ``(index, sockets)``. Shrinks
+        retire the highest-index active instances (with drain); growth
+        goes through :meth:`add_instance`'s retrying allocation. NOTE:
+        runs subprocess lifecycle (blocking waits) — call from a
+        control thread, never from an ingest/draw hot path (BJX110)."""
+        assert n >= 0
+        added, removed = [], []
+        with self._lock:
+            while self.active_count() < n:
+                added.append(self.add_instance(extra_args=extra_args))
+            while self.active_count() > n:
+                victim = self.active_indices()[-1]
+                removed.append(
+                    (victim, self.retire_instance(victim, drain=True))
+                )
+        return added, removed
 
     def __exit__(self, exc_type=None, exc=None, tb=None) -> bool:
         for p in self.processes:
@@ -318,6 +560,7 @@ class ProcessLauncher:
         # All children must be gone (reference asserts, ``launcher.py:181``).
         still = [p.pid for p in self.processes if p.poll() is None]
         self.processes = []
+        self._retired = set()
         if self._ipc_dir is not None:
             # SIGTERM'd producers never unlink their unix sockets; stale
             # files would also break rebinding after a respawn.

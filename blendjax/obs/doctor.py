@@ -276,7 +276,8 @@ def diagnose(
                     f"(wait_fresh share={shares['echo.wait_fresh']:.0%}, "
                     f"echo factor {factor}): the reservoir can't echo "
                     "any further under its budget",
-                    "raise producer instances, reservoir capacity, or "
+                    "raise producer instances (blendjax.fleet autoscales "
+                    "on this verdict), reservoir capacity, or "
                     "max_echo_factor",
                     shares,
                 )
@@ -296,9 +297,10 @@ def diagnose(
             f"consumer starving (queue_wait share="
             f"{shares['ingest.queue_wait']:.0%}) while frames arrive "
             f"fresh ({fresh}): producers don't render fast enough",
-            "launch more producer instances or cheapen the scene/render "
-            "— or absorb the gap with data echoing "
-            "(blendjax.data.EchoingPipeline)",
+            "launch more producer instances — by hand or via "
+            "blendjax.fleet.FleetController, which autoscales on this "
+            "verdict — cheapen the scene/render, or absorb the gap "
+            "with data echoing (blendjax.data.EchoingPipeline)",
             shares,
         )
 

@@ -224,6 +224,31 @@ class FrameLineage:
         with self._lock:
             return sum(p.gaps for p in self._producers.values())
 
+    # -- elastic membership ---------------------------------------------------
+
+    def register(self, btid) -> None:
+        """Pre-register a producer (fleet admission): its entry exists
+        before the first frame, so the fleet view shows a joining
+        member immediately. ``ingest`` would create it lazily anyway —
+        a brand-new btid starts tracking at its first observed seq, so
+        joining mid-run can never read as a drop storm."""
+        with self._lock:
+            if btid not in self._producers:
+                self._producers[btid] = _Producer()
+
+    def retire(self, btid) -> bool:
+        """Drop a producer's lineage state on clean retirement (fleet
+        scale-down). Without this a retired slot's stale seq state
+        would (a) keep a dead member in every ``report()`` forever and
+        (b) — if the btid is ever reused by a NEW producer numbering
+        from its own 0 — count the rejoin as a restart plus reorder
+        noise instead of fresh tracking. Returns True when state
+        existed. NOT for crashes: a respawned producer reuses its slot
+        and the seq==0 restart detection is the correct accounting
+        there."""
+        with self._lock:
+            return self._producers.pop(btid, None) is not None
+
     def reset(self) -> None:
         with self._lock:
             self._producers.clear()

@@ -72,11 +72,18 @@ class StatsReporter:
         history: int = 32,
         jsonl_rotate_bytes: int | None = DEFAULT_ROTATE_BYTES,
         jsonl_keep: int = 3,
+        fleet=None,
     ):
         self.interval_s = float(interval_s)
         self.registry = registry
         self.lineage = lineage
         self.driver_stats = driver_stats
+        # Optional FleetController (or anything with .state() -> dict):
+        # its instance count / streaks / scale-event log are archived
+        # beside the verdict each tick, so a JSONL trail answers "what
+        # did the fleet do when the verdict flipped" without correlating
+        # two logs.
+        self.fleet = fleet
         self.log = log
         self._jsonl = (
             JsonlExporter(
@@ -140,6 +147,11 @@ class StatsReporter:
             }
             if self.watchdog is not None:
                 extra["slo"] = self.watchdog.state()
+            if self.fleet is not None:
+                try:
+                    extra["fleet"] = self.fleet.state()
+                except Exception:
+                    self.log.exception("fleet state snapshot failed")
             # Echoing runs get their accounting surfaced beside the
             # verdict (fresh/echoed counters sum exactly to drawn
             # samples; the echo-mitigated/saturated arms read these).

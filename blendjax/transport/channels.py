@@ -323,6 +323,32 @@ class DataReceiverSocket(_Channel):
             )
         return out
 
+    # -- elastic membership (fleet controller substrate) ---------------------
+    # ZMQ sockets are single-thread: both calls below must run on the
+    # thread that owns this socket (RemoteStream queues membership ops
+    # and applies them from its iterating thread — see
+    # ``blendjax.data.stream``).
+
+    def connect(self, addr: str) -> None:
+        """Admit one more producer endpoint into the fan-in (idempotent
+        at the bookkeeping level; duplicate connects are skipped)."""
+        if addr in self.addresses:
+            return
+        self.sock.connect(addr)
+        self.addresses.append(addr)
+
+    def disconnect(self, addr: str) -> None:
+        """Retire one producer endpoint. NOTE: zmq drops messages still
+        queued on that endpoint's pipe — drain first (retire the
+        producer, keep receiving through a grace window) or the tail is
+        lost."""
+        try:
+            self.sock.disconnect(addr)
+        except zmq.ZMQError:
+            pass  # already gone (e.g. peer closed the transport)
+        if addr in self.addresses:
+            self.addresses.remove(addr)
+
 
 
 class PairChannel(_Channel):

@@ -112,6 +112,24 @@ def main():
         "trace, and the breaching rule states (needs --slo)",
     )
     ap.add_argument(
+        "--fleet", default=None, metavar="MIN:MAX",
+        help="elastic producer autoscaling (blendjax.fleet, docs/"
+        "fleet.md): start MIN producers and let a FleetController "
+        "grow/shrink the fleet between MIN and MAX on live stall-"
+        "doctor verdicts — up on producer-bound/echo-saturated, down "
+        "on step-bound/idle, crashed instances respawned in place; "
+        "with --slo, a breaching watchdog blocks scale-down. The "
+        "scale-event log prints beside the doctor verdict at exit",
+    )
+    ap.add_argument(
+        "--synthetic-producers", type=int, default=0, metavar="N",
+        help="replace the cube producers with N Blender-free synthetic "
+        "producers (blendjax.fleet.synthetic: the native rasterizer at "
+        "~1,100 frames/s each, raw frames only) — the high-rate tier "
+        "that reaches step-bound and scale-down regimes Blender "
+        "cannot. Composes with --fleet (MIN wins as the start count)",
+    )
+    ap.add_argument(
         "--augment", action="store_true",
         help="on-device color jitter inside the jitted step "
         "(blendjax.ops.augment; per-step deterministic keys). Only "
@@ -120,6 +138,23 @@ def main():
         "without a matching label transform.",
     )
     args = ap.parse_args()
+
+    fleet_bounds = None
+    if args.fleet:
+        try:
+            lo, hi = (int(v) for v in args.fleet.split(":"))
+        except ValueError:
+            ap.error("--fleet expects MIN:MAX, e.g. --fleet 1:4")
+        if not 1 <= lo <= hi:
+            ap.error("--fleet needs 1 <= MIN <= MAX")
+        if args.replay:
+            ap.error("--fleet scales live producers; drop --replay")
+        fleet_bounds = (lo, hi)
+    if args.synthetic_producers and args.encoding != "raw":
+        ap.error(
+            "--synthetic-producers publishes raw frames: use "
+            "--encoding raw"
+        )
 
     import jax
 
@@ -285,18 +320,33 @@ def main():
                 run_steps(iter(source))
             return
 
-        producer_args = ["--shape", str(h), str(w),
-                         "--trace-every", str(args.trace_every)]
-        if args.encoding in ("tile", "pal"):
-            producer_args += [
-                "--batch", str(args.batch), "--encoding", args.encoding,
+        if args.synthetic_producers:
+            from blendjax.fleet import SYNTHETIC_PRODUCER
+
+            script = SYNTHETIC_PRODUCER
+            producer_args = [
+                "--shape", str(h), str(w), "--batch", str(args.batch),
+                "--trace-every", str(args.trace_every),
             ]
+            start_n = args.synthetic_producers
+        else:
+            script = __file__.replace("train.py", "cube_producer.py")
+            producer_args = ["--shape", str(h), str(w),
+                             "--trace-every", str(args.trace_every)]
+            if args.encoding in ("tile", "pal"):
+                producer_args += [
+                    "--batch", str(args.batch),
+                    "--encoding", args.encoding,
+                ]
+            start_n = args.instances
+        if fleet_bounds:
+            start_n = fleet_bounds[0]
         with PythonProducerLauncher(
-            script=__file__.replace("train.py", "cube_producer.py"),
-            num_instances=args.instances,
+            script=script,
+            num_instances=start_n,
             named_sockets=["DATA"],
             seed=0,
-            instance_args=[producer_args] * args.instances,
+            instance_args=[producer_args] * start_n,
         ) as launcher:
             pipe = StreamDataPipeline(
                 launcher.addresses["DATA"],
@@ -306,11 +356,52 @@ def main():
                 emit_packed=use_fused,
                 record_path_prefix=args.record,
             )
-            with wrap_echo(pipe) as source:
-                run_steps(iter(source))
-                if echo_mode:
-                    print(f"echo={source.stats}")
-                print(source.doctor(driver).render())
+            ctrl = None
+            if fleet_bounds:
+                from blendjax.fleet import FleetController, FleetPolicy
+
+                # the controller's own daemon thread runs the blocking
+                # launcher lifecycle (BJX110); the pipeline applies the
+                # connect/disconnect ops from its socket-owning thread
+                ctrl = FleetController(
+                    launcher, connector=pipe,
+                    policy=FleetPolicy(
+                        min_instances=fleet_bounds[0],
+                        max_instances=fleet_bounds[1],
+                    ),
+                    diagnose=lambda: pipe.doctor(driver),
+                    health=(
+                        (lambda: reporter.healthy)
+                        if reporter is not None else None
+                    ),
+                    instance_args=producer_args,
+                ).start()
+                if reporter is not None:
+                    # fleet state rides the JSONL archive per tick
+                    reporter.fleet = ctrl
+            try:
+                with wrap_echo(pipe) as source:
+                    run_steps(iter(source))
+                    if echo_mode:
+                        print(f"echo={source.stats}")
+                    print(source.doctor(driver).render())
+                    if ctrl is not None:
+                        st = ctrl.state()
+                        print(
+                            f"fleet: instances={st['instances']} "
+                            f"(bounds {st['min']}:{st['max']}), "
+                            f"ticks={st['ticks']}, "
+                            f"last verdict={st['verdict']}"
+                        )
+                        for ev in ctrl.scale_events():
+                            detail = {
+                                k: v for k, v in ev.items()
+                                if k not in ("t", "action")
+                            }
+                            print(f"  fleet {ev['action']}: {detail}")
+            finally:
+                if ctrl is not None:
+                    ctrl.stop()
     finally:
         if reporter is not None:
             reporter.stop()  # final tick logs the closing verdict

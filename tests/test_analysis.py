@@ -931,10 +931,81 @@ def test_bjx109_inline_suppression():
     assert rule_ids(src, select=["BJX109"]) == []
 
 
+# -- BJX110 fleet-thread-affinity ---------------------------------------------
+
+
+def test_bjx110_flags_launcher_lifecycle_in_hot_path():
+    src = """
+        # bjx: hot-path
+
+        def on_timeout(self):
+            self.launcher.assert_alive()
+            return True
+
+        def rebalance(launcher, n):
+            launcher.scale_to(n)
+
+        def drain(blender_launcher):
+            blender_launcher.retire_instance(0, drain=True)
+            blender_launcher.wait()
+    """
+    got = findings(src, select=["BJX110"])
+    assert [f.rule for f in got] == ["BJX110"] * 4
+    assert "assert_alive" in got[0].message
+    assert "control thread" in got[0].message
+
+
+def test_bjx110_negatives_non_launcher_receivers_and_unmarked():
+    # generic wait()s — trackers, events, subprocesses — are out of
+    # scope: the receiver gate requires a launcher-like name
+    src = """
+        # bjx: hot-path
+
+        def publish(tracker, proc, event):
+            tracker.wait()
+            event.wait(1.0)
+            proc.wait(timeout=5)
+    """
+    assert rule_ids(src, select=["BJX110"]) == []
+    # unmarked modules may drive the launcher freely (the controller
+    # module itself, bench code, tests)
+    unmarked = """
+        def control_tick(launcher):
+            launcher.scale_to(3)
+            launcher.wait()
+    """
+    assert rule_ids(unmarked, select=["BJX110"]) == []
+    # non-lifecycle launcher calls stay clean
+    reads = """
+        # bjx: hot-path
+
+        def fleet_size(launcher):
+            return launcher.active_count()
+    """
+    assert rule_ids(reads, select=["BJX110"]) == []
+
+
+def test_bjx110_hot_by_basename_and_inline_suppression():
+    src = """
+        def iterate(self):
+            self.launcher.poll_processes()
+    """
+    assert rule_ids(src, relpath="pipeline.py", select=["BJX110"]) == [
+        "BJX110"
+    ]
+    suppressed = """
+        def iterate(self):
+            self.launcher.poll_processes()  # bjx: ignore[BJX110]
+    """
+    assert rule_ids(
+        suppressed, relpath="pipeline.py", select=["BJX110"]
+    ) == []
+
+
 def test_every_rule_registered():
     assert set(all_rules()) == {
         "BJX101", "BJX102", "BJX103", "BJX104", "BJX105", "BJX106",
-        "BJX107", "BJX108", "BJX109",
+        "BJX107", "BJX108", "BJX109", "BJX110",
     }
 
 

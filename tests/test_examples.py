@@ -86,6 +86,19 @@ def test_datagen_train_echo(monkeypatch, capsys):
     assert "doctor:" in out
 
 
+def test_datagen_train_synthetic_fleet(monkeypatch, capsys):
+    run_main(
+        monkeypatch, "datagen/train.py",
+        "--steps", "4", "--batch", "8", "--shape", "32", "32",
+        "--synthetic-producers", "1", "--fleet", "1:2",
+    )
+    out = capsys.readouterr().out
+    assert "step 0: loss=" in out and "images/sec" in out
+    assert "doctor:" in out
+    # the scale-event log prints beside the verdict at exit
+    assert "fleet: instances=" in out and "(bounds 1:2)" in out
+
+
 def test_datagen_train_record_then_replay(monkeypatch, capsys, tmp_path):
     prefix = str(tmp_path / "rec")
     run_main(
