@@ -111,6 +111,32 @@ LIVE_ECHO_FACTORS = tuple(
 LIVE_FLEET = os.environ.get("BLENDJAX_BENCH_LIVE_FLEET", "1") == "1"
 FLEET_RATE = float(os.environ.get("BLENDJAX_BENCH_FLEET_RATE", "40"))
 FLEET_MAX = int(os.environ.get("BLENDJAX_BENCH_FLEET_MAX", "4"))
+# Multi-chip live row (docs/performance.md "Going multi-chip"): the
+# SAME live pipeline (synthetic producers -> ShardedHostIngest ->
+# DeviceFeeder -> MeshTrainDriver) at mesh sizes 1/2/4/8 with a FIXED
+# per-chip batch (weak scaling — the regime real DP runs in), on a
+# forced 8-device CPU mesh in a SUBPROCESS (the device count must be
+# set before the backend initializes, which this process already did).
+# Reports img/s per mesh size, the 8-vs-1 speedup, and
+# scaling_efficiency = speedup / 8; CI asserts the structural
+# contracts (dispatch_per_step == 1.0, seq_gaps == 0, efficiency
+# reported). Pure CPU — runs identically in degraded weather.
+MULTICHIP_LIVE = os.environ.get("BLENDJAX_BENCH_MULTICHIP", "1") == "1"
+MULTICHIP_MESHES = tuple(
+    int(v) for v in os.environ.get(
+        "BLENDJAX_BENCH_MULTICHIP_MESHES", "1,2,4,8"
+    ).split(",") if v
+)
+MULTICHIP_TIME_CAP_S = float(
+    os.environ.get("BLENDJAX_BENCH_MULTICHIP_TIME_CAP_S", "5")
+)
+# Interleaved passes, best-of per leg — the same window-noise defense
+# the headline rows use (BLENDJAX_BENCH_PASSES): on shared-core hosts
+# a single 5s window swings 2x, and the interleaving keeps any one
+# weather window from biasing one mesh size.
+MULTICHIP_PASSES = int(
+    os.environ.get("BLENDJAX_BENCH_MULTICHIP_PASSES", "2")
+)
 # The non-sparse row's codec: 'pal' (lossless full-frame palette; 4-8x
 # fewer bytes across socket AND host->device, decoded by a device
 # gather) or 'raw' (uncompressed frames). pal chunk-groups 8 batches
@@ -1473,6 +1499,193 @@ def measure_live_fleet(time_cap: float = 12.0, rate: float | None = None,
     return row
 
 
+def _multichip_live_legs(mesh_sizes=None, time_cap: float | None = None,
+                         b_dev: int = 2, shape=(16, 16)) -> dict:
+    """The in-process body of the ``multichip_live`` row: the live
+    pipeline on a named mesh at each requested size, fixed per-chip
+    batch (weak scaling). Requires the process to already hold >=
+    max(mesh_sizes) devices — the bench parent runs this in a
+    subprocess via ``bench.py --multichip-live`` (see
+    :func:`measure_multichip_live`); tests call it directly on their
+    8-device CPU mesh.
+
+    Each leg: 2 unthrottled synthetic producers (blendjax.fleet) ->
+    ShardedHostIngest (2 workers) -> DeviceFeeder mesh placement ->
+    MeshTrainDriver (pinned-sharding step, inflight=4). Per-chip batch
+    stays fixed so the global batch grows with the mesh — the regime
+    real data parallelism runs in, and the one that amortizes every
+    per-batch host cost (ingest pop, placement call, dispatch) over N
+    chips' worth of images."""
+    import jax
+    import jax.numpy as jnp
+
+    from blendjax.data import StreamDataPipeline
+    from blendjax.fleet import synthetic_fleet
+    from blendjax.models import CubeRegressor
+    from blendjax.obs.lineage import lineage
+    from blendjax.parallel import create_mesh
+    from blendjax.train import MeshTrainDriver
+    from blendjax.utils.metrics import metrics as reg
+
+    mesh_sizes = tuple(mesh_sizes or MULTICHIP_MESHES)
+    time_cap = MULTICHIP_TIME_CAP_S if time_cap is None else time_cap
+    avail = len(jax.devices())
+    fit = tuple(n for n in mesh_sizes if n <= avail)
+    if not fit:
+        # name the misconfiguration instead of dying on fit[0] below
+        # (the parent would only see an opaque subprocess rc=1)
+        raise ValueError(
+            f"no requested mesh size {mesh_sizes} fits the {avail} "
+            "available devices — check BLENDJAX_BENCH_MULTICHIP_MESHES"
+        )
+    mesh_sizes = fit
+    legs: dict = {}
+    seq_gaps = 0
+
+    def one_leg(n_dev: int) -> dict:
+        nonlocal seq_gaps
+        reg.reset()
+        lineage.reset()
+        gb = b_dev * n_dev
+        mesh = create_mesh(
+            {"data": n_dev}, devices=jax.devices()[:n_dev]
+        )
+        with synthetic_fleet(
+            2, shape=shape, batch=gb, bind_grace_s=0.5
+        ) as launcher:
+            drv = MeshTrainDriver.build(
+                CubeRegressor(features=(4,), dtype=jnp.float32), mesh,
+                np.zeros((gb, *shape, 4), np.uint8),
+                sync_every=0, inflight=4,
+            )
+            with StreamDataPipeline(
+                launcher.addresses["DATA"], batch_size=gb, mesh=mesh,
+                ingest_workers=2, timeoutms=30_000,
+            ) as pipe:
+                it = iter(pipe)
+                for _ in range(4):  # compile (twice: donated layouts)
+                    drv.submit(next(it))
+                drv.drain()
+                reg.reset()  # spans cover the measured window only
+                steps0, blocks0 = drv.steps, drv.host_blocks
+                t0 = time.perf_counter()
+                while time.perf_counter() - t0 < time_cap:
+                    drv.submit(next(it))
+                final_loss = drv.drain()
+                dt = time.perf_counter() - t0
+        steps = drv.steps - steps0
+        spans = reg.report()["spans"]
+        train_calls = spans.get("train.dispatch", {}).get("count", 0)
+        decode_calls = spans.get("decode.dispatch", {}).get("count", 0)
+        gaps = lineage.total_gaps()
+        seq_gaps += gaps
+        return {
+            "img_s": round(steps * gb / dt, 1),
+            "steps": steps,
+            "global_batch": gb,
+            "per_chip_batch": b_dev,
+            "seconds": round(dt, 2),
+            "host_blocks": drv.host_blocks - blocks0,
+            "train_dispatch_count": train_calls,
+            "decode_dispatch_count": decode_calls,
+            "dispatch_per_step": (
+                round((train_calls + decode_calls) / steps, 3)
+                if steps else None
+            ),
+            "seq_gaps": gaps,
+            "final_loss": final_loss,
+        }
+
+    # Interleaved passes, best-of per mesh size (the headline rows'
+    # window-noise defense): the dispatch/gap contracts must hold on
+    # EVERY pass — a kept best-throughput leg can't hide a contract
+    # breach from a discarded one.
+    contract_ok = True
+    for _ in range(max(1, MULTICHIP_PASSES)):
+        for n_dev in mesh_sizes:
+            got = one_leg(n_dev)
+            contract_ok = contract_ok and (
+                got["dispatch_per_step"] == 1.0
+                and got["decode_dispatch_count"] == 0
+            )
+            key = str(n_dev)
+            if key not in legs or got["img_s"] > legs[key]["img_s"]:
+                legs[key] = got
+    row: dict = {
+        "legs": legs,
+        "seq_gaps": seq_gaps,
+        "b_dev": b_dev,
+        "passes": max(1, MULTICHIP_PASSES),
+        "contracts_held_every_pass": contract_ok,
+        # Scaling on a FORCED CPU mesh is bounded by real cores: the 8
+        # virtual devices share this many, so read the efficiency
+        # against min(cores, mesh) — on real multi-chip hardware each
+        # mesh step runs on its own silicon and the same row reads
+        # near-linear.
+        "cpu_count": os.cpu_count(),
+    }
+    first, last = str(mesh_sizes[0]), str(mesh_sizes[-1])
+    if first != last and legs[first]["img_s"]:
+        speedup = legs[last]["img_s"] / legs[first]["img_s"]
+        row["speedup"] = round(speedup, 3)
+        row["scaling_efficiency"] = round(
+            speedup * mesh_sizes[0] / mesh_sizes[-1], 3
+        )
+        row["value"] = row["speedup"]
+    # the contracts CI asserts, lifted from the LARGEST mesh leg (the
+    # one where a broken invariant would hide best)
+    row["dispatch_per_step"] = legs[last]["dispatch_per_step"]
+    row["decode_dispatch_eliminated"] = all(
+        leg["decode_dispatch_count"] == 0 for leg in legs.values()
+    )
+    return row
+
+
+def measure_multichip_live(timeout_s: float = 420.0) -> dict:
+    """Run the multichip legs in a SUBPROCESS on a forced 8-device CPU
+    mesh (``bench.py --multichip-live``): this process's backend is
+    already initialized with the real device topology, and
+    ``xla_force_host_platform_device_count`` only takes effect before
+    first use. The child prints one JSON line; weak-scaling img/s at
+    mesh 1/2/4/8 with the structural contracts comes back in it."""
+    import subprocess
+
+    proc = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), "--multichip-live"],
+        capture_output=True, text=True, timeout=timeout_s,
+        cwd=os.path.dirname(os.path.abspath(__file__)),
+    )
+    lines = [
+        ln for ln in (proc.stdout or "").strip().splitlines()
+        if ln.startswith("{")
+    ]
+    if proc.returncode != 0 or not lines:
+        return {
+            "error": (
+                f"rc={proc.returncode} "
+                f"stderr={(proc.stderr or '')[-300:]}"
+            )
+        }
+    return json.loads(lines[-1])
+
+
+def _multichip_live_main() -> None:
+    """``bench.py --multichip-live`` entry: force the 8-device CPU
+    platform BEFORE the first backend query (same dance as
+    ``__graft_entry__.dryrun_multichip`` — the image's sitecustomize
+    pins the TPU plugin regardless of JAX_PLATFORMS), run the legs,
+    print one JSON line."""
+    import jax
+
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            f"{flags} --xla_force_host_platform_device_count=8"
+        ).strip()
+    jax.config.update("jax_platforms", "cpu")
+    print(json.dumps(_multichip_live_legs()))
+
+
 def measure_rl_hz(seconds: float = 3.0) -> dict:
     """Full REQ/REP rendezvous stepping rate, rendering off (the
     reference's '2000 Hz are easily achieved' row, ``Readme.md:95``;
@@ -1877,6 +2090,17 @@ def _build_record(progress: dict) -> dict:
             detail["live_fleet"] = measure_live_fleet()
         except Exception as e:  # pragma: no cover - spawn flake path
             detail["live_fleet"] = {"error": repr(e)[:200]}
+    if MULTICHIP_LIVE:
+        # Multi-chip live row (docs/performance.md "Going multi-chip"):
+        # the live pipeline at mesh sizes 1/2/4/8 on a forced 8-device
+        # CPU mesh in a subprocess, fixed per-chip batch. Pure CPU and
+        # weather-independent like the fleet row; CI asserts
+        # dispatch_per_step == 1.0 and seq_gaps == 0 and that
+        # scaling_efficiency is reported.
+        try:
+            detail["multichip_live"] = measure_multichip_live()
+        except Exception as e:  # pragma: no cover - spawn flake path
+            detail["multichip_live"] = {"error": repr(e)[:200]}
     if ENCODING == "tile" and INGEST_AB and not degraded:
         # Sharded-ingest A/B (same weather regime as the headline): does
         # a second recv/decode worker raise end-to-end img/s on THIS
@@ -2016,4 +2240,6 @@ def main() -> None:
 
 
 if __name__ == "__main__":
+    if "--multichip-live" in sys.argv:
+        sys.exit(_multichip_live_main())
     sys.exit(main())

@@ -7,6 +7,7 @@ from blendjax.analysis.rules import (  # noqa: F401  (registration side effects)
     driver_sync,
     fleet_affinity,
     hotpath,
+    mesh_placement,
     metric_names,
     purity,
     reservoir_sync,

@@ -1,0 +1,222 @@
+"""BJX111 mesh-placement: per-device host loops and host
+materialization of global arrays in mesh hot paths.
+
+The multi-chip live pipeline's placement contract (docs/performance.md
+"Going multi-chip"): a host batch becomes a global ``jax.Array`` in ONE
+placement call — a grouped ``device_put`` under a ``NamedSharding``, or
+one ``make_array_from_process_local_data`` per field on multihost. Two
+anti-patterns silently reintroduce per-chip host work that scales the
+host cost with the mesh size:
+
+- a ``for``/comprehension over a device enumeration (``mesh.devices``,
+  ``jax.devices()``, ``jax.local_devices()``,
+  ``.addressable_devices``) that calls ``device_put`` per device —
+  N transfer RPCs and N host slices where the runtime would have done
+  one sharded placement;
+- host materialization of an assembled global array:
+  ``np.asarray``/``np.array``/``jax.device_get`` on a value bound from
+  ``make_array_from_process_local_data``, or ANY iteration over
+  ``.addressable_shards`` — each shard fetch is a device->host round
+  trip per chip, and downstream compute on the result runs on the
+  host.
+
+Scope: modules opting in with a ``bjx: mesh-hot-path`` marker comment,
+plus ``pipeline.py`` and ``mesh_driver.py`` by basename (the placement
+layer and the mesh driver are always mesh-hot). Inspection/debug code
+outside those modules — or a justified exception inside them — uses
+``# bjx: ignore[BJX111]``.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Iterator, Sequence
+
+from blendjax.analysis.core import (
+    Finding,
+    ModuleContext,
+    Rule,
+    register,
+    walk_shallow,
+)
+from blendjax.analysis.rules.driver_sync import _names
+
+MESH_HOT_BASENAMES = {"pipeline.py", "mesh_driver.py"}
+# Comment lines only (same contract as the hot-path / driver-hot-path
+# markers): the marker quoted in a docstring must not opt a module in.
+MESH_MARKER_RE = re.compile(r"^\s*#.*bjx: mesh-hot-path", re.MULTILINE)
+
+DEVICE_ENUM_ATTRS = {
+    "devices",
+    "local_devices",
+    "addressable_devices",
+    "devices_flat",
+}
+DEVICE_ENUM_CALLS = {"jax.devices", "jax.local_devices"}
+GLOBAL_ASSEMBLY_CALLS = {"make_array_from_process_local_data"}
+HOST_FETCHES = {
+    "numpy.asarray",
+    "numpy.array",
+    "numpy.ascontiguousarray",
+    "jax.device_get",
+}
+
+
+def _is_mesh_hot(module: ModuleContext) -> bool:
+    if os.path.basename(module.relpath) in MESH_HOT_BASENAMES:
+        return True
+    return MESH_MARKER_RE.search(module.source[:4096]) is not None
+
+
+def _iterates_devices(it: ast.AST, module: ModuleContext) -> bool:
+    """True when an iterator expression enumerates devices: a bare
+    ``.devices``-style attribute (``mesh.devices``, possibly flattened
+    through ``.flat``/``np.ravel``) or a ``jax.devices()`` call."""
+    for node in ast.walk(it):
+        if isinstance(node, ast.Attribute) and node.attr in DEVICE_ENUM_ATTRS:
+            return True
+        if isinstance(node, ast.Call):
+            resolved = module.resolve(node.func) or ""
+            if resolved in DEVICE_ENUM_CALLS or resolved.endswith(
+                tuple("." + a for a in DEVICE_ENUM_ATTRS)
+            ):
+                return True
+    return False
+
+
+def _iterates_shards(it: ast.AST) -> bool:
+    return any(
+        isinstance(node, ast.Attribute)
+        and node.attr == "addressable_shards"
+        for node in ast.walk(it)
+    )
+
+
+def _contains_device_put(
+    body_nodes: Sequence[ast.AST], module: ModuleContext
+) -> ast.Call | None:
+    for stmt in body_nodes:
+        for node in ast.walk(stmt):
+            if isinstance(node, ast.Call):
+                resolved = module.resolve(node.func) or ""
+                if resolved.split(".")[-1] == "device_put":
+                    return node
+    return None
+
+
+@register
+class MeshPlacementRule(Rule):
+    id = "BJX111"
+    name = "mesh-placement"
+    description = (
+        "per-device device_put loop or host materialization of a global "
+        "array (np.asarray on an assembled global / .addressable_shards "
+        "iteration) in a mesh hot path"
+    )
+
+    def check(self, module: ModuleContext) -> Iterator[Finding]:
+        if not _is_mesh_hot(module):
+            return
+        for qual, fn, _cls in module.iter_functions():
+            yield from self._scan(module, fn, qual)
+
+    def _scan(
+        self, module: ModuleContext, fn: ast.AST, qual: str
+    ) -> Iterator[Finding]:
+        nodes = list(walk_shallow(fn))
+        # names bound from a global-array assembly call: host-fetching
+        # those is a per-shard device->host round trip times the mesh
+        assembled: dict[str, int] = {}
+        for node in nodes:
+            if isinstance(node, ast.Assign) and isinstance(
+                node.value, ast.Call
+            ):
+                resolved = module.resolve(node.value.func) or ""
+                if resolved.split(".")[-1] in GLOBAL_ASSEMBLY_CALLS:
+                    for target in node.targets:
+                        for name in _names(target):
+                            line = getattr(node, "lineno", 0)
+                            if (
+                                name not in assembled
+                                or line < assembled[name]
+                            ):
+                                assembled[name] = line
+        for node in nodes:
+            # per-device placement loops (statement form)
+            if isinstance(node, (ast.For, ast.AsyncFor)):
+                if _iterates_devices(node.iter, module):
+                    put = _contains_device_put(node.body, module)
+                    if put is not None:
+                        yield self.finding(
+                            module,
+                            put,
+                            f"device_put inside a per-device loop in "
+                            f"'{qual}': place the whole batch ONCE "
+                            "under a NamedSharding (or "
+                            "make_array_from_process_local_data) and "
+                            "let the runtime fan out the shards",
+                        )
+                if _iterates_shards(node.iter):
+                    yield self.finding(
+                        module,
+                        node,
+                        f"iterating .addressable_shards in '{qual}' "
+                        "materializes every shard on the host (one "
+                        "fetch per chip): aggregate on device, or use "
+                        "a process-level report instead",
+                    )
+            # comprehension forms of both patterns
+            if isinstance(
+                node, (ast.ListComp, ast.SetComp, ast.GeneratorExp,
+                       ast.DictComp)
+            ):
+                for gen in node.generators:
+                    if _iterates_devices(gen.iter, module):
+                        put = _contains_device_put([node], module)
+                        if put is not None:
+                            yield self.finding(
+                                module,
+                                put,
+                                f"per-device device_put comprehension "
+                                f"in '{qual}': one sharded placement "
+                                "replaces the device loop",
+                            )
+                    if _iterates_shards(gen.iter):
+                        yield self.finding(
+                            module,
+                            node,
+                            f".addressable_shards comprehension in "
+                            f"'{qual}' fetches one shard per chip to "
+                            "the host — aggregate on device instead",
+                        )
+            # host materialization of an assembled global array
+            if isinstance(node, ast.Call):
+                resolved = module.resolve(node.func) or ""
+                if resolved in HOST_FETCHES and node.args:
+                    direct = any(
+                        isinstance(inner, ast.Call)
+                        and (
+                            (module.resolve(inner.func) or "").split(".")[-1]
+                            in GLOBAL_ASSEMBLY_CALLS
+                        )
+                        for inner in ast.walk(node.args[0])
+                    )
+                    hit = sorted(
+                        name for name in _names(node.args[0])
+                        if name in assembled
+                        and getattr(node, "lineno", 0) >= assembled[name]
+                    )
+                    if direct or hit:
+                        what = (
+                            f"'{hit[0]}'" if hit else "an assembled global"
+                        )
+                        yield self.finding(
+                            module,
+                            node,
+                            f"{resolved}() on {what} in '{qual}' pulls "
+                            "the whole global array (every process's "
+                            "shards) back to the host — keep it on "
+                            "device; export metrics, not arrays",
+                        )

@@ -97,10 +97,27 @@ class SampleReservoir:
     labels stay consistent with echoed images.
     """
 
-    def __init__(self, capacity: int, augment=None, rng=0):
+    def __init__(self, capacity: int, augment=None, rng=0, sharding=None):
         self.capacity = int(capacity)
         if self.capacity < 1:
             raise ValueError(f"capacity must be >= 1, got {capacity}")
+        # Mesh mode: ``sharding`` (a NamedSharding over the ring's
+        # leading axis, ``blendjax.parallel.ring_sharding(mesh)``)
+        # splits reservoir storage across the data axis — capacity
+        # scales with the mesh instead of replicating per chip, and
+        # the insert scatter / sample gather keep their donation and
+        # single-dispatch invariants via pinned out_shardings.
+        self.sharding = sharding
+        if sharding is not None:
+            from blendjax.parallel.sharding import leading_shard_count
+
+            ways = leading_shard_count(sharding)
+            if ways > 1 and self.capacity % ways:
+                raise ValueError(
+                    f"capacity={capacity} must divide evenly over the "
+                    f"{ways}-way sharded ring axis — every chip holds "
+                    "an equal slice of the reservoir"
+                )
         self.augment = augment
         self._rng_seed = rng
         self._buffers: dict | None = None
@@ -126,6 +143,11 @@ class SampleReservoir:
             k: jnp.zeros((self.capacity, *shape), dtype)
             for k, (shape, dtype) in self._spec.items()
         }
+        if self.sharding is not None:
+            # One placement for the whole ring pytree: the storage is
+            # born sharded, so the donated scatter below reuses the
+            # sharded buffers in place forever after.
+            self._buffers = jax.device_put(self._buffers, self.sharding)
         capacity = self.capacity
 
         def _insert(bufs, batch, cursor):
@@ -137,8 +159,18 @@ class SampleReservoir:
 
         # Donated buffers: the scatter updates the ring in place, so
         # insert never reallocates the (potentially multi-GB) reservoir
-        # and the train loop's memory footprint is flat.
-        self._insert_fn = jax.jit(_insert, donate_argnums=(0,))
+        # and the train loop's memory footprint is flat. Under a mesh
+        # sharding the output layout is PINNED to the ring sharding —
+        # donation requires matching in/out layouts, and an inferred
+        # output layout drifting (e.g. toward the incoming batch's)
+        # would silently break the stable-buffer contract.
+        self._insert_fn = jax.jit(
+            _insert, donate_argnums=(0,),
+            **(
+                {"out_shardings": self.sharding}
+                if self.sharding is not None else {}
+            ),
+        )
 
         augment = self.augment
         base_key = (
@@ -155,10 +187,17 @@ class SampleReservoir:
 
         # Gather + augmentation in ONE jitted dispatch per draw: echoed
         # samples leave the reservoir already re-augmented, with no
-        # intermediate host hop.
-        self._draw_fn = jax.jit(_draw)
+        # intermediate host hop. Sharded rings pin the emitted batch to
+        # the same data-axis layout the feeder produces, so the train
+        # step sees identical shardings whether a batch came fresh off
+        # the wire or out of the reservoir.
+        out_sh = (
+            {"out_shardings": self.sharding}
+            if self.sharding is not None else {}
+        )
+        self._draw_fn = jax.jit(_draw, **out_sh)
         self._gather_fn = jax.jit(
-            lambda bufs, i: {k: v[i] for k, v in bufs.items()}
+            lambda bufs, i: {k: v[i] for k, v in bufs.items()}, **out_sh
         )
 
     # -- operations -----------------------------------------------------------
@@ -267,6 +306,13 @@ class EchoingPipeline:
       the reservoir pre-fills from it through the full replay decode
       path before live frames arrive, so step 0 never blocks on the
       first render. Lineage stamps are stripped (``ReplayStream``).
+    - ``mesh`` / ``sharding``: shard the reservoir ring over the
+      mesh's ``data`` axis (capacity scales with the mesh instead of
+      replicating per chip) and emit drawn batches pre-sharded in the
+      feeder's batch layout — the multi-chip live path
+      (docs/performance.md "Going multi-chip"). ``capacity`` must
+      divide the data-axis size. An explicit ``sharding`` wins over
+      ``mesh``.
 
     Metrics: counters ``echo.inserted`` / ``echo.fresh`` /
     ``echo.echoed`` (``fresh + echoed == steps * batch`` exactly) /
@@ -292,6 +338,8 @@ class EchoingPipeline:
         rng=0,
         warm_start: str | None = None,
         warm_start_allow_pickle: bool = False,
+        mesh=None,
+        sharding=None,
     ):
         self.pipeline = pipeline
         self.capacity = int(capacity)
@@ -325,8 +373,33 @@ class EchoingPipeline:
             augment = default_echo_augment(
                 image_key=image_key, points_key=points_key
             )
+        # Mesh mode (the multi-chip live path): the ring shards over
+        # the mesh's data axis, and drawn batches leave pre-sharded in
+        # the feeder's batch layout. ``mesh=`` derives the ring
+        # sharding; an explicit ``sharding=`` wins when both are given
+        # (e.g. a custom axis fold).
+        if sharding is None and mesh is not None:
+            from blendjax.parallel.sharding import ring_sharding
+
+            sharding = ring_sharding(mesh)
+        if sharding is not None and self.batch_size:
+            # same early-raise contract as capacity: a batch_size that
+            # can't split over the draw layout would otherwise surface
+            # as an opaque XLA shard-divisibility error at the first
+            # jitted draw (the wrapped pipeline only checks its own
+            # batch_size when IT was built with mesh=).
+            from blendjax.parallel.sharding import leading_shard_count
+
+            ways = leading_shard_count(sharding)
+            if ways > 1 and self.batch_size % ways:
+                raise ValueError(
+                    f"batch_size={self.batch_size} must divide evenly "
+                    f"over the {ways}-way sharded batch axis — every "
+                    "chip takes an equal shard of each drawn batch"
+                )
+        self.mesh = mesh
         self.reservoir = SampleReservoir(
-            self.capacity, augment=augment, rng=rng
+            self.capacity, augment=augment, rng=rng, sharding=sharding
         )
         self.warm_start = warm_start
         self.warm_start_allow_pickle = bool(warm_start_allow_pickle)

@@ -33,6 +33,20 @@ def _require_jax():
     return jax
 
 
+def _representative_sharding(sharding):
+    """ONE unwrap rule for "the pipeline's sharding, which may be a
+    per-field dict": the first non-None entry (every entry shares one
+    mesh — per-field specs differ, the mesh doesn't), or the value
+    itself. Callers needing the mesh, the replicated layout, or the
+    batch-axis shard count all resolve through here so they can never
+    pick different representatives."""
+    if isinstance(sharding, dict):
+        return next(
+            (s for s in sharding.values() if s is not None), None
+        )
+    return sharding
+
+
 class DeviceFeeder:
     """Transfers host batch dicts to device with a prefetch ring.
 
@@ -58,10 +72,37 @@ class DeviceFeeder:
     TPU-over-network host). A deep window (default 8) rides out such a
     link's per-op turnaround (~100ms) that a wait-each-batch regime
     pays in full. ``throttle=0``/None disables the bound.
+
+    **Mesh mode**: pass ``mesh=`` (a named ``jax.sharding.Mesh``)
+    instead of spelling the layout by hand — the batch sharding is
+    derived over ``data_axis`` (``fsdp`` folded in, the layout
+    ``blendjax.parallel.batch_sharding`` defines) and ``multihost``
+    defaults to whether more than one jax process participates, so the
+    SAME constructor drives one chip, an 8-chip pod slice, and a
+    multi-host fleet. Placement is one call per batch, never a
+    per-device host loop: single-process batches go up in ONE grouped
+    ``device_put`` of every same-layout field (XLA slices shards
+    device-side), multihost batches in one
+    ``make_array_from_process_local_data`` per field (each process
+    contributes its local rows to the global array).
     """
 
-    def __init__(self, sharding=None, prefetch: int = 2, multihost: bool = False,
-                 throttle: int = 8):
+    def __init__(self, sharding=None, prefetch: int = 2,
+                 multihost: bool | None = None,
+                 throttle: int = 8, mesh=None, data_axis: str = "data"):
+        if mesh is not None and sharding is None:
+            from blendjax.parallel.sharding import batch_sharding
+
+            sharding = batch_sharding(mesh, axis=data_axis)
+        if multihost is None:
+            # auto only in mesh mode: a mesh spanning several processes
+            # must assemble globals; explicit sharding keeps the old
+            # single-host default.
+            multihost = (
+                mesh is not None and _require_jax().process_count() > 1
+            )
+        self.mesh = mesh
+        self.data_axis = data_axis
         self.sharding = self._simplify(sharding)
         self.prefetch = max(1, int(prefetch))
         self.multihost = multihost
@@ -94,6 +135,12 @@ class DeviceFeeder:
     def _place(self, batch: dict) -> dict:
         jax = _require_jax()
         out = {}
+        # Same-layout tensor fields are grouped and placed with ONE
+        # device_put call on the whole sub-dict (the runtime fans the
+        # group out itself): a batch is one placement, not one RPC per
+        # field — and never a per-device host loop (bjx-lint BJX111
+        # guards that property on mesh hot paths).
+        groups: dict = {}
         for k, v in batch.items():
             if k in ("_meta", TRACES_KEY) or isinstance(
                 v, (int, float)
@@ -122,8 +169,24 @@ class DeviceFeeder:
                 # batch sharding — byte-sharding a buffer whose fields
                 # aren't device-aligned would split fields mid-array (or
                 # reject ragged sizes); the unpacked fields are resharded
-                # after the decode jit instead.
-                out[k] = jax.device_put(v)
+                # after the decode jit instead. On a multi-device mesh
+                # the buffer replicates (ONE placement call) so the
+                # decode/fused-step jit sees a single device set; the
+                # fused mesh step re-shards the decoded fields over
+                # `data` inside the jit.
+                mesh = getattr(
+                    _representative_sharding(self.sharding), "mesh", None
+                )
+                if mesh is not None:
+                    # packed buffers only exist single-host (multihost
+                    # tile streams decode via global-array assembly)
+                    from jax.sharding import NamedSharding, PartitionSpec
+
+                    out[k] = jax.device_put(
+                        v, NamedSharding(mesh, PartitionSpec())
+                    )
+                else:
+                    out[k] = jax.device_put(v)
                 continue
             s = (
                 self.sharding.get(k)
@@ -140,12 +203,16 @@ class DeviceFeeder:
                 from jax.sharding import NamedSharding, PartitionSpec
 
                 s = NamedSharding(s.mesh, PartitionSpec())
-            if s is None:
-                out[k] = jax.device_put(v)
-            elif self.multihost:
+            if self.multihost and s is not None:
                 out[k] = jax.make_array_from_process_local_data(s, v)
             else:
-                out[k] = jax.device_put(v, s)
+                groups.setdefault(s, {})[k] = v
+        for s, fields in groups.items():
+            placed = (
+                jax.device_put(fields) if s is None
+                else jax.device_put(fields, s)
+            )
+            out.update(placed)
         return out
 
     @staticmethod
@@ -292,9 +359,7 @@ class TileStreamDecoder:
 
     def _replicated(self):
         jax = _require_jax()
-        s = self.sharding
-        if isinstance(s, dict):
-            s = next((v for v in s.values() if v is not None), None)
+        s = _representative_sharding(self.sharding)
         if s is not None and hasattr(s, "mesh"):
             from jax.sharding import NamedSharding, PartitionSpec
 
@@ -335,9 +400,7 @@ class TileStreamDecoder:
         the configured batch sharding's mesh and its leading spec axis;
         (None, 'data') on single-device/unsharded pipelines (the decode
         then auto-selects as before)."""
-        s = self.sharding
-        if isinstance(s, dict):
-            s = next((v for v in s.values() if v is not None), None)
+        s = _representative_sharding(self.sharding)
         mesh = getattr(s, "mesh", None)
         if mesh is None or np.prod(list(mesh.shape.values())) <= 1:
             return None, "data"
@@ -1039,7 +1102,9 @@ class StreamDataPipeline:
         schema=None,
         sharding=None,
         prefetch: int = 2,
-        multihost: bool = False,
+        multihost: bool | None = None,
+        mesh=None,
+        data_axis: str = "data",
         launcher=None,
         chunk: int = 1,
         chunk_strict: bool = False,
@@ -1105,6 +1170,32 @@ class StreamDataPipeline:
         self.batch_size = batch_size
         self.schema = schema
         self.prefetch = prefetch
+        # Mesh mode (the one-liner for the multi-chip live pipeline,
+        # docs/performance.md "Going multi-chip"): derive the batch
+        # sharding from the named mesh and let multihost follow the
+        # process count — exactly what the DeviceFeeder does, resolved
+        # ONCE here so the tile decoder sees the same layout.
+        if mesh is not None:
+            from blendjax.parallel.sharding import (
+                batch_sharding,
+                leading_shard_count,
+            )
+
+            if sharding is None:
+                sharding = batch_sharding(mesh, axis=data_axis)
+            axis_total = leading_shard_count(sharding)
+            if axis_total > 1 and batch_size % axis_total:
+                raise ValueError(
+                    f"batch_size={batch_size} must divide evenly over "
+                    f"the {axis_total}-way batch axis of mesh "
+                    f"{dict(mesh.shape)} — every chip takes an equal "
+                    "shard of each global batch"
+                )
+        self.mesh = mesh
+        if multihost is None:
+            multihost = (
+                mesh is not None and _require_jax().process_count() > 1
+            )
         if emit_packed and multihost:
             # The packed single-buffer form cannot shard (bytes, not
             # batch): multihost tile batches are decoded via global-array
@@ -1265,12 +1356,32 @@ class StreamDataPipeline:
         """Bucket-pad `_partial` tail batches on the host (numpy, free)
         before tile handling and device placement, so every downstream
         stage — packing, feeder sharding, the jitted step — sees a
-        regular bucket shape plus a `_mask` validity vector."""
-        from blendjax.data.batcher import pad_to_bucket
+        regular bucket shape plus a `_mask` validity vector.
 
+        On a mesh, buckets are restricted to multiples of the batch
+        axis's shard count: a 3-row tail padded to the default bucket
+        4 cannot be placed under an 8-way ``data`` sharding (device_put
+        rejects the split), so the ladder starts at the shard count —
+        every padded tail still places in one call like a full batch."""
+        from blendjax.data.batcher import bucket_sizes, pad_to_bucket
+
+        buckets = None
+        sharding = _representative_sharding(self.feeder.sharding)
+        if sharding is not None:
+            from blendjax.parallel.sharding import leading_shard_count
+
+            ways = leading_shard_count(sharding)
+            if ways > 1:
+                # non-empty: the constructor enforced batch_size % ways
+                buckets = tuple(
+                    b for b in bucket_sizes(self.batch_size)
+                    if b % ways == 0
+                )
         for hb in batches:
             if hb.get("_partial"):
-                hb = pad_to_bucket(hb, batch_size=self.batch_size)
+                hb = pad_to_bucket(
+                    hb, batch_size=self.batch_size, buckets=buckets
+                )
             yield hb
 
     def queue_depth(self) -> int:

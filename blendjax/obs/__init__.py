@@ -23,6 +23,9 @@ The observability layer over the streaming stack (docs/observability.md):
 - :mod:`blendjax.obs.watchdog` — declarative ``Slo`` rules evaluated
   per reporter tick with sustained-breach windows, plus the
   ``FlightRecorder`` that dumps a bounded evidence bundle on breach.
+- :mod:`blendjax.obs.fleetview` — multi-process mesh runs: each
+  process's doctor/lineage/trace snapshot, process-index tagged,
+  gathered and aggregated into one fleet report.
 
 Import-cheap by design: nothing here pulls jax, zmq, or numpy, so
 producer processes (Blender's Python) can export their own metrics.
@@ -36,6 +39,11 @@ from blendjax.obs.doctor import (  # noqa: F401
     Verdict,
     diagnose,
     diagnose_current,
+)
+from blendjax.obs.fleetview import (  # noqa: F401
+    fleet_report,
+    gather_fleet_snapshots,
+    process_snapshot,
 )
 from blendjax.obs.exporters import (  # noqa: F401
     JsonlExporter,
@@ -74,6 +82,9 @@ __all__ = [
     "Verdict",
     "diagnose",
     "diagnose_current",
+    "fleet_report",
+    "gather_fleet_snapshots",
+    "process_snapshot",
     "JsonlExporter",
     "MetricsHTTPServer",
     "chrome_trace",

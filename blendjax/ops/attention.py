@@ -45,10 +45,39 @@ from blendjax.parallel.ring import reference_attention
 # applies because xla can no longer run at all. (T=16k at B=1, H=4 is
 # ~4.3 GB/call — comfortably over.)
 FLASH_RESIDUAL_BYTES = 2 << 30
-# The kernel's block constraints: sequence lengths must tile 128-wide
-# blocks; head_dim is padded up to 128 but must be a multiple of 128
-# above it.
+# OUR pinned block edge, not the kernel's default: every flash call
+# passes an explicit ``BlockSizes`` built from this constant (see
+# ``flash_block_sizes``), so ``flash_supported``'s tiling check and the
+# kernel's real grid can never drift apart across jax upgrades — a new
+# release changing the kernel's *default* block sizes changes nothing
+# here. Sequence lengths must tile these blocks; head_dim is padded up
+# to 128 but must be a multiple of 128 above it.
 FLASH_BLOCK = 128
+
+
+def flash_block_sizes(t_q: int, t_kv: int) -> "object":
+    """Explicit kernel grid for a (t_q, t_kv) call: every forward and
+    backward block edge pinned to :data:`FLASH_BLOCK` (clamped to the
+    sequence lengths for short inputs). ``flash_supported`` admits a
+    shape if and only if it tiles THESE blocks — one source of truth
+    for eligibility and launch."""
+    from jax.experimental.pallas.ops.tpu.flash_attention import BlockSizes
+
+    bq = min(FLASH_BLOCK, int(t_q))
+    bk = min(FLASH_BLOCK, int(t_kv))
+    return BlockSizes(
+        block_q=bq,
+        block_k_major=bk,
+        block_k=bk,
+        block_b=1,
+        block_q_major_dkv=bq,
+        block_k_major_dkv=bk,
+        block_k_dkv=bk,
+        block_q_dkv=bq,
+        block_k_major_dq=bk,
+        block_k_dq=bk,
+        block_q_dq=bq,
+    )
 
 
 def scores_residual_bytes(q, k=None) -> int:
@@ -123,12 +152,14 @@ def local_attention(q, k, v, causal: bool = False, scale=None,
 
     d = q.shape[-1]
     scale = scale if scale is not None else d**-0.5
-    # kernel layout is (B, H, T, D)
+    # kernel layout is (B, H, T, D); blocks pinned explicitly so the
+    # launch grid is the one flash_supported admitted, on every jax
     o = flash_attention(
         q.transpose(0, 2, 1, 3),
         k.transpose(0, 2, 1, 3),
         v.transpose(0, 2, 1, 3),
         causal=causal,
         sm_scale=scale,
+        block_sizes=flash_block_sizes(q.shape[1], k.shape[1]),
     )
     return o.transpose(0, 2, 1, 3)

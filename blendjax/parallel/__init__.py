@@ -10,9 +10,13 @@ and ring attention for sequence/context parallelism over ICI.
 from blendjax.parallel.mesh import MeshSpec, create_mesh
 from blendjax.parallel.sharding import (
     batch_sharding,
+    leading_shard_count,
+    mesh_chip_count,
     param_sharding_rules,
     replicated,
+    ring_sharding,
     shard_params,
+    state_shardings,
 )
 from blendjax.parallel.collectives import (
     all_gather,
@@ -31,6 +35,10 @@ __all__ = [
     "replicated",
     "param_sharding_rules",
     "shard_params",
+    "leading_shard_count",
+    "mesh_chip_count",
+    "ring_sharding",
+    "state_shardings",
     "all_gather",
     "all_reduce_mean",
     "all_reduce_sum",

@@ -12,19 +12,31 @@ from __future__ import annotations
 import functools
 
 
-def _shard_map(fn, mesh, in_specs, out_specs, check: bool = True):
+def _resolve_shard_map():
+    """``shard_map`` across jax versions: top-level ``jax.shard_map`` on
+    current releases, ``jax.experimental.shard_map.shard_map`` before
+    the promotion. ONE resolver for every SPMD region in the repo (ring,
+    ulysses, pipeline parallel, the sharded tile decode)."""
     import jax
 
+    sm = getattr(jax, "shard_map", None)
+    if sm is None:
+        from jax.experimental.shard_map import shard_map as sm
+    return sm
+
+
+def _shard_map(fn, mesh, in_specs, out_specs, check: bool = True):
+    sm = _resolve_shard_map()
     kwargs = dict(mesh=mesh, in_specs=in_specs, out_specs=out_specs)
     if not check:
         # Replication of e.g. tiled all_gather output is not statically
         # inferred by the varying-manual-axes checker; the flag is named
         # check_vma on current JAX, check_rep on older releases.
         try:
-            return jax.shard_map(fn, check_vma=False, **kwargs)
+            return sm(fn, check_vma=False, **kwargs)
         except TypeError:
-            return jax.shard_map(fn, check_rep=False, **kwargs)
-    return jax.shard_map(fn, **kwargs)
+            return sm(fn, check_rep=False, **kwargs)
+    return sm(fn, **kwargs)
 
 
 def all_reduce_sum(x, mesh, axis: str = "data"):

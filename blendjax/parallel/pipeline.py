@@ -159,9 +159,11 @@ def pipeline_apply(
         n_stages=n_stages, vary_axes=vary_axes,
     )
     xspec = P(None, b_ax)
-    f = jax.shard_map(
+    from blendjax.parallel.collectives import _shard_map
+
+    f = _shard_map(
         body,
-        mesh=mesh,
+        mesh,
         in_specs=(P(axis), xspec),
         out_specs=xspec,
     )

@@ -37,8 +37,16 @@ def _ring_attention_local(q, k, v, *, axis_name: str, causal: bool, scale,
     def step(i, carry):
         o, m, l, k_cur, v_cur = carry
         # K/V block currently held arrived from device (my - i) mod n.
+        # Inputs stay in their wire dtype (bf16 halves the ppermute
+        # bytes vs the old pre-shard_map f32 upcast); the MXU matmuls
+        # ACCUMULATE in f32 via preferred_element_type, and the
+        # streaming-softmax carries (o, m, l) are f32 throughout — the
+        # numerical risk lives in accumulation, not in the operands.
         src = (my - i) % n
-        s = jnp.einsum("bqhd,bkhd->bhqk", q, k_cur) * scale
+        s = jnp.einsum(
+            "bqhd,bkhd->bhqk", q, k_cur,
+            preferred_element_type=jnp.float32,
+        ) * scale
         if causal:
             k_pos = src * tk + jnp.arange(tk)
             mask = q_pos[:, None] >= k_pos[None, :]
@@ -50,14 +58,17 @@ def _ring_attention_local(q, k, v, *, axis_name: str, causal: bool, scale,
         corr = jnp.exp(m - m_new)
         corr = jnp.where(m <= NEG_INF / 2, 0.0, corr)
         l_new = l * corr + p.sum(axis=-1)
-        o_new = o * corr[..., None] + jnp.einsum("bhqk,bkhd->bhqd", p, v_cur)
+        o_new = o * corr[..., None] + jnp.einsum(
+            "bhqk,bkhd->bhqd", p.astype(v_cur.dtype), v_cur,
+            preferred_element_type=jnp.float32,
+        )
         k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
         v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
         return o_new, m_new, l_new, k_nxt, v_nxt
 
-    o = jnp.zeros((b, h, tq, d), q.dtype)
-    m = jnp.full((b, h, tq), NEG_INF, q.dtype)
-    l = jnp.zeros((b, h, tq), q.dtype)
+    o = jnp.zeros((b, h, tq, d), jnp.float32)
+    m = jnp.full((b, h, tq), NEG_INF, jnp.float32)
+    l = jnp.zeros((b, h, tq), jnp.float32)
     # Constant-initialized carries must be marked device-varying to match
     # the loop body's types under shard_map's VMA checking.
     if hasattr(jax.lax, "pcast"):
@@ -68,7 +79,8 @@ def _ring_attention_local(q, k, v, *, axis_name: str, causal: bool, scale,
         o, m, l = (jax.lax.pvary(x, vary_axes) for x in (o, m, l))
     o, m, l, _, _ = jax.lax.fori_loop(0, n, step, (o, m, l, k, v))
     o = o / jnp.maximum(l, 1e-30)[..., None]
-    return o.transpose(0, 2, 1, 3)  # back to (B, Tq, H, D)
+    # back to (B, Tq, H, D), in the wire dtype (f32 in -> f32 out)
+    return o.transpose(0, 2, 1, 3).astype(q.dtype)
 
 
 def ring_attention(
@@ -97,14 +109,15 @@ def ring_attention(
         # exact attention (same math, zero collectives; it keeps bf16
         # inputs on the MXU and does its softmax in f32 internally).
         return reference_attention(q, k, v, causal=causal, scale=scale)
-    # The streaming softmax carries its running max/sum (and the output
-    # accumulator) in the input dtype — bf16 carries would erode the
-    # exactness contract, so the RING path upcasts. This sits after the
-    # fallback check so the degraded path keeps bf16 MXU matmuls.
-    import jax.numpy as jnp
+    # Inputs enter shard_map in their OWN dtype: the old pre-shard_map
+    # f32 upcast doubled the bytes every K/V ppermute hop moved over
+    # ICI for bf16 models — the dominant ring cost. Numerical safety
+    # lives inside the body instead: f32 score accumulation via
+    # preferred_element_type and f32 streaming-softmax carries (see
+    # _ring_attention_local), so bf16 in/bf16 out now rings at half the
+    # wire bytes with the same f32 accumulation the reference path uses.
+    from blendjax.parallel.collectives import _shard_map
 
-    out_dtype = q.dtype
-    q, k, v = (a.astype(jnp.float32) for a in (q, k, v))
     b_ax = batch_axis if (batch_axis and batch_axis in mesh.axis_names) else None
     spec = P(b_ax, axis)
     vary_axes = tuple(a for a in (b_ax, axis) if a in mesh.axis_names)
@@ -112,10 +125,18 @@ def ring_attention(
         _ring_attention_local, axis_name=axis, causal=causal, scale=scale,
         vary_axes=vary_axes,
     )
-    f = jax.shard_map(
-        body, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec
+    # Releases without pcast/pvary can't mark the constant-initialized
+    # fori carries device-varying, so their replication checker reports
+    # a false carry mismatch (its own message suggests check_rep=False);
+    # strict checking stays on wherever the marking primitives exist.
+    import jax
+
+    strict = hasattr(jax.lax, "pcast") or hasattr(jax.lax, "pvary")
+    f = _shard_map(
+        body, mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check=strict,
     )
-    return f(q, k, v).astype(out_dtype)
+    return f(q, k, v)
 
 
 def reference_attention(q, k, v, causal: bool = False, scale=None):

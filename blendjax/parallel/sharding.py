@@ -77,3 +77,75 @@ def shard_params(mesh, params):
         return jax.device_put(leaf, param_sharding_rules(mesh, path, leaf))
 
     return jax.tree_util.tree_map_with_path(place, params)
+
+
+def mesh_chip_count(mesh) -> int:
+    """Total participating chips (all processes): the factor live MFU
+    and per-chip throughput figures scale by on a mesh run."""
+    import numpy as np
+
+    return int(np.prod([int(s) for s in mesh.shape.values()])) if getattr(
+        mesh, "shape", None
+    ) else 1
+
+
+def state_shardings(state, mesh=None):
+    """The sharding pytree of a concrete train state — what
+    ``jax.jit(in_shardings=(state_shardings(state, mesh), ...),
+    out_shardings=(state_shardings(state, mesh), ...))`` pins so a
+    donated step can never silently reshard params/optimizer state
+    mid-run (``blendjax.train.mesh_driver`` builds its steps on this).
+
+    With ``mesh`` given the tree is normalized ONTO it: array leaves
+    already holding a NamedSharding on this mesh keep it (params and
+    optimizer moments under the mesh rules), every other array leaf —
+    the step counters optax creates on the default device — pins to
+    replicated on the SAME mesh, so the whole state lives on one
+    device set (a jit mixing device sets refuses to run). Without
+    ``mesh``, leaves map to their current sharding as-is. Non-array
+    leaves (flax's integer ``step`` before the first update,
+    ``apply_fn``) map to ``None`` — "unspecified", which jit infers."""
+    import jax
+
+    if mesh is None:
+        return jax.tree_util.tree_map(
+            lambda v: getattr(v, "sharding", None), state
+        )
+    NamedSharding, P = _np()
+    rep = NamedSharding(mesh, P())
+
+    def pin(v):
+        if not hasattr(v, "shape"):
+            return None
+        s = getattr(v, "sharding", None)
+        if isinstance(s, NamedSharding) and getattr(s, "mesh", None) == mesh:
+            return s
+        return rep
+
+    return jax.tree_util.tree_map(pin, state)
+
+
+def leading_shard_count(sharding) -> int:
+    """How many ways a sharding splits dim 0 (1 for ``None``/replicated)
+    — the divisibility a global batch size / reservoir capacity must
+    satisfy so every chip takes an equal shard."""
+    spec = getattr(sharding, "spec", None)
+    mesh = getattr(sharding, "mesh", None)
+    if not spec or mesh is None:
+        return 1
+    lead = spec[0]
+    if lead is None:
+        return 1
+    total = 1
+    for part in lead if isinstance(lead, tuple) else (lead,):
+        if part is not None:
+            total *= int(mesh.shape[part])
+    return total
+
+
+def ring_sharding(mesh, axis: str = "data"):
+    """Sharding for a device-resident sample ring: the capacity
+    (leading) axis split over ``axis`` (folded with ``fsdp`` exactly
+    like :func:`batch_sharding`), so reservoir storage scales with the
+    mesh instead of replicating per chip."""
+    return batch_sharding(mesh, axis=axis)

@@ -97,7 +97,9 @@ def ulysses_attention(
         _ulysses_local, axis_name=axis, causal=causal, scale=scale,
         backend=backend,
     )
-    f = jax.shard_map(
-        body, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec
+    from blendjax.parallel.collectives import _shard_map
+
+    f = _shard_map(
+        body, mesh, in_specs=(spec, spec, spec), out_specs=spec
     )
     return f(q, k, v)

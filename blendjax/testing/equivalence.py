@@ -14,6 +14,18 @@ from __future__ import annotations
 import numpy as np
 
 
+def normalized_spec(sharding) -> tuple:
+    """A sharding's PartitionSpec as a plain comparable tuple: some jax
+    releases canonicalize spec entries to 1-tuples, so ``P(None,
+    'data')`` arrives as ``P(None, ('data',))`` — assertions comparing
+    layouts go through this ONE normalizer (the dryrun and the test
+    suite must not drift on the next canonicalization quirk)."""
+    return tuple(
+        e[0] if isinstance(e, tuple) and len(e) == 1 else e
+        for e in tuple(getattr(sharding, "spec", sharding))
+    )
+
+
 def max_tree_diff(a, b) -> float:
     """Max abs elementwise difference across two equal-structure trees."""
     import jax

@@ -16,6 +16,11 @@ from blendjax.train.steps import (
 )
 from blendjax.train.checkpoint import CheckpointManager
 from blendjax.train.driver import TrainDriver
+from blendjax.train.mesh_driver import (
+    MeshTrainDriver,
+    make_mesh_fused_step,
+    make_mesh_supervised_step,
+)
 
 __all__ = [
     "make_train_state",
@@ -26,4 +31,7 @@ __all__ = [
     "corner_loss",
     "CheckpointManager",
     "TrainDriver",
+    "MeshTrainDriver",
+    "make_mesh_fused_step",
+    "make_mesh_supervised_step",
 ]

@@ -1,4 +1,12 @@
-"""Jitted training steps over mesh-sharded streamed batches."""
+"""Jitted training steps over mesh-sharded streamed batches.
+
+These builders leave layouts to propagate from the arrays (jit infers;
+GSPMD partitions). For the multi-chip LIVE loop use their
+pinned-sharding twins in :mod:`blendjax.train.mesh_driver`
+(``make_mesh_supervised_step`` / ``make_mesh_fused_step``): identical
+training math, with ``in_shardings``/``out_shardings`` pinned from the
+concrete state so the donated update can never drift layouts mid-run.
+"""
 
 from __future__ import annotations
 
@@ -69,6 +77,21 @@ def _default_loss(state, params, batch):
     )
 
 
+def _sharding_jit_kwargs(state_sharding, n_data_args: int = 1) -> dict:
+    """jit kwargs pinning a state's layout: ``in_shardings``/
+    ``out_shardings`` with the state tree explicit and every data arg
+    (and the metrics output) left unspecified for jit to infer. The
+    mesh builders (:mod:`blendjax.train.mesh_driver`) pass the
+    concrete state's sharding tree here; ``None`` keeps the plain
+    propagate-from-arrays jit."""
+    if state_sharding is None:
+        return {}
+    return {
+        "in_shardings": (state_sharding,) + (None,) * n_data_args,
+        "out_shardings": (state_sharding, None),
+    }
+
+
 def make_supervised_step(
     mesh=None,
     batch_sharding=None,
@@ -77,6 +100,7 @@ def make_supervised_step(
     accum_steps: int = 1,
     augment=None,
     augment_rng=None,
+    state_sharding=None,
 ):
     """Build ``step(state, batch) -> (state, metrics)``.
 
@@ -101,6 +125,11 @@ def make_supervised_step(
       (pixel coordinates, masks), geometric ops like flip/crop would
       desynchronize image and label — use photometric ops there, or
       apply a paired transform in ``loss_fn`` instead.
+    - ``state_sharding`` (a pytree of shardings matching the concrete
+      train state) pins the jit's ``in_shardings``/``out_shardings``
+      for the state argument — the mesh path's layout-stability
+      guarantee (``blendjax.train.mesh_driver`` supplies it; plain
+      single-chip callers leave it ``None``).
     """
     del mesh, batch_sharding  # layouts ride on the arrays (see above)
     base_rng = _resolve_augment_rng(augment, augment_rng)
@@ -171,7 +200,11 @@ def make_supervised_step(
         metrics = {"loss": loss}
         return state, metrics
 
-    return jax.jit(step, donate_argnums=(0,) if donate else ())
+    return jax.jit(
+        step,
+        donate_argnums=(0,) if donate else (),
+        **_sharding_jit_kwargs(state_sharding),
+    )
 
 
 def _resolve_augment_rng(augment, augment_rng):
@@ -209,6 +242,7 @@ def make_chunked_supervised_step(
     donate: bool = True,
     augment=None,
     augment_rng=None,
+    state_sharding=None,
 ):
     """Build ``step(state, superbatch) -> (state, metrics)`` where
     ``superbatch`` fields carry a leading chunk axis: (K, B, ...).
@@ -236,7 +270,11 @@ def make_chunked_supervised_step(
         )
         return state, {"loss": losses}
 
-    return jax.jit(step, donate_argnums=(0,) if donate else ())
+    return jax.jit(
+        step,
+        donate_argnums=(0,) if donate else (),
+        **_sharding_jit_kwargs(state_sharding),
+    )
 
 
 def make_fused_tile_step(
@@ -244,6 +282,8 @@ def make_fused_tile_step(
     donate: bool = True,
     augment=None,
     augment_rng=None,
+    state_sharding=None,
+    superbatch_constraint=None,
 ):
     """Build ``step(state, packed_batch) -> (state, metrics)`` where
     ``packed_batch`` is what ``StreamDataPipeline(emit_packed=True)``
@@ -266,20 +306,30 @@ def make_fused_tile_step(
     scan-only chunked step on its decoded fields — still one device
     call. Pairs with :class:`blendjax.train.TrainDriver` to keep
     several of these single-dispatch steps in flight.
+
+    ``state_sharding`` pins the jits' in/out state layout (see
+    :func:`make_supervised_step`); ``superbatch_constraint`` is an
+    optional in-jit hook applied to the just-decoded superbatch before
+    the scan — the mesh path re-shards the decoded fields over the
+    batch axis there (``blendjax.train.mesh_driver``). Both default
+    off with zero behavior change.
     """
     loss_fn = loss_fn or _default_loss
     chunked = make_chunked_supervised_step(
         loss_fn=loss_fn, donate=donate,
         augment=augment, augment_rng=augment_rng,
+        state_sharding=state_sharding,
     )
     base_rng = _resolve_augment_rng(augment, augment_rng)
+    pin = superbatch_constraint or (lambda sb: sb)
 
     def _fused(state, packed, refs, spec, names, geoms):
         from blendjax.ops.tiles import decode_packed_superbatch
 
         superbatch = decode_packed_superbatch(packed, refs, spec, names, geoms)
         state, losses = jax.lax.scan(
-            _chunk_scan_body(loss_fn, augment, base_rng), state, superbatch
+            _chunk_scan_body(loss_fn, augment, base_rng), state,
+            pin(superbatch),
         )
         return state, {"loss": losses}
 
@@ -287,6 +337,7 @@ def make_fused_tile_step(
         _fused,
         static_argnames=("spec", "names", "geoms"),
         donate_argnums=(0,) if donate else (),
+        **_sharding_jit_kwargs(state_sharding, n_data_args=2),
     )
 
     def _fused_pal(state, packed, spec, pal_groups):
@@ -294,7 +345,8 @@ def make_fused_tile_step(
 
         superbatch = decode_packed_pal_superbatch(packed, spec, pal_groups)
         state, losses = jax.lax.scan(
-            _chunk_scan_body(loss_fn, augment, base_rng), state, superbatch
+            _chunk_scan_body(loss_fn, augment, base_rng), state,
+            pin(superbatch),
         )
         return state, {"loss": losses}
 
@@ -302,19 +354,21 @@ def make_fused_tile_step(
         _fused_pal,
         static_argnames=("spec", "pal_groups"),
         donate_argnums=(0,) if donate else (),
+        **_sharding_jit_kwargs(state_sharding),
     )
 
     def step(state, batch):
+        # static decode-plan args go POSITIONALLY: jit rejects keyword
+        # arguments once in_shardings is pinned (the mesh path), and
+        # the plain path resolves them identically either way
         if "_pal" in batch:
             return fused_pal(
-                state, batch["_packed"],
-                spec=batch["_spec"], pal_groups=batch["_pal"],
+                state, batch["_packed"], batch["_spec"], batch["_pal"]
             )
         if "_packed" in batch:
             return fused(
                 state, batch["_packed"], batch["_refs"],
-                spec=batch["_spec"], names=batch["_names"],
-                geoms=batch["_geoms"],
+                batch["_spec"], batch["_names"], batch["_geoms"],
             )
         fields = {
             k: v for k, v in batch.items()

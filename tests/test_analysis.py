@@ -1002,10 +1002,113 @@ def test_bjx110_hot_by_basename_and_inline_suppression():
     ) == []
 
 
+# -- BJX111 mesh-placement ----------------------------------------------------
+
+
+def test_bjx111_flags_per_device_device_put_loops():
+    src = """
+        # bjx: mesh-hot-path
+        import jax
+
+        def place_loop(mesh, batch):
+            out = []
+            for d in mesh.devices:
+                out.append(jax.device_put(batch, d))
+            return out
+
+        def place_comp(batch):
+            return [jax.device_put(batch, d) for d in jax.devices()]
+
+        def place_local(batch):
+            for d in jax.local_devices():
+                jax.device_put(batch, d)
+    """
+    got = findings(src, select=["BJX111"])
+    assert [f.rule for f in got] == ["BJX111"] * 3
+    assert "per-device" in got[0].message
+    assert "NamedSharding" in got[0].message
+
+
+def test_bjx111_flags_global_array_host_materialization():
+    src = """
+        # bjx: mesh-hot-path
+        import jax
+        import numpy as np
+
+        def assemble(s, v):
+            g = jax.make_array_from_process_local_data(s, v)
+            host = np.asarray(g)
+            return host
+
+        def direct(s, v):
+            return np.asarray(
+                jax.make_array_from_process_local_data(s, v)
+            )
+
+        def shard_walk(g):
+            return [s.data for s in g.addressable_shards]
+    """
+    got = findings(src, select=["BJX111"])
+    assert [f.rule for f in got] == ["BJX111"] * 3
+    assert "'g'" in got[0].message
+    assert "addressable_shards" in got[2].message
+
+
+def test_bjx111_negatives_single_placement_and_unmarked():
+    # the sanctioned pattern: one grouped placement, no device loop
+    src = """
+        # bjx: mesh-hot-path
+        import jax
+
+        def place(batch, sharding):
+            return jax.device_put(batch, sharding)
+
+        def over_fields(batch, sharding):
+            # loops over FIELDS are fine; the loop var is not a device
+            return {k: jax.device_put(v, sharding)
+                    for k, v in batch.items()}
+    """
+    assert rule_ids(src, select=["BJX111"]) == []
+    # a fetch of something never bound from a global assembly is fine
+    host = """
+        # bjx: mesh-hot-path
+        import numpy as np
+
+        def pack(rows):
+            return np.asarray(rows)
+    """
+    assert rule_ids(host, select=["BJX111"]) == []
+    # unmarked modules (tests, debug tooling) iterate shards freely
+    unmarked = """
+        def inspect(g):
+            return [s.data for s in g.addressable_shards]
+    """
+    assert rule_ids(unmarked, select=["BJX111"]) == []
+
+
+def test_bjx111_hot_by_basename_and_inline_suppression():
+    src = """
+        def inspect(g):
+            for s in g.addressable_shards:
+                print(s)
+    """
+    assert rule_ids(src, relpath="mesh_driver.py", select=["BJX111"]) == [
+        "BJX111"
+    ]
+    suppressed = """
+        def inspect(g):
+            for s in g.addressable_shards:  # bjx: ignore[BJX111]
+                print(s)
+    """
+    assert rule_ids(
+        suppressed, relpath="mesh_driver.py", select=["BJX111"]
+    ) == []
+
+
 def test_every_rule_registered():
     assert set(all_rules()) == {
         "BJX101", "BJX102", "BJX103", "BJX104", "BJX105", "BJX106",
-        "BJX107", "BJX108", "BJX109", "BJX110",
+        "BJX107", "BJX108", "BJX109", "BJX110", "BJX111",
     }
 
 

@@ -60,6 +60,37 @@ def test_flash_support_checks_kv_length_too():
     assert not flash_supported(q, k_bad)
 
 
+def test_flash_block_sizes_pinned_and_consistent():
+    """Every flash call passes an EXPLICIT BlockSizes built from
+    FLASH_BLOCK (kernel defaults drifting across jax upgrades change
+    nothing): all block edges are pinned, none exceeds FLASH_BLOCK,
+    and any shape flash_supported admits tiles the pinned grid
+    exactly — eligibility and launch share one source of truth."""
+    from blendjax.ops.attention import FLASH_BLOCK, flash_block_sizes
+
+    for t_q, t_kv in [(128, 128), (3072, 3072), (256, 1024), (64, 128)]:
+        bs = flash_block_sizes(t_q, t_kv)
+        edges = {
+            name: getattr(bs, name)
+            for name in (
+                "block_q", "block_k_major", "block_k", "block_b",
+                "block_q_major_dkv", "block_k_major_dkv", "block_k_dkv",
+                "block_q_dkv", "block_k_major_dq", "block_k_dq",
+                "block_q_dq",
+            )
+        }
+        assert all(v is not None for v in edges.values()), edges
+        assert all(v <= FLASH_BLOCK for v in edges.values()), edges
+        if t_q % FLASH_BLOCK == 0 and t_kv % FLASH_BLOCK == 0:
+            # the admitted regime: every q-edge tiles t_q, every
+            # k-edge tiles t_kv — the grid flash_supported promised
+            for name, v in edges.items():
+                if name == "block_b":
+                    continue
+                t = t_q if name.startswith("block_q") else t_kv
+                assert t % v == 0, (name, v, t_q, t_kv)
+
+
 def test_scores_residual_bytes_and_auto_threshold():
     """The auto policy is memory-driven: f32 prob-residual bytes per
     call against FLASH_RESIDUAL_BYTES (in-model, the materialized path
@@ -95,6 +126,13 @@ def test_dispatch_matches_reference_off_tpu(backend):
 def test_flash_matches_reference_on_tpu():
     """Kernel parity on real hardware
     (run with BLENDJAX_TEST_TPU=1 pytest -m tpu)."""
+    # self-skip beats relying on the marker filter: a pytest invocation
+    # overriding -m (e.g. `-m 'not slow'`) runs this on the CPU mesh,
+    # where the kernel is structurally unsupported
+    import jax
+
+    if jax.default_backend() != "tpu":
+        pytest.skip("flash kernel needs a real TPU")
     q, k, v = _qkv(t=1024, h=4, d=128, dtype=jnp.bfloat16)
     assert flash_supported(q)
     for causal in (False, True):

@@ -502,3 +502,33 @@ def test_ingest_workers_ab_row_shape(monkeypatch):
     for leg in ("workers1", "workers2"):
         wire = row[leg]["wire"]
         assert wire["wire.raw_bytes"] >= wire["wire.compressed_bytes"] > 0
+
+
+def test_multichip_live_legs_shape(monkeypatch):
+    """The multichip_live row runs the REAL live mesh path (synthetic
+    producers -> sharded ingest -> mesh feeder -> MeshTrainDriver) per
+    mesh size and reports the record's contracts: one dispatch per
+    step at every size, zero decode dispatches, zero wire gaps, and
+    the weak-scaling speedup/efficiency pair. Shrunk to two mesh sizes
+    and short windows for the CPU suite; the structure is identical to
+    the full 1/2/4/8 row."""
+    import bench
+
+    monkeypatch.setattr(bench, "MULTICHIP_PASSES", 1)
+    row = bench._multichip_live_legs(mesh_sizes=(1, 4), time_cap=1.5)
+    assert set(row["legs"]) == {"1", "4"}
+    for n, leg in row["legs"].items():
+        assert leg["img_s"] > 0
+        assert leg["global_batch"] == row["b_dev"] * int(n)
+        assert leg["dispatch_per_step"] == 1.0
+        assert leg["decode_dispatch_count"] == 0
+    assert row["seq_gaps"] == 0
+    assert row["contracts_held_every_pass"] is True
+    assert row["dispatch_per_step"] == 1.0
+    assert row["decode_dispatch_eliminated"] is True
+    assert row["speedup"] == pytest.approx(
+        row["legs"]["4"]["img_s"] / row["legs"]["1"]["img_s"], rel=1e-3
+    )
+    assert row["scaling_efficiency"] == pytest.approx(
+        row["speedup"] / 4, rel=1e-2
+    )

@@ -116,9 +116,10 @@ def test_reference_attention_bf16_inputs_keep_f32_accumulation():
 
 
 def test_ring_attention_bf16_inputs_ring_exactly():
-    """The ring path upcasts internally (streaming-softmax carries ride
-    the input dtype) and returns the input dtype — bf16 in, bf16 out,
-    matching the bf16 reference within bf16 resolution."""
+    """bf16 in, bf16 out, matching the f32 reference within bf16
+    tolerance: the ring body accumulates scores and streaming-softmax
+    carries in f32 (``preferred_element_type``) while the K/V blocks
+    themselves stay bf16 on the wire."""
     mesh = create_mesh({"seq": 4}, devices=jax.devices()[:4])
     rng = np.random.default_rng(5)
     b, t, h, d = 2, 64, 2, 8
@@ -137,6 +138,53 @@ def test_ring_attention_bf16_inputs_ring_exactly():
     np.testing.assert_allclose(
         np.asarray(out.astype(jnp.float32)), np.asarray(ref), atol=2e-2
     )
+
+
+def test_ring_attention_bf16_halves_ppermute_bytes():
+    """The ROADMAP item 5 fix pinned structurally: bf16 q/k/v must
+    enter ``shard_map`` unconverted, so every ``ppermute`` rotates
+    bf16 K/V blocks — the old pre-shard_map f32 upcast doubled the
+    bytes each ICI hop moved. The jaxpr is the proof: with bf16 inputs
+    no ppermute may carry an f32 operand."""
+    mesh = create_mesh({"seq": 4}, devices=jax.devices()[:4])
+    b, t, h, d = 1, 32, 2, 8
+    qb = jnp.zeros((b, t, h, d), jnp.bfloat16)
+    jaxpr = jax.make_jaxpr(
+        lambda q, k, v: ring_attention(
+            q, k, v, mesh, axis="seq", batch_axis=None
+        )
+    )(qb, qb, qb)
+    perms = [
+        eqn
+        for eqn in jaxpr.jaxpr.eqns
+        for inner in [eqn]
+        if inner.primitive.name == "ppermute"
+    ] + [
+        eqn
+        for outer in jaxpr.jaxpr.eqns
+        if "jaxpr" in outer.params or "call_jaxpr" in outer.params
+        for eqn in _walk_eqns(outer)
+        if eqn.primitive.name == "ppermute"
+    ]
+    assert perms, "no ppermute in the ring jaxpr?"
+    for eqn in perms:
+        for var in eqn.invars:
+            assert str(var.aval.dtype) == "bfloat16", (
+                f"ppermute carries {var.aval.dtype}: the f32 upcast "
+                "is back in front of shard_map"
+            )
+
+
+def _walk_eqns(eqn):
+    """All equations reachable through an eqn's sub-jaxprs (shard_map /
+    scan / fori bodies), recursively."""
+    out = []
+    for v in eqn.params.values():
+        inner = getattr(v, "jaxpr", v)
+        for e in getattr(inner, "eqns", ()):
+            out.append(e)
+            out.extend(_walk_eqns(e))
+    return out
 
 
 def test_ring_attention_with_data_and_seq_axes():

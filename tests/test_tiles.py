@@ -958,8 +958,12 @@ def test_multihost_tiles_chunked_superbatch():
     assert [np.asarray(b["image"]).shape for b in got] == [
         (2, B, 32, 32, 4)
     ] * 2
+    from blendjax.testing.equivalence import normalized_spec
+
     for b in got:
-        assert b["image"].sharding.spec == P(None, "data")
+        # canonicalization-proof layout compare (some jax releases
+        # deliver P(None, 'data') as P(None, ('data',)))
+        assert normalized_spec(b["image"].sharding) == (None, "data")
         img = np.asarray(b["image"])
         fid = np.asarray(b["frameid"])
         for k in range(2):
