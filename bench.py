@@ -137,6 +137,14 @@ MULTICHIP_TIME_CAP_S = float(
 MULTICHIP_PASSES = int(
     os.environ.get("BLENDJAX_BENCH_MULTICHIP_PASSES", "2")
 )
+# Precision-policy A/B row (docs/performance.md "Raising the device
+# ceiling"): step-alone img/s + mfu_step_alone for the bf16-grads vs
+# bf16-compute policies, on BOTH the headline CNN and the longseq
+# transformer. On TPU it runs the real bench geometries; elsewhere a
+# shrunken geometry keeps the row (and its CI structural assertions)
+# cheap — the numbers are only meaningful on the real chip, the
+# structure is asserted everywhere.
+PRECISION_AB = os.environ.get("BLENDJAX_BENCH_PRECISION_AB", "1") == "1"
 # The non-sparse row's codec: 'pal' (lossless full-frame palette; 4-8x
 # fewer bytes across socket AND host->device, decoded by a device
 # gather) or 'raw' (uncompressed frames). pal chunk-groups 8 batches
@@ -669,12 +677,16 @@ def measure(encoding: str, chunk: int, items: int, time_cap: float,
 
 
 def measure_step_alone(chunk: int, calls: int = 8, model=None,
-                       loss_fn=None, shape=None, batch=None) -> dict:
+                       loss_fn=None, shape=None, batch=None,
+                       precision=None) -> dict:
     """Chip-side ceiling: the chunked train step on an already-on-device
     superbatch, no pipeline — the denominator of the utilization figure
     (VERDICT r2 item 1: achieved img/s / step-alone img/s).
     ``shape``/``batch`` default to the bench frame geometry; the
-    long-sequence transformer sub-row passes larger frames."""
+    long-sequence transformer sub-row passes larger frames.
+    ``precision`` names a :mod:`blendjax.train.precision` policy for
+    the step builders (the precision A/B row passes it; ``None`` keeps
+    the default ``bf16-compute`` discipline)."""
     import jax
 
     from blendjax.models import CubeRegressor
@@ -697,11 +709,14 @@ def measure_step_alone(chunk: int, calls: int = 8, model=None,
         np.zeros((batch, *shape, 4), np.uint8), mesh=mesh,
     )
     if chunk > 1:
-        step = make_chunked_supervised_step(loss_fn=loss_fn)
+        step = make_chunked_supervised_step(
+            loss_fn=loss_fn, precision=precision
+        )
         lead = (chunk, batch)
     else:
         step = make_supervised_step(
-            mesh=mesh, batch_sharding=sharding, loss_fn=loss_fn
+            mesh=mesh, batch_sharding=sharding, loss_fn=loss_fn,
+            precision=precision,
         )
         lead = (batch,)
     # Chunked fields carry the chunk axis replicated; per-batch fields
@@ -1066,6 +1081,116 @@ def measure_transformer_row(chunk: int) -> dict:
     return row
 
 
+def measure_precision_ab(chunk: int | None = None) -> dict:
+    """Precision-policy A/B: ``bf16-grads`` vs ``bf16-compute``
+    step-alone on the headline CNN AND the long-sequence transformer,
+    with ``mfu_step_alone`` per leg (None off-v5e, where the v5e peak
+    denominator would lie; the key is always present so CI can assert
+    the row's shape on CPU).
+
+    bf16-grads differentiates w.r.t. the bf16-cast params so the
+    cross-chip gradient all-reduce carries half the bytes
+    (:mod:`blendjax.train.precision`); step-alone on one chip it
+    measures the cast overhead/benefit floor, and the same policy flag
+    flows unchanged through the mesh builders where the all-reduce win
+    is real. TPU runs the true bench geometries; other backends shrink
+    both models so the row stays seconds-cheap in bench-smoke."""
+    import jax
+
+    from blendjax.models import CubeRegressor, StreamFormer
+    from blendjax.train import corner_loss, resolve_policy
+
+    on_tpu = jax.default_backend() == "tpu"
+    if on_tpu:
+        cnn_kwargs: dict = {}
+        cnn_shape, cnn_batch, cnn_chunk = SHAPE, BATCH, (chunk or CHUNK)
+        tf_kwargs = dict(
+            patch=20, dim=512, depth=8, num_heads=4, num_outputs=16
+        )
+        long_shape, long_batch, long_chunk, long_calls = (
+            (960, 1280), 4, 4, 4
+        )
+    else:
+        # shrunk geometry, batch = device count so the test/CI suite's
+        # forced 8-device CPU mesh can shard the batch axis evenly;
+        # sized for seconds, not fidelity — the structure is the
+        # product, and the row costs 8 fresh jit compiles (2 models x
+        # 2 policies x 2 step programs), so the models shrink too
+        n_dev = max(1, len(jax.devices()))
+        cnn_kwargs = {"features": (8, 16)}
+        cnn_shape, cnn_batch, cnn_chunk = (32, 32), n_dev, 2
+        tf_kwargs = dict(
+            patch=8, dim=64, depth=1, num_heads=4, num_outputs=16
+        )
+        long_shape, long_batch, long_chunk, long_calls = (
+            (64, 64), n_dev, 2, 2
+        )
+
+    def tf_loss(state, params, batch):
+        pred = state.apply_fn({"params": params}, batch["image"])
+        return corner_loss(
+            pred.reshape(-1, 8, 2), batch["xy"],
+            image_shape=batch["image"].shape[1:3],
+        )
+
+    def leg(policy_name: str) -> dict:
+        policy = resolve_policy(policy_name)
+        cnn = CubeRegressor(**cnn_kwargs, **policy.module_kwargs())
+        cnn_alone = measure_step_alone(
+            cnn_chunk, calls=2 if not on_tpu else 8, model=cnn,
+            shape=cnn_shape, batch=cnn_batch, precision=policy,
+        )
+        tf = StreamFormer(**tf_kwargs, **policy.module_kwargs())
+        long_alone = measure_step_alone(
+            long_chunk, calls=long_calls, model=tf, loss_fn=tf_loss,
+            shape=long_shape, batch=long_batch, precision=policy,
+        )
+        out = {
+            "policy": policy.name,
+            "cnn": {**cnn_alone, "mfu_step_alone": None},
+            "longseq": {
+                **long_alone,
+                "tokens": (long_shape[0] // tf.patch)
+                * (long_shape[1] // tf.patch),
+                "mfu_step_alone": None,
+            },
+        }
+        if _is_v5e():
+            fl = measure_model_flops(
+                model=cnn, label=f"CubeRegressor {policy.name}",
+                shape=cnn_shape, batch=cnn_batch,
+            )
+            out["cnn"]["mfu_step_alone"] = round(
+                cnn_alone["img_s"] * fl["flops_per_image"]
+                / V5E_PEAK_FLOPS, 4
+            )
+            lfl = measure_model_flops(
+                model=tf, loss_fn=tf_loss,
+                label=f"StreamFormer longseq {policy.name}",
+                shape=long_shape, batch=long_batch,
+            )
+            out["longseq"]["mfu_step_alone"] = round(
+                long_alone["img_s"] * lfl["flops_per_image"]
+                / V5E_PEAK_FLOPS, 4
+            )
+        return out
+
+    row: dict = {"legs": {}}
+    for name in ("bf16-compute", "bf16-grads"):
+        row["legs"][name] = leg(name)
+    base = row["legs"]["bf16-compute"]
+    grads = row["legs"]["bf16-grads"]
+    row["value"] = round(
+        grads["cnn"]["img_s"] / max(base["cnn"]["img_s"], 1e-9), 3
+    )
+    row["longseq_ratio"] = round(
+        grads["longseq"]["img_s"]
+        / max(base["longseq"]["img_s"], 1e-9), 3
+    )
+    row["full_geometry"] = on_tpu
+    return row
+
+
 def measure_ingest_workers_ab(chunk: int, items: int | None = None,
                               time_cap: float = 30.0) -> dict:
     """Interleaved ingest_workers=1 vs 2 A/B on the live tile stream.
@@ -1169,26 +1294,36 @@ def measure_live_echo(items: int | None = None, time_cap: float = 25.0,
                       factors=None, capacity: int = 256,
                       inflight: int = 2) -> dict:
     """Interleaved data-echoing A/B on the live stream: the SAME
-    decoded pipeline + supervised step + ``TrainDriver``, echo off vs
-    ``EchoingPipeline(max_echo_factor=f)`` for each ``f`` in
-    ``factors``.
+    decoded pipeline + ``TrainDriver``, echo off (supervised step) vs
+    ``EchoingPipeline(max_echo_factor=f, emit_draws=True)`` driving
+    the echo-FUSED step for each ``f`` in ``factors`` — gather +
+    re-augmentation + loss + donated update in one jit
+    (``make_echo_fused_step``).
 
     Each leg reports live img/s INTO the step (``steps * batch / s`` —
     the number echoing multiplies), the fresh frame rate, the unique
-    fraction, final loss, and the two contracts the bench-smoke CI job
+    fraction, final loss, and the contracts the bench-smoke CI job
     asserts: exact echo accounting (``echo.fresh + echo.echoed ==
-    steps * batch``) and exactly one train dispatch per driver step
-    (``dispatch_per_step == 1.0`` — reservoir insert/gather ride the
-    data layer, not the step). ``value`` is the largest echo leg's
-    step-rate ratio over the echo-off leg."""
+    steps * batch``), exactly one DEVICE dispatch per driver step
+    counting every step-cadence jit — the train call plus any
+    standalone reservoir gather (``dispatch_per_step == 1.0``; the
+    pre-fusion echo path cost 2.0 here and was only ever asserted
+    train-dispatch-only), and the runtime donation audit
+    (``donation_reuse`` / the ``train.donation_reuse`` gauge: ring and
+    state buffer pointers stable across the window — updated in
+    place, never copied; :mod:`blendjax.testing.donation`). ``value``
+    is the largest echo leg's step-rate ratio over the echo-off
+    leg."""
     import jax  # noqa: F401  (device backend must initialize first)
 
     from blendjax.data import EchoingPipeline, StreamDataPipeline
     from blendjax.launcher import PythonProducerLauncher
     from blendjax.models import CubeRegressor
     from blendjax.parallel import batch_sharding, create_mesh
+    from blendjax.testing.donation import DonationAudit
     from blendjax.train import (
         TrainDriver,
+        make_echo_fused_step,
         make_supervised_step,
         make_train_state,
     )
@@ -1212,13 +1347,8 @@ def measure_live_echo(items: int | None = None, time_cap: float = 25.0,
             CubeRegressor(), np.zeros((BATCH, *SHAPE, 4), np.uint8),
             mesh=mesh,
         )
-        step = make_supervised_step(mesh=mesh, batch_sharding=sharding)
         fpi = _live_flops_per_image(CubeRegressor(), None)
-        driver = TrainDriver(
-            step, state, inflight=inflight, sync_every=16,
-            flops_per_image=fpi,
-            peak_flops=V5E_PEAK_FLOPS if fpi else None,
-        )
+        audit = DonationAudit()
         with PythonProducerLauncher(
             script=producer, num_instances=1, named_sockets=["DATA"],
             seed=0, proto="ipc",
@@ -1236,15 +1366,36 @@ def measure_live_echo(items: int | None = None, time_cap: float = 25.0,
             )
             echo = None
             if factor is not None:
+                # fused path: the pipeline emits draw TOKENS and the
+                # reservoir gather+augment happens inside the train jit
                 echo = EchoingPipeline(
                     pipe, capacity=capacity, max_echo_factor=factor,
+                    emit_draws=True,
                 )
+                step = make_echo_fused_step(
+                    reservoir_draw=echo.reservoir.draw
+                )
+            else:
+                step = make_supervised_step(
+                    mesh=mesh, batch_sharding=sharding
+                )
+            driver = TrainDriver(
+                step, state, inflight=inflight, sync_every=16,
+                flops_per_image=fpi,
+                peak_flops=V5E_PEAK_FLOPS if fpi else None,
+            )
             source = echo if echo is not None else pipe
             with source:
                 it = iter(source)
                 for _ in range(2):  # compile + fill queues
                     driver.submit(next(it))
                 driver.drain()
+                # donation audit marks: ring + state pointers at the
+                # measured window's start (post-compile, so the donated
+                # executables are the ones that run)
+                audit.snapshot("state", driver.state.params)
+                if echo is not None:
+                    audit.snapshot("reservoir", echo.reservoir._buffers)
                 reg.reset()
                 drv0 = dict(driver.stats)
                 e0 = dict(echo.stats) if echo is not None else None
@@ -1257,6 +1408,16 @@ def measure_live_echo(items: int | None = None, time_cap: float = 25.0,
                         break
                 final_loss = driver.drain()
                 dt = time.perf_counter() - t0
+                audit.snapshot("state", driver.state.params)
+                if echo is not None:
+                    audit.snapshot("reservoir", echo.reservoir._buffers)
+                donation_ok = audit.stable("state") and (
+                    echo is None or audit.stable("reservoir")
+                )
+                # surfaced in the run metrics too, so the record's
+                # stage snapshot and the SLO watchdog can see a
+                # donation regression without parsing this row
+                reg.gauge("train.donation_reuse", float(donation_ok))
         report = reg.report()
         steps = driver.stats["steps"] - drv0["steps"]
         counters = report["counters"]
@@ -1266,17 +1427,34 @@ def measure_live_echo(items: int | None = None, time_cap: float = 25.0,
         decode_calls = report["spans"].get(
             "decode.dispatch", {}
         ).get("count", 0)
+        # standalone reservoir gathers at the step cadence: ZERO on the
+        # fused path (the draw rides inside the train jit); pre-fusion
+        # this was one per step and dispatch_per_step read 2.0 when
+        # honestly counted
+        sample_calls = report["spans"].get(
+            "echo.sample", {}
+        ).get("count", 0)
         out = {
             "step_img_s": round(steps * BATCH / dt, 2),
             "steps": steps,
             "seconds": round(dt, 2),
             "final_loss": final_loss,
-            # one TRAIN jit call per driver step: reservoir insert/
-            # gather (and the per-fresh-frame tile decode in the drain
-            # thread) are data-layer dispatches at the FRAME cadence,
-            # never a second call at the step cadence
-            "dispatch_per_step": round(train_calls / max(steps, 1), 3),
+            # EVERY device call at the STEP cadence counts: the train
+            # jit plus any standalone reservoir gather (pre-fusion the
+            # gather was a second jit per step and this read 2.0; the
+            # old row divided train calls alone and couldn't see it).
+            # Reservoir inserts and the per-fresh-frame tile decode in
+            # the drain thread stay data-layer dispatches at the FRAME
+            # cadence — echoing exists to make that cadence lower —
+            # and are reported beside, not divided in.
+            "dispatch_per_step": round(
+                (train_calls + sample_calls) / max(steps, 1), 3
+            ),
+            "echo_sample_dispatches": sample_calls,
             "decode_dispatch_count": decode_calls,
+            "fused_draw": factor is not None,
+            "donation_reuse": donation_ok,
+            "donation_audit": audit.report(),
             "host_blocks": driver.stats["host_blocks"]
             - drv0["host_blocks"],
         }
@@ -1325,6 +1503,11 @@ def measure_live_echo(items: int | None = None, time_cap: float = 25.0,
     row["dispatch_per_step"] = max(
         row[k]["dispatch_per_step"] for k in row
         if isinstance(row[k], dict)
+    )
+    # the donation audit must hold on EVERY leg (CI-asserted): ring and
+    # state buffers updated in place across the whole window
+    row["donation_reuse"] = all(
+        row[k]["donation_reuse"] for k in row if isinstance(row[k], dict)
     )
     return row
 
@@ -1757,6 +1940,16 @@ def collect_passes(run_measure, probe, *, n_passes, retry_floor,
     between a passing probe and the first pass). In ``degraded`` mode
     probes are skipped wholesale (each costs multi-second RTTs);
     ``w0`` — the run-start probe — stamps the first fallback pass.
+
+    Fallback passes run PROBE-FREE (ADVICE r5): the wait budget is
+    already spent by the time the fallback runs, so fresh ``probe()``
+    calls there — previously one pre + one post per fallback pass —
+    could eat the remaining watchdog budget on a degraded link where
+    each probe costs multi-second RTTs. The first fallback pass reuses
+    the LAST poll probe as its pre stamp (it names the window the
+    bench gave up in); every other pre/post is the explicit skip
+    marker. Fallback passes can therefore never read fit — correct,
+    since no probe bracketed them.
     """
     passes: list = []
     if degraded:
@@ -1766,9 +1959,9 @@ def collect_passes(run_measure, probe, *, n_passes, retry_floor,
     def fit_passes():
         return [p for p in passes if p.get("fit_window")]
 
-    def run_pass(pre):
+    def run_pass(pre, probe_post: bool = True):
         q = run_measure()
-        post = _SKIPPED_PROBE if degraded else probe()
+        post = probe() if probe_post and not degraded else _SKIPPED_PROBE
         q["weather"] = {"pre": pre, "post": post}
         q["fit_window"] = bool(pre.get("fit") and post.get("fit"))
         passes.append(q)
@@ -1777,6 +1970,7 @@ def collect_passes(run_measure, probe, *, n_passes, retry_floor,
         return q
 
     blind_streak = 0
+    last_poll = None  # newest poll probe: stamps the first fallback pass
     while clock() - t0 < wait_budget and len(passes) < 20:
         fit = fit_passes()
         if fit and len(fit) >= n_passes and max(
@@ -1784,6 +1978,7 @@ def collect_passes(run_measure, probe, *, n_passes, retry_floor,
         ) >= retry_floor:
             break
         pre = probe()
+        last_poll = pre
         blind_streak = 0 if "h2d_MB_s" in pre else blind_streak + 1
         if blind_streak >= 3:
             break
@@ -1792,12 +1987,11 @@ def collect_passes(run_measure, probe, *, n_passes, retry_floor,
         else:
             sleep(poll_sleep)
     if not passes:
+        first = w0 if degraded else (last_poll or w0)
         for i in range(n_passes):
-            if degraded:
-                # w0 already told the story; don't pay more outage RTTs
-                run_pass(w0 if i == 0 else _SKIPPED_PROBE)
-            else:
-                run_pass(probe())
+            run_pass(
+                first if i == 0 else _SKIPPED_PROBE, probe_post=False
+            )
     return passes
 
 
@@ -2130,6 +2324,19 @@ def _build_record(progress: dict) -> dict:
             )
         except Exception as e:  # pragma: no cover - device flake path
             detail["transformer_row"] = {"error": repr(e)[:200]}
+    if PRECISION_AB and not degraded:
+        # Precision-policy A/B (docs/performance.md "Raising the device
+        # ceiling"): bf16-grads vs bf16-compute step-alone with
+        # mfu_step_alone per policy on the CNN and longseq models.
+        # Pure device compute — window-stamped like step_alone because
+        # the collapsed tunnel mode slows per-op dispatch too.
+        try:
+            detail["precision_ab"] = gated_row(
+                lambda: measure_precision_ab(primary["chunk"]),
+                budget=240.0, attempts=1,
+            )
+        except Exception as e:  # pragma: no cover - device flake path
+            detail["precision_ab"] = {"error": repr(e)[:200]}
     try:
         # Chip-utilization estimate: achieved throughput over the
         # step-alone ceiling, at the chunk configuration the passes

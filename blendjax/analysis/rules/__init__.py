@@ -4,6 +4,7 @@ from __future__ import annotations
 
 from blendjax.analysis.rules import (  # noqa: F401  (registration side effects)
     deserialization,
+    donation,
     driver_sync,
     fleet_affinity,
     hotpath,

@@ -124,6 +124,7 @@ class SampleReservoir:
         self._spec: dict | None = None  # field -> (shape, dtype)
         self._insert_fn = None
         self._draw_fn = None
+        self._draw_body = None  # unjitted: the make_echo_fused_step hook
         self._cursor = 0
         self.size = 0  # filled slots (== capacity once wrapped)
         self.inserts = 0  # samples inserted, lifetime
@@ -184,6 +185,8 @@ class SampleReservoir:
             if augment is not None:
                 out = augment(jax.random.fold_in(base_key, counter), out)
             return out
+
+        self._draw_body = _draw
 
         # Gather + augmentation in ONE jitted dispatch per draw: echoed
         # samples leave the reservoir already re-augmented, with no
@@ -266,6 +269,46 @@ class SampleReservoir:
             raise RuntimeError("reservoir is empty: insert() first")
         return self._gather_fn(self._buffers, np.asarray(idx, np.int32))
 
+    def draw(self, buffers, idx, counter):
+        """The traceable gather+augment body — the ``reservoir_draw``
+        hook for :func:`blendjax.train.make_echo_fused_step`, called
+        INSIDE the fused train jit's trace. Identical math to
+        :meth:`sample` (same key fold of the construction ``rng`` with
+        the draw counter), so fused and two-dispatch runs replay the
+        exact same augmentation sequence. The reservoir builds its jits
+        lazily from the first insert; a draw token never exists before
+        one, so by the time the fused step traces, this body does."""
+        if self._draw_body is None:
+            raise RuntimeError("reservoir is empty: insert() first")
+        return self._draw_body(buffers, idx, counter)
+
+    def draw_token(self, idx) -> dict:
+        """Compose one fused-draw token — the batch-shaped dict
+        ``make_echo_fused_step`` consumes: the ring buffer pytree (by
+        reference, no dispatch), the host index vector, and this
+        draw's counter. Advances the SAME counter :meth:`sample` uses,
+        so mixing token draws and eager draws keeps one deterministic
+        augmentation sequence. No device work happens here: the
+        gather+augment runs inside the train step's own jit.
+
+        Lifetime: the token's buffer objects are the ones the NEXT
+        donated :meth:`insert` consumes — dispatch the fused step
+        before inserting again, or the token dies with a
+        deleted-array error. The ``EchoingPipeline`` draw loop holds
+        this by construction (inserts run in the draw thread, which
+        is suspended between yielding a token and the consumer's next
+        request), and ``TrainDriver.submit`` dispatches immediately;
+        only callers that PARK tokens across inserts can break it."""
+        if self._buffers is None:
+            raise RuntimeError("reservoir is empty: insert() first")
+        token = {
+            "_echo_buffers": self._buffers,
+            "_echo_idx": np.asarray(idx, np.int32),
+            "_echo_counter": np.uint32(self._draws),
+        }
+        self._draws += 1
+        return token
+
     @property
     def fields(self) -> tuple:
         return tuple(self._spec) if self._spec else ()
@@ -313,6 +356,15 @@ class EchoingPipeline:
       (docs/performance.md "Going multi-chip"). ``capacity`` must
       divide the data-axis size. An explicit ``sharding`` wins over
       ``mesh``.
+    - ``emit_draws``: yield fused-draw TOKENS instead of sampled
+      batches — ``{"_echo_buffers", "_echo_idx", "_echo_counter"}``
+      dicts that :func:`blendjax.train.make_echo_fused_step` consumes,
+      moving the gather+augment INSIDE the train jit so the echo path
+      costs exactly one device dispatch per step (the
+      ``dispatch_per_step == 1.0`` contract; docs/performance.md
+      "Raising the device ceiling"). Budget composition, accounting,
+      and the augmentation key sequence are identical to the eager
+      mode — only where the gather executes changes.
 
     Metrics: counters ``echo.inserted`` / ``echo.fresh`` /
     ``echo.echoed`` (``fresh + echoed == steps * batch`` exactly) /
@@ -340,6 +392,7 @@ class EchoingPipeline:
         warm_start_allow_pickle: bool = False,
         mesh=None,
         sharding=None,
+        emit_draws: bool = False,
     ):
         self.pipeline = pipeline
         self.capacity = int(capacity)
@@ -398,6 +451,7 @@ class EchoingPipeline:
                     "chip takes an equal shard of each drawn batch"
                 )
         self.mesh = mesh
+        self.emit_draws = bool(emit_draws)
         self.reservoir = SampleReservoir(
             self.capacity, augment=augment, rng=rng, sharding=sharding
         )
@@ -641,7 +695,14 @@ class EchoingPipeline:
                 self._poll_fresh(block=True)
                 continue
             waiting = False
-            batch = self.reservoir.sample(idx)
+            if self.emit_draws:
+                # fused mode: no dispatch here — the token carries the
+                # ring pytree + host indices, and the gather+augment
+                # happens inside the train step's own jit
+                # (make_echo_fused_step)
+                batch = self.reservoir.draw_token(idx)
+            else:
+                batch = self.reservoir.sample(idx)
             if self._slot_traces:
                 # First draw touching a traced batch's anchor slot
                 # releases its traces into the emitted batch (host dict

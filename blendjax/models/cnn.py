@@ -4,35 +4,43 @@ This is the "small CNN train step" of the north star (BASELINE.json): it
 consumes exactly what ``examples/datagen``'s cube stream publishes
 (image uint8 HxWx4 + ``xy`` (8,2) float32) and regresses the corners.
 
-TPU notes: compute in bfloat16 (MXU-native), params in float32; the
-uint8->bf16 cast happens on device inside the jitted step so only uint8
-crosses PCIe/DCN (4x less transfer than float32).
+TPU notes: compute dtype comes from the package precision policy
+(:mod:`blendjax.train.precision` — bf16 MXU-native by default), params
+in float32; the uint8->compute-dtype cast happens on device inside the
+jitted step so only uint8 crosses PCIe/DCN (4x less transfer than
+float32).
 """
 
 from __future__ import annotations
+
+from typing import Any
 
 import flax.linen as nn
 import jax.numpy as jnp
 
 from blendjax.ops.image import maybe_normalize_uint8
+from blendjax.precision import default_compute_dtype
 
 
 class CubeRegressor(nn.Module):
     features: tuple = (32, 64, 128, 256)
     num_points: int = 8
-    dtype: type = jnp.bfloat16
+    # None -> the precision policy's compute dtype (bf16 by default);
+    # pass an explicit dtype (or policy.module_kwargs()) to override
+    dtype: Any = None
 
     @nn.compact
     def __call__(self, images):
         """``images``: (B, H, W, 4) uint8 (or float in [0,1]).
         Returns (B, P, 2)."""
-        x = maybe_normalize_uint8(images, self.dtype)
+        dtype = default_compute_dtype(self.dtype)
+        x = maybe_normalize_uint8(images, dtype)
         for f in self.features:
-            x = nn.Conv(f, (3, 3), strides=(2, 2), dtype=self.dtype,
+            x = nn.Conv(f, (3, 3), strides=(2, 2), dtype=dtype,
                         param_dtype=jnp.float32)(x)
             x = nn.gelu(x)
         x = x.mean(axis=(1, 2))  # global average pool
-        x = nn.Dense(256, dtype=self.dtype, param_dtype=jnp.float32)(x)
+        x = nn.Dense(256, dtype=dtype, param_dtype=jnp.float32)(x)
         x = nn.gelu(x)
         out = nn.Dense(self.num_points * 2, dtype=jnp.float32,
                        param_dtype=jnp.float32)(x)

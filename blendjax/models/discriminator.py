@@ -8,24 +8,28 @@ parameters toward a target distribution.
 
 from __future__ import annotations
 
+from typing import Any
+
 import flax.linen as nn
 import jax.numpy as jnp
 
 from blendjax.ops.image import maybe_normalize_uint8
+from blendjax.precision import default_compute_dtype
 
 
 class Discriminator(nn.Module):
     features: tuple = (32, 64, 128, 256)
-    dtype: type = jnp.bfloat16
+    dtype: Any = None  # None -> the precision policy's compute dtype
 
     @nn.compact
     def __call__(self, images, train: bool = True):
         """``images``: (B, H, W, C) in [0,1] or uint8. Returns (B,) logits."""
-        x = maybe_normalize_uint8(images, self.dtype)
+        dtype = default_compute_dtype(self.dtype)
+        x = maybe_normalize_uint8(images, dtype)
         for f in self.features:
             x = nn.Conv(f, (4, 4), strides=(2, 2), use_bias=False,
-                        dtype=self.dtype, param_dtype=jnp.float32)(x)
-            x = nn.GroupNorm(num_groups=8, dtype=self.dtype,
+                        dtype=dtype, param_dtype=jnp.float32)(x)
+            x = nn.GroupNorm(num_groups=8, dtype=dtype,
                              param_dtype=jnp.float32)(x)
             x = nn.leaky_relu(x, 0.2)
         x = x.mean(axis=(1, 2))

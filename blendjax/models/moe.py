@@ -13,8 +13,12 @@ data-sharded tokens and expert-sharded weights automatically.
 
 from __future__ import annotations
 
+from typing import Any
+
 import flax.linen as nn
 import jax.numpy as jnp
+
+from blendjax.precision import default_compute_dtype
 
 
 def collect_aux_loss(intermediates) -> jnp.ndarray:
@@ -51,10 +55,11 @@ class MoEMLP(nn.Module):
     num_experts: int
     mlp_ratio: int = 4
     capacity_factor: float = 1.25
-    dtype: type = jnp.bfloat16
+    dtype: Any = None  # None -> the precision policy's compute dtype
 
     @nn.compact
     def __call__(self, x):
+        dtype = default_compute_dtype(self.dtype)
         b, t, c = x.shape
         e = self.num_experts
         n = b * t
@@ -94,14 +99,14 @@ class MoEMLP(nn.Module):
         b2 = self.param("expert_bo", nn.initializers.zeros, (e, c),
                         jnp.float32)
 
-        xt = tokens.astype(self.dtype)
-        xe = jnp.einsum("nec,nd->ecd", dispatch.astype(self.dtype), xt)
+        xt = tokens.astype(dtype)
+        xe = jnp.einsum("nec,nd->ecd", dispatch.astype(dtype), xt)
         he = nn.gelu(
-            jnp.einsum("ecd,edh->ech", xe, w1.astype(self.dtype))
-            + b1[:, None].astype(self.dtype)
+            jnp.einsum("ecd,edh->ech", xe, w1.astype(dtype))
+            + b1[:, None].astype(dtype)
         )
-        ye = (jnp.einsum("ech,ehd->ecd", he, w2.astype(self.dtype))
-              + b2[:, None].astype(self.dtype))
+        ye = (jnp.einsum("ech,ehd->ecd", he, w2.astype(dtype))
+              + b2[:, None].astype(dtype))
         combine = dispatch * gate[:, None, None]
-        y = jnp.einsum("nec,ecd->nd", combine.astype(self.dtype), ye)
+        y = jnp.einsum("nec,ecd->nd", combine.astype(dtype), ye)
         return y.reshape(b, t, c)

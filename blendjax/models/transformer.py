@@ -16,6 +16,8 @@ SURVEY.md §2.4): the multi-chip showcase model. Design goals:
 
 from __future__ import annotations
 
+from typing import Any
+
 import flax.linen as nn
 import jax.numpy as jnp
 
@@ -23,11 +25,12 @@ from blendjax.ops.attention import local_attention
 from blendjax.ops.image import maybe_normalize_uint8
 from blendjax.parallel.ring import ring_attention
 from blendjax.parallel.ulysses import ulysses_attention
+from blendjax.precision import default_compute_dtype
 
 
 class MultiHeadAttention(nn.Module):
     num_heads: int
-    dtype: type = jnp.bfloat16
+    dtype: Any = None  # None -> the precision policy's compute dtype
     use_ring: bool = False
     mesh: object = None
     seq_axis: str = "seq"
@@ -38,11 +41,12 @@ class MultiHeadAttention(nn.Module):
 
     @nn.compact
     def __call__(self, x):
+        dtype = default_compute_dtype(self.dtype)
         b, t, c = x.shape
         h = self.num_heads
         d = c // h
         qkv = nn.DenseGeneral(
-            (3, h, d), axis=-1, dtype=self.dtype, param_dtype=jnp.float32,
+            (3, h, d), axis=-1, dtype=dtype, param_dtype=jnp.float32,
             name="qkv",
         )(x)
         q, k, v = (qkv[:, :, i] for i in range(3))  # (B, T, H, D)
@@ -79,15 +83,15 @@ class MultiHeadAttention(nn.Module):
         else:
             o = local_attention(q, k, v, causal=self.causal,
                                 backend=self.attn_backend)
-        o = o.astype(self.dtype).reshape(b, t, c)
-        return nn.Dense(c, dtype=self.dtype, param_dtype=jnp.float32,
+        o = o.astype(dtype).reshape(b, t, c)
+        return nn.Dense(c, dtype=dtype, param_dtype=jnp.float32,
                         name="proj")(o)
 
 
 class Block(nn.Module):
     num_heads: int
     mlp_ratio: int = 4
-    dtype: type = jnp.bfloat16
+    dtype: Any = None  # None -> the precision policy's compute dtype
     use_ring: bool = False
     mesh: object = None
     seq_axis: str = "seq"
@@ -99,10 +103,11 @@ class Block(nn.Module):
 
     @nn.compact
     def __call__(self, x):
+        dtype = default_compute_dtype(self.dtype)
         c = x.shape[-1]
         y = nn.LayerNorm(dtype=jnp.float32)(x)
         x = x + MultiHeadAttention(
-            self.num_heads, dtype=self.dtype, use_ring=self.use_ring,
+            self.num_heads, dtype=dtype, use_ring=self.use_ring,
             mesh=self.mesh, seq_axis=self.seq_axis,
             batch_axis=self.batch_axis, causal=self.causal,
             sp_mode=self.sp_mode, attn_backend=self.attn_backend,
@@ -113,13 +118,13 @@ class Block(nn.Module):
 
             y = MoEMLP(
                 num_experts=self.num_experts, mlp_ratio=self.mlp_ratio,
-                dtype=self.dtype,
+                dtype=dtype,
             )(y)
         else:
-            y = nn.Dense(c * self.mlp_ratio, dtype=self.dtype,
+            y = nn.Dense(c * self.mlp_ratio, dtype=dtype,
                          param_dtype=jnp.float32)(y)
             y = nn.gelu(y)
-            y = nn.Dense(c, dtype=self.dtype, param_dtype=jnp.float32)(y)
+            y = nn.Dense(c, dtype=dtype, param_dtype=jnp.float32)(y)
         return x + y
 
 
@@ -140,7 +145,7 @@ class StreamFormer(nn.Module):
     depth: int = 4
     num_heads: int = 8
     num_outputs: int = 16
-    dtype: type = jnp.bfloat16
+    dtype: Any = None  # None -> the precision policy's compute dtype
     use_ring: bool = False
     mesh: object = None
     seq_axis: str = "seq"
@@ -158,10 +163,11 @@ class StreamFormer(nn.Module):
 
     @nn.compact
     def __call__(self, images):
-        x = maybe_normalize_uint8(images, self.dtype)
+        dtype = default_compute_dtype(self.dtype)
+        x = maybe_normalize_uint8(images, dtype)
         x = nn.Conv(
             self.dim, (self.patch, self.patch),
-            strides=(self.patch, self.patch), dtype=self.dtype,
+            strides=(self.patch, self.patch), dtype=dtype,
             param_dtype=jnp.float32, name="patch_embed",
         )(x)
         b, hh, ww, c = x.shape
@@ -170,7 +176,7 @@ class StreamFormer(nn.Module):
             "pos_embed", nn.initializers.normal(0.02), (1, hh * ww, c),
             jnp.float32,
         )
-        x = x + pos.astype(self.dtype)
+        x = x + pos.astype(dtype)
         block_cls = nn.remat(Block) if self.remat else Block
         for i in range(self.depth):
             moe = (
@@ -183,7 +189,7 @@ class StreamFormer(nn.Module):
             # Block_i -> remat(CheckpointBlock_i), invalidating
             # checkpoints on a memory-knob toggle).
             x = block_cls(
-                self.num_heads, dtype=self.dtype, use_ring=self.use_ring,
+                self.num_heads, dtype=dtype, use_ring=self.use_ring,
                 mesh=self.mesh, seq_axis=self.seq_axis,
                 batch_axis=self.batch_axis, num_experts=moe,
                 sp_mode=self.sp_mode, attn_backend=self.attn_backend,

@@ -59,7 +59,9 @@ def color_jitter(rng, images, brightness: float = 0.2,
     input must already be normalized to [0, 1] (the package-wide float
     contract, see ``maybe_normalize_uint8``) and stays float. One fused
     elementwise expression — XLA folds it into whatever consumes the
-    batch."""
+    batch. Internal arithmetic is f32 regardless of the train step's
+    compute dtype: the per-image mean is a reduction, and reductions
+    stay in the policy's accum dtype (:mod:`blendjax.precision`)."""
     b = images.shape[0]
     is_int = jnp.issubdtype(images.dtype, jnp.integer)
     x = images.astype(jnp.float32)

@@ -1,0 +1,172 @@
+"""Named mixed-precision policies, applied uniformly.
+
+Before this module every model file carried its own dtype constants
+(``dtype: type = jnp.bfloat16`` in cnn.py, transformer.py, moe.py, ...)
+and the step builders had no say in what dtype gradients crossed the
+mesh in. A policy names the whole discipline once (Micikevicius et al.
+2018, "Mixed Precision Training") and the step builders + model
+constructors resolve everything from it:
+
+- ``f32`` — everything float32. The bit-exactness/reference policy
+  (equivalence tests, the fused-vs-unfused loss-equality pins).
+- ``bf16-compute`` — **the package default, identical to the previous
+  per-file constants**: bf16 activations/matmul inputs on the MXU,
+  f32 params, f32 gradients. Matmul accumulation is f32 where the repo
+  controls it (``preferred_element_type`` in the attention kernels,
+  f32 softmax/LayerNorm/loss), and the data-parallel gradient
+  all-reduce runs on f32 grads.
+- ``bf16-grads`` — everything in ``bf16-compute`` plus *bf16
+  gradients across the mesh*: the step builders differentiate with
+  respect to the policy-cast (bf16) params, so the backward-pass
+  cotangents — and the cross-chip all-reduce GSPMD inserts for a
+  ``data``-sharded batch — carry bf16, **halving gradient all-reduce
+  bytes** the same way PR 8's bf16 ring attention halved ppermute
+  bytes. Grads are cast back up to the f32 master params before the
+  optimizer, and *accumulations stay f32*: the loss is f32
+  (``corner_loss`` casts), gradient accumulation over microbatches
+  sums into f32 zeros (``accum_steps``), and the matmul accumulators
+  keep their ``preferred_element_type=f32`` from the kernels.
+
+The policy binds at TWO points — don't pass it to only one:
+
+- **model construction** owns the compute dtype: ``dtype=None``
+  resolves through :func:`default_compute_dtype` to the *package
+  default* policy (bf16), and an explicit
+  ``Model(**policy.module_kwargs())`` overrides it. A step builder's
+  ``precision=`` cannot reach inside an already-constructed model.
+- **step builders** own the gradient/accumulation side via
+  ``precision=`` (a name or a :class:`PrecisionPolicy`); ``None``
+  keeps the default policy, which keeps today's numerics bit-for-bit.
+
+So "run the f32 policy" means ``Model(**F32.module_kwargs())`` AND
+``make_*_step(precision="f32")`` — the bench's ``precision_ab`` row
+and the fused-vs-eager equality tests do exactly that.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class PrecisionPolicy:
+    """One named precision discipline.
+
+    - ``compute_dtype``: activations and matmul inputs (the flax
+      module ``dtype``).
+    - ``param_dtype``: master params the optimizer updates (always f32
+      here; a policy exists to make deviation explicit, not easy).
+    - ``grad_reduce_dtype``: dtype the gradients carry through the
+      backward pass — and therefore through the cross-chip all-reduce
+      of a data-parallel step. ``None`` leaves grads in
+      ``param_dtype``.
+    - ``accum_dtype``: accumulator dtype for matmuls
+      (``preferred_element_type``), microbatch gradient accumulation,
+      and loss reductions. f32 in every shipped policy: bf16
+      accumulation is how mixed precision diverges.
+    """
+
+    name: str
+    compute_dtype: Any
+    param_dtype: Any = jnp.float32
+    grad_reduce_dtype: Any | None = None
+    accum_dtype: Any = jnp.float32
+
+    def module_kwargs(self) -> dict:
+        """Constructor kwargs for the repo's flax models
+        (``CubeRegressor(**policy.module_kwargs())``)."""
+        return {"dtype": self.compute_dtype}
+
+
+F32 = PrecisionPolicy("f32", compute_dtype=jnp.float32)
+BF16_COMPUTE = PrecisionPolicy("bf16-compute", compute_dtype=jnp.bfloat16)
+BF16_GRADS = PrecisionPolicy(
+    "bf16-grads", compute_dtype=jnp.bfloat16,
+    grad_reduce_dtype=jnp.bfloat16,
+)
+
+POLICIES: dict[str, PrecisionPolicy] = {
+    p.name: p for p in (F32, BF16_COMPUTE, BF16_GRADS)
+}
+
+# The package-wide default: identical numerics to the per-file dtype
+# constants it replaced.
+DEFAULT_POLICY = BF16_COMPUTE
+
+
+def resolve_policy(policy) -> PrecisionPolicy:
+    """``None`` -> the default policy; a name -> its registry entry; a
+    :class:`PrecisionPolicy` passes through."""
+    if policy is None:
+        return DEFAULT_POLICY
+    if isinstance(policy, PrecisionPolicy):
+        return policy
+    try:
+        return POLICIES[policy]
+    except KeyError:
+        raise ValueError(
+            f"unknown precision policy {policy!r}; "
+            f"known: {sorted(POLICIES)}"
+        ) from None
+
+
+def default_compute_dtype(dtype=None):
+    """The ONE resolution rule for model ``dtype`` attributes: an
+    explicit dtype wins; ``None`` takes the default policy's compute
+    dtype. Models call this instead of baking their own constant."""
+    return dtype if dtype is not None else DEFAULT_POLICY.compute_dtype
+
+
+def cast_floating(tree, dtype):
+    """Cast every floating leaf of a pytree to ``dtype``; integer/bool
+    leaves (uint8 frames, step counters) pass through untouched."""
+    def cast(x):
+        if hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jnp.floating):
+            return x.astype(dtype)
+        return x
+
+    return jax.tree.map(cast, tree)
+
+
+def policy_value_and_grad(scalar_loss, params, policy: PrecisionPolicy):
+    """``jax.value_and_grad`` under a policy — the one grad path all
+    step builders share.
+
+    With ``grad_reduce_dtype`` unset this IS ``value_and_grad`` (the
+    default policy changes nothing). With it set (``bf16-grads``), the
+    differentiation runs with respect to the policy-cast params: the
+    cotangents the backward pass produces — including the cross-chip
+    gradient all-reduce GSPMD inserts when the batch is sharded over
+    the mesh ``data`` axis — carry ``grad_reduce_dtype`` (half the
+    all-reduce bytes at bf16), and the grads are cast back up to each
+    master param's own dtype before the optimizer sees them (f32
+    moments and updates; the accumulation discipline stays
+    ``accum_dtype``)."""
+    if policy.grad_reduce_dtype is None:
+        return jax.value_and_grad(scalar_loss)(params)
+    loss, grads = jax.value_and_grad(scalar_loss)(
+        cast_floating(params, policy.grad_reduce_dtype)
+    )
+    grads = jax.tree.map(
+        lambda g, p: g.astype(p.dtype) if hasattr(p, "dtype") else g,
+        grads, params,
+    )
+    return loss, grads
+
+
+__all__ = [
+    "PrecisionPolicy",
+    "POLICIES",
+    "DEFAULT_POLICY",
+    "F32",
+    "BF16_COMPUTE",
+    "BF16_GRADS",
+    "resolve_policy",
+    "default_compute_dtype",
+    "cast_floating",
+    "policy_value_and_grad",
+]

@@ -20,10 +20,32 @@ blendjax additionally ships a faithful in-process stand-in so the
 
 The real-Blender tier (``pytest -m blender``) remains the ground truth;
 this tier is what keeps those code paths executed in every CI run.
+
+:mod:`blendjax.testing.donation` is the odd one out: not a Blender
+double but a runtime audit helper — it tracks device buffer pointers
+across the feeder -> reservoir insert -> fused draw/step chain to
+prove donation reuses buffers in place (imported lazily below: it
+needs jax, which the Blender-side doubles must never pull in).
 """
 
 from blendjax.testing.fake_blender import write_fake_blender
 from blendjax.testing.fake_bpy import install as install_fake_bpy
 from blendjax.testing.fake_bpy import reset as reset_fake_bpy
 
-__all__ = ["install_fake_bpy", "reset_fake_bpy", "write_fake_blender"]
+__all__ = [
+    "install_fake_bpy",
+    "reset_fake_bpy",
+    "write_fake_blender",
+    "DonationAudit",
+]
+
+
+def __getattr__(name):
+    # lazy: the donation audit imports jax, and producer-side users of
+    # this package (fake bpy/gpu, the blender CLI emulator) must stay
+    # importable in Blender's Python where jax does not exist
+    if name == "DonationAudit":
+        from blendjax.testing.donation import DonationAudit
+
+        return DonationAudit
+    raise AttributeError(name)

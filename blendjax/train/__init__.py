@@ -9,6 +9,7 @@ donated, mesh-sharded steps fed by ``blendjax.data``.
 from blendjax.train.steps import (
     corner_loss,
     make_chunked_supervised_step,
+    make_echo_fused_step,
     make_eval_step,
     make_fused_tile_step,
     make_train_state,
@@ -18,20 +19,33 @@ from blendjax.train.checkpoint import CheckpointManager
 from blendjax.train.driver import TrainDriver
 from blendjax.train.mesh_driver import (
     MeshTrainDriver,
+    make_mesh_echo_fused_step,
     make_mesh_fused_step,
     make_mesh_supervised_step,
+)
+from blendjax.train.precision import (
+    DEFAULT_POLICY,
+    POLICIES,
+    PrecisionPolicy,
+    resolve_policy,
 )
 
 __all__ = [
     "make_train_state",
     "make_supervised_step",
     "make_chunked_supervised_step",
+    "make_echo_fused_step",
     "make_eval_step",
     "make_fused_tile_step",
     "corner_loss",
     "CheckpointManager",
     "TrainDriver",
     "MeshTrainDriver",
+    "make_mesh_echo_fused_step",
     "make_mesh_fused_step",
     "make_mesh_supervised_step",
+    "PrecisionPolicy",
+    "POLICIES",
+    "DEFAULT_POLICY",
+    "resolve_policy",
 ]

@@ -184,6 +184,11 @@ class TrainDriver:
         chunk groups count K' rows x the per-batch lead from `_spec`;
         decoded (K, B, H, W, C) superbatches count K*B; plain batches
         their leading dim. Shape reads only — no device values."""
+        idx = batch.get("_echo_idx")
+        if idx is not None:
+            # fused echo draw token: the host index vector names every
+            # image the step trains on (the gather runs inside the jit)
+            return int(len(idx))
         packed = batch.get("_packed")
         if packed is not None:
             spec = batch.get("_spec") or ()

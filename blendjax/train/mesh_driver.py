@@ -147,6 +147,72 @@ def make_mesh_fused_step(
     )
 
 
+def make_mesh_echo_fused_step(
+    state,
+    mesh,
+    reservoir,
+    loss_fn=None,
+    donate: bool = True,
+    precision=None,
+    data_axis: str = "data",
+):
+    """:func:`blendjax.train.make_echo_fused_step` with the mesh
+    layouts made explicit: the state's ``in_shardings``/
+    ``out_shardings`` pinned from the concrete ``state`` (the donated
+    update can never drift layouts), the reservoir RING's
+    ``data``-axis sharding pinned into the jit's buffer argument (a
+    drifted ring placement fails loudly at dispatch instead of
+    silently resharding the multi-GB ring every step), and an in-jit
+    constraint re-sharding the just-gathered batch over the batch
+    axis — the same hook trio ``make_mesh_fused_step`` uses for
+    packed groups.
+
+    ``reservoir`` is the :class:`blendjax.data.echo.SampleReservoir`
+    backing the ``EchoingPipeline(mesh=..., emit_draws=True)`` this
+    step trains from; its ring sharding must cover ``data_axis``
+    (construct the pipeline with ``mesh=``). ONE step body: delegates
+    to the plain builder, so single-chip and mesh echo runs train
+    identical math."""
+    jax = _require_jax()
+
+    from blendjax.train.steps import make_echo_fused_step
+
+    if data_axis not in mesh.axis_names:
+        # same build-time failure as make_mesh_fused_step: a typo'd
+        # batch axis would silently train replicated
+        raise ValueError(
+            f"data_axis {data_axis!r} is not an axis of mesh "
+            f"{dict(mesh.shape)}"
+        )
+    ring_sharding = getattr(reservoir, "sharding", None)
+    if ring_sharding is None:
+        raise ValueError(
+            "the reservoir ring is not mesh-sharded — construct the "
+            "EchoingPipeline (or SampleReservoir) with mesh=/sharding= "
+            "so echo capacity shards over the data axis"
+        )
+
+    def _pin_drawn_batch(batch):
+        from blendjax.parallel.sharding import batch_sharding
+
+        bs = batch_sharding(mesh, axis=data_axis)
+        return {
+            k: (
+                jax.lax.with_sharding_constraint(v, bs)
+                if getattr(v, "ndim", 0) >= 1 else v
+            )
+            for k, v in batch.items()
+        }
+
+    return make_echo_fused_step(
+        reservoir_draw=reservoir.draw,
+        loss_fn=loss_fn, donate=donate, precision=precision,
+        state_sharding=_state_jit_shardings(state, mesh),
+        buffer_sharding=ring_sharding,
+        draw_constraint=_pin_drawn_batch,
+    )
+
+
 class MeshTrainDriver(TrainDriver):
     """:class:`~blendjax.train.driver.TrainDriver` running the live
     loop on a named mesh.
@@ -264,6 +330,7 @@ class MeshTrainDriver(TrainDriver):
 
 __all__ = [
     "MeshTrainDriver",
+    "make_mesh_echo_fused_step",
     "make_mesh_fused_step",
     "make_mesh_supervised_step",
 ]

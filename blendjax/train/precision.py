@@ -1,0 +1,26 @@
+"""Precision policies, re-exported at their train-layer name.
+
+The substance lives in :mod:`blendjax.precision` — a jax-only module
+OUTSIDE the train package — because the model constructors resolve
+their compute dtype from it at import time, and importing anything
+under ``blendjax.train`` executes the package init (optax, flax
+training state, checkpointing, the driver stack): a process that only
+builds a model must not pay for — or depend on — the whole train
+layer. Step-builder callers keep importing from here; both names are
+the same module contents.
+"""
+
+from blendjax.precision import (  # noqa: F401
+    BF16_COMPUTE,
+    BF16_GRADS,
+    DEFAULT_POLICY,
+    F32,
+    POLICIES,
+    PrecisionPolicy,
+    cast_floating,
+    default_compute_dtype,
+    policy_value_and_grad,
+    resolve_policy,
+)
+
+from blendjax.precision import __all__  # noqa: F401

@@ -32,7 +32,13 @@ step "blender-marked tests collect"
 # registration) even without the binary.
 python -m pytest tests -m blender -q --collect-only >/tmp/bjx_blender_collect.txt 2>&1
 rc=$?
-if [ $rc -ne 0 ]; then
+if [ $rc -eq 5 ]; then
+    # pytest rc 5 = collection succeeded but ZERO tests matched the
+    # marker — a legitimate tree state (e.g. the blender tier pruned),
+    # not a collection failure; name it and move on
+    echo "no blender-marked tests in the tree (pytest rc 5)"
+    skipped+=("blender-marked tests (none collected)")
+elif [ $rc -ne 0 ]; then
     tail -5 /tmp/bjx_blender_collect.txt
     fail=1
 else
@@ -67,8 +73,11 @@ if [ $fail -ne 0 ]; then
     echo "DRYRUN FAILED"
     exit 1
 fi
-if [ ${#skipped[@]} -gt 0 ]; then
-    printf 'DRYRUN GREEN (skipped: %s)\n' "${skipped[*]}"
+# ${skipped[*]-} (with the `-` default): expanding an EMPTY array under
+# `set -u` is an "unbound variable" error on bash < 4.4, and macOS
+# ships 3.2
+if [ -n "${skipped[*]-}" ]; then
+    printf 'DRYRUN GREEN (skipped: %s)\n' "${skipped[*]-}"
 else
     echo "FULL TIER GREEN"
 fi

@@ -1105,10 +1105,96 @@ def test_bjx111_hot_by_basename_and_inline_suppression():
     ) == []
 
 
+# -- BJX112 non-donated-train-jit --------------------------------------------
+
+
+def test_bjx112_flags_undonated_step_jit_in_hot_module():
+    src = """
+        # bjx: driver-hot-path
+        import jax
+
+        def make_step():
+            def step(state, batch):
+                return state, {}
+            return jax.jit(step)
+    """
+    assert rule_ids(src, select=["BJX112"]) == ["BJX112"]
+    # state-named first param triggers even without a step-ish name
+    src2 = """
+        # bjx: driver-hot-path
+        import jax
+
+        def build():
+            def evaluate(state, batch):
+                return state.params
+            return jax.jit(evaluate)
+    """
+    assert rule_ids(src2, select=["BJX112"]) == ["BJX112"]
+
+
+def test_bjx112_donation_keyword_presence_satisfies():
+    src = """
+        # bjx: driver-hot-path
+        import jax
+
+        def make_step(donate=True):
+            def step(state, batch):
+                return state, {}
+            return jax.jit(step, donate_argnums=(0,) if donate else ())
+    """
+    assert rule_ids(src, select=["BJX112"]) == []
+
+
+def test_bjx112_decorator_form_and_step_module_scope():
+    src = """
+        import jax
+
+        @jax.jit
+        def train_step(state, batch):
+            return state
+    """
+    # steps.py is in scope without a marker (the builders live there)
+    assert rule_ids(src, relpath="steps.py", select=["BJX112"]) == [
+        "BJX112"
+    ]
+    # ... an unmarked ordinary module is not
+    assert rule_ids(src, relpath="mod.py", select=["BJX112"]) == []
+
+
+def test_bjx112_non_step_jits_and_suppressions_pass():
+    src = """
+        # bjx: driver-hot-path
+        import jax
+
+        def build():
+            draw = jax.jit(lambda bufs, i: bufs[i])
+            gather = jax.jit(_gather)
+            # segment-anchored name match: 'constrain' must not read
+            # as train
+            pin = jax.jit(apply_constraint)
+            return draw, gather, pin
+
+        def apply_constraint(sb):
+            return sb
+    """
+    assert rule_ids(src, select=["BJX112"]) == []
+    suppressed = """
+        # bjx: driver-hot-path
+        import jax
+
+        def make_eval():
+            def eval_step(state, batch):
+                return state.params
+            # bjx: ignore[BJX112]
+            return jax.jit(eval_step)
+    """
+    assert rule_ids(suppressed, select=["BJX112"]) == []
+
+
 def test_every_rule_registered():
     assert set(all_rules()) == {
         "BJX101", "BJX102", "BJX103", "BJX104", "BJX105", "BJX106",
-        "BJX107", "BJX108", "BJX109", "BJX110", "BJX111",
+        "BJX107", "BJX108", "BJX109", "BJX110", "BJX111", "BJX112",
     }
 
 

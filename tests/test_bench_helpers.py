@@ -218,6 +218,69 @@ def test_collect_passes_degraded_skips_probes():
     assert passes[1]["weather"]["pre"].get("skipped") == "outage"
 
 
+def test_collect_passes_fallback_is_probe_free():
+    """ADVICE r5: once the wait budget is spent, fallback passes must
+    not issue fresh probe() calls (on a degraded link each costs
+    multi-second RTTs that eat the watchdog budget) — the first
+    fallback pass reuses the LAST poll probe, the rest carry the skip
+    marker, and no pass gets a post probe."""
+    import bench
+
+    clock = _Clock()
+    seq = []
+
+    def probe():
+        seq.append("probe")
+        clock.t += 2.0
+        return dict(COLLAPSED)
+
+    inner = _measure_seq([20.0], clock)
+
+    def run():
+        seq.append("measure")
+        return inner()
+
+    passes = bench.collect_passes(
+        run, probe,
+        n_passes=3, retry_floor=400.0, wait_budget=30.0, poll_sleep=12.0,
+        degraded=False, w0=COLLAPSED, clock=clock, sleep=clock.sleep,
+    )
+    assert len(passes) == 3
+    # the poll loop probed; the fallback (everything from the first
+    # measure onward) issued ZERO fresh probes
+    assert seq.index("measure") > 0
+    assert "probe" not in seq[seq.index("measure"):]
+    assert passes[0]["weather"]["pre"] == COLLAPSED  # last poll reused
+    for p in passes:
+        assert p["weather"]["post"].get("skipped") == "outage"
+    for p in passes[1:]:
+        assert p["weather"]["pre"].get("skipped") == "outage"
+
+
+def test_collect_passes_zero_budget_first_pass_stamped_by_w0():
+    """wait_budget=0 (the CI smoke config): no poll probe ever ran, so
+    the run-start probe stamps the first fallback pass and still no
+    fresh probes are issued."""
+    import bench
+
+    clock = _Clock()
+    calls = {"probes": 0}
+
+    def probe():
+        calls["probes"] += 1
+        return dict(FIT)
+
+    passes = bench.collect_passes(
+        _measure_seq([20.0], clock), probe,
+        n_passes=2, retry_floor=400.0, wait_budget=0.0, poll_sleep=12.0,
+        degraded=False, w0=COLLAPSED, clock=clock, sleep=clock.sleep,
+    )
+    assert calls["probes"] == 0
+    assert len(passes) == 2
+    assert passes[0]["weather"]["pre"] == COLLAPSED
+    assert passes[1]["weather"]["pre"].get("skipped") == "outage"
+
+
 def test_collect_passes_flap_mid_pass_is_not_fit():
     """pre fit, post collapsed -> the window didn't hold; the pass is
     recorded but not fit (the r4 lesson: pre-only gating was defeated
@@ -452,9 +515,11 @@ def test_live_echo_row_shape(monkeypatch):
     """The data-echoing A/B row runs the off and echo legs for real
     through pipeline + reservoir + TrainDriver and reports the record's
     contracts: exact echo accounting (fresh + echoed == steps * batch),
-    exactly one train dispatch per driver step, unique fraction, and
-    the step-rate ratio. Bench shapes shrunk for the CPU mesh like the
-    rows above."""
+    exactly one DEVICE dispatch per driver step under FULL accounting
+    (train + standalone reservoir gathers + decodes — the echo leg runs
+    the fused draw, so standalone gathers are zero), the donation-reuse
+    audit, unique fraction, and the step-rate ratio. Bench shapes
+    shrunk for the CPU mesh like the rows above."""
     import bench
 
     monkeypatch.setattr(bench, "SHAPE", (64, 64))
@@ -469,6 +534,15 @@ def test_live_echo_row_shape(monkeypatch):
     assert row["dispatch_per_step"] == 1.0
     leg = row["echo4"]
     assert leg["max_echo_factor"] == 4
+    assert leg["fused_draw"] is True
+    # the full dispatch accounting's teeth: zero standalone reservoir
+    # gathers at the step cadence (pre-fusion this was one per step)
+    assert leg["echo_sample_dispatches"] == 0
+    # the runtime donation audit held on every leg: ring + state
+    # buffers updated in place, never copied
+    assert row["donation_reuse"] is True
+    assert leg["donation_audit"]["reservoir"]["stable"] is True
+    assert leg["donation_audit"]["state"]["stable"] is True
     assert 0.0 < leg["unique_fraction"] <= 1.0
     assert leg["echo_counters"]["echo.fresh"] + leg["echo_counters"][
         "echo.echoed"
@@ -477,6 +551,25 @@ def test_live_echo_row_shape(monkeypatch):
     assert row["value"] == pytest.approx(
         row["echo4"]["step_img_s"] / row["off"]["step_img_s"], abs=5e-4
     )
+
+
+def test_precision_ab_row_shape():
+    """The precision A/B row reports BOTH policies with step-alone
+    img/s and an mfu_step_alone key on the CNN and longseq legs (None
+    off-v5e — the key's presence is the CI structural assertion), plus
+    the throughput ratios."""
+    import bench
+
+    row = bench.measure_precision_ab()
+    assert set(row["legs"]) == {"bf16-compute", "bf16-grads"}
+    for leg in row["legs"].values():
+        for sub in ("cnn", "longseq"):
+            assert leg[sub]["img_s"] > 0
+            assert "mfu_step_alone" in leg[sub]
+        assert leg["longseq"]["tokens"] > 0
+    assert row["value"] > 0
+    assert row["longseq_ratio"] > 0
+    assert row["full_geometry"] is False  # CPU suite runs shrunk shapes
 
 
 def test_ingest_workers_ab_row_shape(monkeypatch):

@@ -1,0 +1,328 @@
+"""Echo-fused train step (PR 9): gather + re-augmentation + loss +
+donated update in ONE jit.
+
+- f32 loss equality: the fused step trains EXACTLY the same math as
+  the two-dispatch path (reservoir ``sample`` then supervised step) on
+  the same draw sequence, augmentation included,
+- exact echo accounting is preserved in ``emit_draws`` token mode,
+- exactly one device dispatch per driver step, single-chip AND on the
+  8-device mesh (no standalone ``echo.sample``/``decode.dispatch``),
+- the reservoir ring's buffer pointers stay stable under the fused
+  step's donation (the ring is read, never donated or copied), and the
+  donated state reuses its buffers in place
+  (:mod:`blendjax.testing.donation`).
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+import optax  # noqa: E402
+
+from blendjax.data.echo import (  # noqa: E402
+    EchoingPipeline,
+    SampleReservoir,
+    default_echo_augment,
+)
+from blendjax.models import CubeRegressor  # noqa: E402
+from blendjax.testing.donation import DonationAudit  # noqa: E402
+from blendjax.train import (  # noqa: E402
+    TrainDriver,
+    make_echo_fused_step,
+    make_supervised_step,
+    make_train_state,
+)
+from blendjax.utils.metrics import metrics as reg  # noqa: E402
+
+B, H, W = 4, 8, 8
+
+
+def _batch(i: int, b: int = B) -> dict:
+    rng = np.random.default_rng(100 + i)
+    return {
+        "image": rng.integers(0, 255, (b, H, W, 4), np.uint8),
+        "xy": (rng.random((b, 8, 2)) * H).astype(np.float32),
+    }
+
+
+def _batches(n: int, delay: float = 0.0):
+    for i in range(n):
+        if delay:
+            time.sleep(delay)
+        yield _batch(i)
+
+
+def _f32_state(rng_seed: int = 0):
+    return make_train_state(
+        CubeRegressor(dtype=jnp.float32),
+        np.zeros((B, H, W, 4), np.uint8),
+        optimizer=optax.sgd(0.01),
+        rng=jax.random.key(rng_seed),
+    )
+
+
+def _filled_reservoir(augment, rng=7, capacity=8, n=2):
+    res = SampleReservoir(capacity=capacity, augment=augment, rng=rng)
+    for i in range(n):
+        res.insert(_batch(i))
+    return res
+
+
+# -- f32 equality: fused vs sample+step ---------------------------------------
+
+
+@pytest.mark.parametrize("augment", [None, "default"])
+def test_fused_loss_equals_sample_plus_step_f32(augment):
+    """The acceptance pin: on the same draw sequence (same slots, same
+    draw counters, same augmentation keys) the fused one-dispatch step
+    and the two-dispatch sample-then-step path produce equal f32
+    losses and equal updated params."""
+    aug = default_echo_augment() if augment == "default" else None
+    draws = [
+        np.array([0, 1, 2, 3]),
+        np.array([4, 5, 0, 1]),  # re-draws decorrelate via the counter
+        np.array([2, 2, 6, 7]),
+    ]
+
+    # two-dispatch reference: jitted gather+augment, then the plain
+    # supervised step
+    res_a = _filled_reservoir(aug)
+    state_a = _f32_state()
+    step_a = make_supervised_step(donate=False, precision="f32")
+    losses_a = []
+    for idx in draws:
+        batch = res_a.sample(idx)
+        state_a, m = step_a(state_a, batch)
+        losses_a.append(float(np.asarray(m["loss"])))
+
+    # fused: the SAME draw bodies trace inside the train jit
+    res_b = _filled_reservoir(aug)
+    state_b = _f32_state()
+    step_b = make_echo_fused_step(
+        reservoir_draw=res_b.draw, donate=False, precision="f32"
+    )
+    losses_b = []
+    for idx in draws:
+        state_b, m = step_b(state_b, res_b.draw_token(idx))
+        losses_b.append(float(np.asarray(m["loss"])))
+
+    np.testing.assert_allclose(losses_a, losses_b, rtol=1e-6)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-6
+        ),
+        state_a.params, state_b.params,
+    )
+
+
+def test_draw_token_and_sample_share_one_counter_sequence():
+    """Token draws advance the SAME counter as eager draws, so a mixed
+    run keeps one deterministic augmentation sequence."""
+    res = _filled_reservoir(default_echo_augment())
+    tok0 = res.draw_token(np.arange(4))
+    assert int(tok0["_echo_counter"]) == 0
+    res.sample(np.arange(4))  # counter 1
+    tok2 = res.draw_token(np.arange(4))
+    assert int(tok2["_echo_counter"]) == 2
+    # and the token's buffers are the live ring, by reference
+    assert tok2["_echo_buffers"] is res._buffers
+
+
+# -- pipeline integration: accounting + one dispatch per step ----------------
+
+
+def test_emit_draws_preserves_exact_echo_accounting():
+    reg.reset()
+    frames = 4 * B
+    with EchoingPipeline(
+        _batches(4, delay=0.01), capacity=32, max_echo_factor=8,
+        augment=None, emit_draws=True,
+    ) as pipe:
+        step = make_echo_fused_step(reservoir_draw=pipe.reservoir.draw)
+        state = make_train_state(
+            CubeRegressor(), np.zeros((B, H, W, 4), np.uint8),
+            optimizer=optax.sgd(0.01),
+        )
+        steps = 0
+        for token in pipe:
+            state, _ = step(state, token)
+            steps += 1
+    st = pipe.stats
+    assert st["inserted"] == frames
+    assert st["steps"] == steps == 4 * 8  # full budget drained
+    assert st["fresh"] + st["echoed"] == steps * B
+    assert st["fresh"] == frames
+    counters = reg.report()["counters"]
+    assert counters["echo.fresh"] + counters["echo.echoed"] == steps * B
+    assert (pipe._use[pipe._filled] <= 8).all()
+
+
+def test_fused_driver_one_dispatch_per_step_single_chip():
+    """EchoingPipeline(emit_draws) -> make_echo_fused_step ->
+    TrainDriver: exactly ONE device dispatch per step — no standalone
+    echo.sample jit, no decode.dispatch."""
+    reg.reset()
+    with EchoingPipeline(
+        _batches(4), capacity=32, max_echo_factor=4, emit_draws=True,
+    ) as pipe:
+        step = make_echo_fused_step(reservoir_draw=pipe.reservoir.draw)
+        state = make_train_state(
+            CubeRegressor(), np.zeros((B, H, W, 4), np.uint8),
+            optimizer=optax.sgd(0.01),
+        )
+        drv = TrainDriver(step, state, inflight=2, sync_every=0)
+        state, final = drv.run(pipe)
+    st = pipe.stats
+    assert drv.stats["steps"] == st["steps"] == 4 * 4
+    spans = reg.spans()
+    assert spans["train.dispatch"]["count"] == drv.stats["steps"]
+    assert "echo.sample" not in spans  # the gather rides the train jit
+    assert "decode.dispatch" not in spans
+    calls = spans["train.dispatch"]["count"] + sum(
+        spans.get(k, {}).get("count", 0)
+        for k in ("echo.sample", "decode.dispatch")
+    )
+    assert calls / drv.stats["steps"] == 1.0
+    assert isinstance(final, float) and np.isfinite(final)
+    # the driver's image accounting reads the token's host index vector
+    assert drv.stats["images_retired"] == drv.stats["steps"] * B
+
+
+def test_fused_mesh_one_dispatch_per_step_8_devices():
+    """The same contract on the 8-device mesh: sharded ring, pinned
+    state/buffer layouts, one dispatch per step."""
+    from blendjax.parallel import create_mesh
+    from blendjax.train import MeshTrainDriver, make_mesh_echo_fused_step
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs the 8-device CPU mesh")
+    mesh = create_mesh({"data": -1})
+    gb = 8  # batch divides the 8-way data axis
+    reg.reset()
+
+    def batches(n):
+        for i in range(n):
+            rng = np.random.default_rng(100 + i)
+            yield {
+                "image": rng.integers(0, 255, (gb, H, W, 4), np.uint8),
+                "xy": (rng.random((gb, 8, 2)) * H).astype(np.float32),
+            }
+
+    state = make_train_state(
+        CubeRegressor(features=(4,), dtype=jnp.float32),
+        np.zeros((gb, H, W, 4), np.uint8), mesh=mesh,
+    )
+    with EchoingPipeline(
+        batches(4), capacity=32, max_echo_factor=4,
+        emit_draws=True, mesh=mesh,
+    ) as pipe:
+        step = make_mesh_echo_fused_step(state, mesh, pipe.reservoir)
+        drv = MeshTrainDriver(step, state, mesh, inflight=2, sync_every=0)
+        state, final = drv.run(pipe)
+    assert drv.chips == 8
+    st = pipe.stats
+    assert st["fresh"] + st["echoed"] == st["steps"] * gb
+    spans = reg.spans()
+    assert spans["train.dispatch"]["count"] == drv.stats["steps"]
+    assert "echo.sample" not in spans
+    assert "decode.dispatch" not in spans
+    assert np.isfinite(final)
+
+
+def test_buffer_sharding_pin_holds_without_state_sharding():
+    """buffer_sharding= must pin the ring layout even when no state
+    sharding is given (a buffer-only caller must not silently lose the
+    fail-loudly guarantee): the pinned step runs on a correctly-placed
+    ring and REJECTS a drifted (replicated) one at dispatch instead of
+    silently resharding it."""
+    from blendjax.parallel import create_mesh
+    from blendjax.parallel.sharding import replicated, ring_sharding
+
+    mesh = create_mesh({"data": -1})
+    res = SampleReservoir(
+        capacity=16, augment=None, sharding=ring_sharding(mesh)
+    )
+    res.insert(_batch(0, b=8))
+    state = make_train_state(
+        CubeRegressor(features=(8,)), np.zeros((8, H, W, 4), np.uint8),
+        optimizer=optax.sgd(0.01),
+    )
+    step = make_echo_fused_step(
+        reservoir_draw=res.draw, donate=False,
+        buffer_sharding=res.sharding,
+    )
+    state, m = step(state, res.draw_token(np.arange(8)))
+    assert np.isfinite(float(np.asarray(m["loss"])))
+    drifted = jax.device_put(
+        {k: np.asarray(v) for k, v in res._buffers.items()},
+        replicated(mesh),
+    )
+    token = res.draw_token(np.arange(8))
+    token["_echo_buffers"] = drifted
+    with pytest.raises(Exception, match="[Ss]harding"):
+        out = step(state, token)
+        jax.block_until_ready(out[1]["loss"])
+
+
+def test_mesh_echo_fused_step_requires_sharded_ring():
+    from blendjax.parallel import create_mesh
+    from blendjax.train import make_mesh_echo_fused_step
+
+    mesh = create_mesh({"data": -1})
+    state = make_train_state(
+        CubeRegressor(features=(4,)), np.zeros((8, H, W, 4), np.uint8),
+        mesh=mesh,
+    )
+    unsharded = SampleReservoir(capacity=8, augment=None)
+    with pytest.raises(ValueError, match="mesh"):
+        make_mesh_echo_fused_step(state, mesh, unsharded)
+
+
+# -- donation: ring stability + state reuse under the fused step --------------
+
+
+def test_reservoir_buffers_stable_under_fused_donation():
+    """The fused step DONATES the state but only READS the ring: across
+    inserts, token draws, and donated fused steps the ring's device
+    pointers never move — and the donated state writes back into the
+    same buffers it consumed (one state copy for the whole run)."""
+    audit = DonationAudit()
+    with EchoingPipeline(
+        _batches(4), capacity=16, max_echo_factor=4, emit_draws=True,
+    ) as pipe:
+        step = make_echo_fused_step(reservoir_draw=pipe.reservoir.draw)
+        state = make_train_state(
+            CubeRegressor(features=(8,)),
+            np.zeros((B, H, W, 4), np.uint8), optimizer=optax.sgd(0.01),
+        )
+        it = iter(pipe)
+        state, _ = step(state, next(it))  # compile + first donation
+        audit.snapshot("state", state.params)
+        audit.snapshot("ring", pipe.reservoir._buffers)
+        for token in it:
+            state, m = step(state, token)
+            audit.snapshot("ring", pipe.reservoir._buffers)
+        jax.block_until_ready(m["loss"])
+        audit.snapshot("state", state.params)
+    audit.assert_stable("ring")
+    audit.assert_stable("state")
+    rep = audit.report()
+    assert rep["ring"]["stable"] and rep["state"]["stable"]
+    assert rep["ring"]["snapshots"] >= 2
+
+
+def test_donation_audit_reports_a_moved_buffer():
+    """The audit itself must catch a copy: an UNDONATED update chain
+    allocates fresh buffers, and the audit says so."""
+    x = jnp.arange(1024.0)
+    f = jax.jit(lambda v: v + 1)  # no donation: output is a new buffer
+    audit = DonationAudit()
+    audit.snapshot("x", x)
+    y = f(x)
+    audit.snapshot("x", y)
+    assert not audit.stable("x")
+    with pytest.raises(AssertionError, match="moved"):
+        audit.assert_stable("x")

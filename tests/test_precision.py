@@ -1,0 +1,198 @@
+"""Uniform precision policies (PR 9): ``blendjax.train.precision``.
+
+- resolution rules (name / instance / None-default) and the model
+  constructors' dtype flowing from the policy instead of per-file
+  constants,
+- ``bf16-compute`` (the default) trains bit-identically to the
+  pre-policy behavior (f32 grads, f32 params),
+- ``bf16-grads`` carries bf16 cotangents through the backward pass
+  (the bytes that cross the mesh) while the optimizer still sees f32
+  grads on f32 master params, and accumulation stays f32,
+- the policy threads through every step builder (per-batch, chunked,
+  accum, echo-fused) without changing the default path's math.
+"""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+import optax  # noqa: E402
+
+from blendjax.models import CubeRegressor  # noqa: E402
+from blendjax.train import (  # noqa: E402
+    make_supervised_step,
+    make_train_state,
+)
+from blendjax.train.precision import (  # noqa: E402
+    BF16_COMPUTE,
+    BF16_GRADS,
+    DEFAULT_POLICY,
+    F32,
+    PrecisionPolicy,
+    cast_floating,
+    default_compute_dtype,
+    policy_value_and_grad,
+    resolve_policy,
+)
+
+B, H, W = 4, 8, 8
+
+
+def _batch(seed: int = 0) -> dict:
+    rng = np.random.default_rng(seed)
+    return {
+        "image": rng.integers(0, 255, (B, H, W, 4), np.uint8),
+        "xy": (rng.random((B, 8, 2)) * H).astype(np.float32),
+    }
+
+
+def _state(model=None):
+    return make_train_state(
+        model or CubeRegressor(features=(8,)),
+        np.zeros((B, H, W, 4), np.uint8),
+        optimizer=optax.sgd(0.01), rng=jax.random.key(0),
+    )
+
+
+# -- resolution ----------------------------------------------------------------
+
+
+def test_policy_resolution_rules():
+    assert resolve_policy(None) is DEFAULT_POLICY
+    assert resolve_policy("f32") is F32
+    assert resolve_policy("bf16-grads") is BF16_GRADS
+    assert resolve_policy(BF16_COMPUTE) is BF16_COMPUTE
+    custom = PrecisionPolicy("mine", compute_dtype=jnp.float16)
+    assert resolve_policy(custom) is custom
+    with pytest.raises(ValueError, match="unknown precision policy"):
+        resolve_policy("bf17")
+
+
+def test_default_policy_is_bf16_compute_with_f32_everything_else():
+    assert DEFAULT_POLICY is BF16_COMPUTE
+    assert DEFAULT_POLICY.compute_dtype == jnp.bfloat16
+    assert DEFAULT_POLICY.param_dtype == jnp.float32
+    assert DEFAULT_POLICY.grad_reduce_dtype is None
+    assert DEFAULT_POLICY.accum_dtype == jnp.float32
+
+
+def test_models_resolve_dtype_from_policy():
+    """Model files carry no dtype constants anymore: ``dtype=None``
+    resolves through the policy; an explicit dtype (or
+    ``policy.module_kwargs()``) still wins."""
+    assert default_compute_dtype(None) == jnp.bfloat16
+    assert default_compute_dtype(jnp.float32) == jnp.float32
+    m = CubeRegressor(features=(8,))
+    assert m.dtype is None
+    v = m.init(jax.random.key(0), np.zeros((1, H, W, 4), np.uint8))
+    out = m.apply(v, np.zeros((1, H, W, 4), np.uint8))
+    assert out.dtype == jnp.float32  # head stays f32 by design
+    mf = CubeRegressor(features=(8,), **F32.module_kwargs())
+    assert mf.dtype == jnp.float32
+
+
+def test_cast_floating_leaves_integers_alone():
+    tree = {"w": jnp.ones((2,), jnp.float32),
+            "img": jnp.zeros((2,), jnp.uint8),
+            "n": jnp.zeros((), jnp.int32)}
+    low = cast_floating(tree, jnp.bfloat16)
+    assert low["w"].dtype == jnp.bfloat16
+    assert low["img"].dtype == jnp.uint8
+    assert low["n"].dtype == jnp.int32
+
+
+# -- grad path -----------------------------------------------------------------
+
+
+def test_bf16_grads_cotangents_are_bf16_then_cast_back():
+    """The policy's point: the backward pass (and therefore the
+    cross-chip gradient all-reduce of a data-sharded step) runs on
+    bf16 cotangents; the optimizer sees f32 grads on f32 masters."""
+    params = {"w": jnp.ones((4, 4), jnp.float32)}
+    seen = {}
+
+    def loss(p):
+        # record the dtype differentiation actually runs in
+        seen["dtype"] = p["w"].dtype
+        return (p["w"].astype(jnp.float32) ** 2).sum()
+
+    val, grads = policy_value_and_grad(loss, params, BF16_GRADS)
+    assert seen["dtype"] == jnp.bfloat16  # differentiated w.r.t. bf16
+    assert grads["w"].dtype == jnp.float32  # cast back for the optimizer
+    # and the default policy is a plain value_and_grad
+    val2, grads2 = policy_value_and_grad(loss, params, BF16_COMPUTE)
+    assert seen["dtype"] == jnp.float32
+    assert grads2["w"].dtype == jnp.float32
+    np.testing.assert_allclose(float(val), float(val2), rtol=1e-2)
+
+
+def test_default_policy_step_is_bit_identical_to_unspecified():
+    """precision=None and precision='bf16-compute' are the SAME step:
+    the policy refactor must not move the default path's numerics."""
+    batch = _batch()
+    s1, m1 = make_supervised_step(donate=False)(_state(), batch)
+    s2, m2 = make_supervised_step(donate=False, precision="bf16-compute")(
+        _state(), batch
+    )
+    assert float(np.asarray(m1["loss"])) == float(np.asarray(m2["loss"]))
+    jax.tree.map(
+        lambda a, b: np.testing.assert_array_equal(
+            np.asarray(a), np.asarray(b)
+        ),
+        s1.params, s2.params,
+    )
+
+
+@pytest.mark.parametrize("accum", [1, 2])
+def test_bf16_grads_step_trains(accum):
+    """bf16-grads changes grad bytes, not trainability: finite loss,
+    f32 params actually move, microbatch accumulation included (f32
+    accumulation of bf16-reduced grads)."""
+    step = make_supervised_step(
+        donate=False, precision="bf16-grads", accum_steps=accum
+    )
+    s0 = _state()
+    before = jax.tree.map(np.asarray, s0.params)
+    s1, m = step(s0, _batch())
+    assert np.isfinite(float(np.asarray(m["loss"])))
+    moved = jax.tree_util.tree_leaves(jax.tree.map(
+        lambda a, b: bool((np.asarray(a) != np.asarray(b)).any()),
+        before, s1.params,
+    ))
+    assert any(moved)
+    leaf = jax.tree_util.tree_leaves(s1.params)[0]
+    assert leaf.dtype == jnp.float32  # masters stay f32
+
+
+def test_chunked_step_threads_policy():
+    from blendjax.train import make_chunked_supervised_step
+
+    batch = _batch()
+    sb = {k: np.stack([v, v]) for k, v in batch.items()}
+    step = make_chunked_supervised_step(
+        donate=False, precision="bf16-grads"
+    )
+    s1, m = step(_state(), sb)
+    assert m["loss"].shape == (2,)
+    assert np.isfinite(np.asarray(m["loss"])).all()
+
+
+def test_echo_fused_step_threads_policy():
+    from blendjax.data.echo import SampleReservoir
+    from blendjax.train import make_echo_fused_step
+
+    res = SampleReservoir(capacity=8, augment=None)
+    res.insert(_batch())
+    step = make_echo_fused_step(
+        reservoir_draw=res.draw, donate=False, precision="bf16-grads"
+    )
+    s1, m = step(_state(), res.draw_token(np.arange(B)))
+    assert np.isfinite(float(np.asarray(m["loss"])))
+
+
+def test_f32_policy_with_f32_model_is_full_precision():
+    model = CubeRegressor(features=(8,), **F32.module_kwargs())
+    step = make_supervised_step(donate=False, precision="f32")
+    s1, m = step(_state(model), _batch())
+    assert np.isfinite(float(np.asarray(m["loss"])))
