@@ -111,6 +111,22 @@ LIVE_ECHO_FACTORS = tuple(
 LIVE_FLEET = os.environ.get("BLENDJAX_BENCH_LIVE_FLEET", "1") == "1"
 FLEET_RATE = float(os.environ.get("BLENDJAX_BENCH_FLEET_RATE", "40"))
 FLEET_MAX = int(os.environ.get("BLENDJAX_BENCH_FLEET_MAX", "4"))
+# Closed-loop scenario A/B row (docs/scenarios.md): the SAME 2-producer
+# synthetic fleet rendering a 2-scenario space (one with irreducible
+# label noise — the high-loss scenario) through the fused echo path,
+# once with a FROZEN uniform mixture and once with the adaptive
+# curriculum republishing the space on a cadence. CI asserts the
+# structural contracts: per-scenario fresh+echoed sums EXACTLY to
+# steps*batch, >= 2 distinct scenario ids observed, the curriculum leg
+# advanced the space version >= 2 and shifted mixture weight toward the
+# high-loss scenario, seq_gaps == 0, dispatch_per_step == 1.0.
+LIVE_SCENARIO = os.environ.get("BLENDJAX_BENCH_LIVE_SCENARIO", "1") == "1"
+SCENARIO_TIME_CAP_S = float(
+    os.environ.get("BLENDJAX_BENCH_SCENARIO_TIME_CAP_S", "20")
+)
+SCENARIO_MIN_STEPS = int(
+    os.environ.get("BLENDJAX_BENCH_SCENARIO_MIN_STEPS", "40")
+)
 # Multi-chip live row (docs/performance.md "Going multi-chip"): the
 # SAME live pipeline (synthetic producers -> ShardedHostIngest ->
 # DeviceFeeder -> MeshTrainDriver) at mesh sizes 1/2/4/8 with a FIXED
@@ -1682,6 +1698,185 @@ def measure_live_fleet(time_cap: float = 12.0, rate: float | None = None,
     return row
 
 
+def measure_live_scenario(time_cap: float | None = None,
+                          min_steps: int | None = None,
+                          rate: float = 60.0) -> dict:
+    """Closed-loop domain-randomization A/B (docs/scenarios.md): a
+    2-producer synthetic fleet renders a 2-scenario space — ``easy``
+    vs ``hard`` (irreducible label noise, the scenario a curriculum
+    must find) — published over the duplex channel, streamed through
+    the fused echo path, and trained with per-step loss attribution.
+
+    Leg A (``fixed``) freezes the uniform mixture; leg B
+    (``curriculum``) lets :class:`blendjax.scenario.ScenarioCurriculum`
+    republish adapted mixture weights every few steps. Both legs hold
+    the structural contracts CI asserts: EXACT per-scenario accounting
+    (fresh + echoed sums to steps*batch across the declared scenarios),
+    >= 2 distinct scenario ids observed, ``seq_gaps == 0``, and
+    ``dispatch_per_step == 1.0`` (the echo draw rides inside the train
+    jit; the only other per-step device interaction is the loss fetch
+    the curriculum needs). The curriculum leg must additionally advance
+    the space version >= 2 and shift mixture weight toward the
+    high-loss scenario."""
+    import jax  # noqa: F401  (device backend must initialize first)
+
+    from blendjax.data import EchoingPipeline, StreamDataPipeline
+    from blendjax.fleet import synthetic_fleet
+    from blendjax.models import CubeRegressor
+    from blendjax.obs.lineage import lineage
+    from blendjax.scenario import (
+        ScenarioCurriculum,
+        ScenarioService,
+        ScenarioSpace,
+        accounting,
+    )
+    from blendjax.train import make_echo_fused_step, make_train_state
+    from blendjax.utils.metrics import metrics as reg
+
+    time_cap = SCENARIO_TIME_CAP_S if time_cap is None else time_cap
+    min_steps = SCENARIO_MIN_STEPS if min_steps is None else min_steps
+    shape, pbatch, tbatch = (32, 32), 4, 8
+    # xy_jitter HALF the image side: the hard scenario's irreducible
+    # label-noise loss dominates the early-training transient, so the
+    # per-window loss ranking (the curriculum's signal) is stable run
+    # to run — at 8px the transient could swamp the ~20% gap in an
+    # unlucky window and flip an early update
+    spec = (
+        "easy:half_extent=u(0.8,1.2) / "
+        "hard:half_extent=u(0.8,1.2),xy_jitter=16"
+    )
+
+    def leg(adaptive: bool) -> dict:
+        reg.reset()
+        lineage.reset()
+        accounting.reset()
+        space = ScenarioSpace.parse(spec)
+        w0 = space.weights()
+        svc = ScenarioService(space)
+        try:
+            with synthetic_fleet(
+                2, shape=shape, batch=pbatch, rate=rate,
+                scenario=True, bind_grace_s=0.5,
+            ) as launcher:
+                for i, addr in enumerate(launcher.addresses["CTRL"]):
+                    svc.attach(i, addr)
+                acked = svc.wait_acked(timeout=15)
+                pipe = StreamDataPipeline(
+                    launcher.addresses["DATA"], batch_size=tbatch,
+                    timeoutms=30_000,
+                )
+                echo = EchoingPipeline(
+                    pipe, capacity=64, max_echo_factor=4,
+                    emit_draws=True,
+                )
+                step = make_echo_fused_step(
+                    reservoir_draw=echo.reservoir.draw
+                )
+                state = make_train_state(
+                    CubeRegressor(),
+                    np.zeros((tbatch, *shape, 4), np.uint8),
+                )
+                curriculum = ScenarioCurriculum(
+                    space, service=svc, every_steps=10, min_rows=4,
+                    adapt_params=False, frozen=not adaptive,
+                )
+                steps = 0
+                t0 = time.perf_counter()
+                with echo:
+                    it = iter(echo)
+                    while True:
+                        token = next(it)
+                        # one fused jit per step — the span IS the
+                        # dispatch-count evidence dispatch_per_step
+                        # divides (same accounting as live_echo)
+                        with reg.span("train.dispatch"):
+                            state, m = step(state, token)
+                        # per-step loss fetch: the curriculum's
+                        # evidence (a sync, not an extra dispatch)
+                        loss = float(m["loss"])
+                        accounting.account_batch(token, loss=loss)
+                        curriculum.step(1)
+                        steps += 1
+                        dt = time.perf_counter() - t0
+                        if steps >= min_steps and (
+                            adaptive is False or curriculum.updates >= 1
+                        ):
+                            break
+                        if dt > time_cap:
+                            break
+                dt = time.perf_counter() - t0
+        finally:
+            svc.stop()
+        report = reg.report()
+        counters = report["counters"]
+        ledger = accounting.report()
+        totals = accounting.totals()
+        declared_rows = sum(
+            f + e for sid, (f, e) in totals.items()
+            if sid in space.names
+        )
+        train_calls = report["spans"].get(
+            "train.dispatch", {}
+        ).get("count", 0)
+        sample_calls = report["spans"].get(
+            "echo.sample", {}
+        ).get("count", 0)
+        wf = space.weights()
+        return {
+            "steps": steps,
+            "seconds": round(dt, 2),
+            "step_img_s": round(steps * tbatch / max(dt, 1e-9), 1),
+            "acked_before_start": acked,
+            "space_version": space.version,
+            "curriculum_updates": curriculum.updates,
+            "weights_initial": {k: round(v, 4) for k, v in w0.items()},
+            "weights_final": {k: round(v, 4) for k, v in wf.items()},
+            "weight_shifted": wf["hard"] > w0["hard"] + 0.02,
+            "distinct_ids": len(totals),
+            "per_scenario": {
+                sid: {
+                    "fresh": f, "echoed": e,
+                    "loss_p50": round(
+                        ledger["scenarios"][sid]["loss"]["p50"], 5
+                    ) if sid in ledger["scenarios"] else None,
+                    "versions": ledger["scenarios"][sid]["versions"]
+                    if sid in ledger["scenarios"] else {},
+                }
+                for sid, (f, e) in sorted(totals.items())
+            },
+            # EXACT: every drawn row attributed to a declared scenario,
+            # fresh + echoed summing to steps * batch with zero slack
+            "accounting_exact": declared_rows == steps * tbatch,
+            "dispatch_per_step": round(
+                (train_calls + sample_calls) / max(steps, 1), 3
+            ),
+            "seq_gaps": int(counters.get("wire.seq_gaps", 0)),
+            "scenario_counters": {
+                k: int(v) for k, v in counters.items()
+                if k.startswith("scenario.")
+            },
+            "echo_saturated_waits": int(
+                counters.get("echo.saturated_waits", 0)
+            ),
+        }
+
+    row: dict = {
+        "fixed": leg(False),
+        "curriculum": leg(True),
+        "high_loss": "hard",
+        "space_spec": spec,
+    }
+    legs = (row["fixed"], row["curriculum"])
+    row["accounting_exact"] = all(g["accounting_exact"] for g in legs)
+    row["distinct_ids"] = min(g["distinct_ids"] for g in legs)
+    row["dispatch_per_step"] = max(g["dispatch_per_step"] for g in legs)
+    row["seq_gaps"] = max(g["seq_gaps"] for g in legs)
+    # the headline: how much mixture weight the curriculum moved onto
+    # the high-loss scenario (0.5 = it did nothing)
+    row["value"] = row["curriculum"]["weights_final"]["hard"]
+    return row
+
+
 def _multichip_live_legs(mesh_sizes=None, time_cap: float | None = None,
                          b_dev: int = 2, shape=(16, 16)) -> dict:
     """The in-process body of the ``multichip_live`` row: the live
@@ -2284,6 +2479,17 @@ def _build_record(progress: dict) -> dict:
             detail["live_fleet"] = measure_live_fleet()
         except Exception as e:  # pragma: no cover - spawn flake path
             detail["live_fleet"] = {"error": repr(e)[:200]}
+    if LIVE_SCENARIO:
+        # Closed-loop scenario A/B (docs/scenarios.md): fixed uniform
+        # mixture vs adaptive curriculum over the duplex channel, with
+        # exact per-scenario accounting through the fused echo path.
+        # CPU-cheap (32x32 synthetic frames, tiny CNN) and weather-
+        # independent: the evidence is counts/versions/weights, not a
+        # device-link rate.
+        try:
+            detail["live_scenario"] = measure_live_scenario()
+        except Exception as e:  # pragma: no cover - spawn flake path
+            detail["live_scenario"] = {"error": repr(e)[:200]}
     if MULTICHIP_LIVE:
         # Multi-chip live row (docs/performance.md "Going multi-chip"):
         # the live pipeline at mesh sizes 1/2/4/8 on a forced 8-device

@@ -32,6 +32,10 @@ Subpackage map (reference counterpart in parens):
   (replaces the examples' torch models, e.g. ``examples/densityopt``).
 - ``blendjax.ops`` — Pallas/XLA image ops (gamma, normalize; the reference
   does these on CPU, ``offscreen.py:105-112``).
+- ``blendjax.scenario`` — closed-loop domain randomization over the duplex
+  channel (the ``examples/densityopt`` capability as a subsystem): versioned
+  scenario spaces, per-producer publication, exact per-scenario accounting,
+  loss-driven curriculum (docs/scenarios.md).
 
 Import policy: this root module stays light and never imports ``jax`` or
 ``bpy`` so that producer processes (Blender's embedded Python) can import

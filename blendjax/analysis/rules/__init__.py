@@ -13,6 +13,7 @@ from blendjax.analysis.rules import (  # noqa: F401  (registration side effects)
     purity,
     reservoir_sync,
     resource_leak,
+    scenario_ids,
     wall_clock,
     zmq_affinity,
 )

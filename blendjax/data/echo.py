@@ -57,6 +57,11 @@ from blendjax.obs.trace import (
     pop_traces as trace_pop,
     stage as trace_stage,
 )
+from blendjax.scenario.accounting import (
+    SCENARIO_ROWS_KEY,
+    accounting as scenario_accounting,
+    batch_row_scenarios,
+)
 from blendjax.utils.logging import get_logger
 from blendjax.utils.metrics import metrics
 
@@ -463,6 +468,13 @@ class EchoingPipeline:
         self._use = np.zeros(self.capacity, np.int64)
         self._t_insert = np.zeros(self.capacity, np.float64)
         self._filled = np.zeros(self.capacity, bool)
+        # Per-slot scenario sidecar (blendjax.scenario): each slot
+        # remembers the _scenario stamp of the row that filled it, so
+        # echoed draws are attributed to their TRUE scenario — the
+        # anchor row's — not the emitting batch's. Host list, keyed by
+        # slot like draw-token traces; None entries = unstamped rows.
+        self._slot_scen: list = [None] * self.capacity
+        self._scen_active = False
         # Sampled frame traces parked while their batch sits in the
         # reservoir: keyed by the batch's first slot, delivered (once)
         # on the first draw touching that slot. Tiny — one entry per
@@ -553,7 +565,19 @@ class EchoingPipeline:
         if self.batch_size is None:
             self.batch_size = int(lead)
         trs = trace_pop(batch)
+        scen_rows = batch_row_scenarios(batch, int(lead))
         slots = self.reservoir.insert(fields)
+        if scen_rows is not None:
+            self._scen_active = True
+            # a batch larger than capacity kept only its NEWEST rows:
+            # align the stamp tail with the slots actually written
+            for s, r in zip(slots, scen_rows[-len(slots):]):
+                self._slot_scen[int(s)] = r
+        elif self._scen_active:
+            # unstamped rows overwrite stamped slots: clear, never leak
+            # a dead scenario onto a new sample
+            for s in slots:
+                self._slot_scen[int(s)] = None
         if self._slot_traces:
             # Overwritten slots evict any still-parked trace with their
             # frame (it will never complete — sampled tracing accepts
@@ -721,11 +745,26 @@ class EchoingPipeline:
             # numpy from _compose_draw, so these int()s are not device
             # syncs despite BJX106's call-result heuristic. Fresh
             # counts FIRST USES: a slot drawn twice in one batch is one
-            # fresh + one echo, so fresh can never exceed inserts.
+            # fresh + one echo, so fresh can never exceed inserts. The
+            # mask is per ROW (first occurrence of a slot AND
+            # first-ever use) so per-scenario accounting splits
+            # fresh/echoed exactly; its sum equals the old unique-slot
+            # fresh count.
             # bjx: ignore[BJX106]
-            uniq = np.unique(idx)
+            first = np.zeros(len(idx), bool)
+            first[np.unique(idx, return_index=True)[1]] = True
             # bjx: ignore[BJX106]
-            fresh_n = int((self._use[uniq] == 0).sum())
+            fresh_rows = first & (self._use[idx] == 0)
+            fresh_n = int(fresh_rows.sum())
+            if self._scen_active:
+                # per-row scenario attribution: each drawn row carries
+                # its ANCHOR slot's stamp into the emitted batch (host
+                # sidecar) and the process-wide scenario ledger — the
+                # echoed-row correctness contract
+                # (docs/scenarios.md; pinned by tests/test_scenario.py)
+                scen = [self._slot_scen[int(i)] for i in idx]
+                batch[SCENARIO_ROWS_KEY] = scen
+                scenario_accounting.observe_rows(scen, fresh=fresh_rows)
             np.add.at(self._use, idx, 1)
             # one locked registry call for the whole age vector — B
             # individual observes per draw would serialize lock round

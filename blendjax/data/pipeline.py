@@ -21,6 +21,7 @@ import threading
 import numpy as np
 
 from blendjax.obs.trace import TRACES_KEY, stamp_batch as trace_stamp_batch
+from blendjax.scenario.accounting import SCENARIO_KEY
 from blendjax.utils.logging import get_logger
 from blendjax.utils.metrics import metrics
 
@@ -142,7 +143,10 @@ class DeviceFeeder:
         # guards that property on mesh hot paths).
         groups: dict = {}
         for k, v in batch.items():
-            if k in ("_meta", TRACES_KEY) or isinstance(
+            # SCENARIO_KEY: the batch-level domain-randomization stamp
+            # (blendjax.scenario) — per-item provenance like _meta, and
+            # a plain dict device_put would reject anyway.
+            if k in ("_meta", TRACES_KEY, SCENARIO_KEY) or isinstance(
                 v, (int, float)
             ) or getattr(v, "ndim", -1) == 0:
                 # Host-side sidecars: per-item provenance and scalars —

@@ -16,6 +16,8 @@ from dataclasses import dataclass
 
 import numpy as np
 
+from blendjax.scenario.accounting import SCENARIO_KEY
+
 
 @dataclass(frozen=True)
 class FieldSpec:
@@ -36,9 +38,18 @@ class StreamSchema:
     Non-tensor metadata keys (e.g. ``btid``) can be listed in ``meta_keys``:
     they are carried per-batch as plain arrays/lists but excluded from
     device placement.
+
+    ``_scenario`` (the blendjax.scenario stamp) is a DEFAULT meta key —
+    not just inferred from the first item — because a mixed fleet's (or
+    a late-joining scenario producer's) first decoded item may be
+    unstamped: a schema frozen from it would silently discard every
+    later stamp at batch assembly, and per-scenario accounting would
+    read zero forever.
     """
 
-    def __init__(self, fields: dict, meta_keys=("btid",)):
+    DEFAULT_META_KEYS = ("btid", SCENARIO_KEY)
+
+    def __init__(self, fields: dict, meta_keys=DEFAULT_META_KEYS):
         self.fields = {
             k: FieldSpec(tuple(v[0]), np.dtype(v[1]))
             if not isinstance(v, FieldSpec)
@@ -48,7 +59,7 @@ class StreamSchema:
         self.meta_keys = tuple(meta_keys)
 
     @classmethod
-    def infer(cls, item: dict, meta_keys=("btid",)) -> "StreamSchema":
+    def infer(cls, item: dict, meta_keys=DEFAULT_META_KEYS) -> "StreamSchema":
         """Infer the contract from one decoded item. Scalars become
         0-d fields; non-numeric values are treated as metadata."""
         fields = {}

@@ -24,6 +24,7 @@ import torch.utils.data as tud
 from blendjax import constants
 from blendjax.data.stream import RemoteStream
 from blendjax.obs.trace import TRACE_KEY
+from blendjax.scenario.accounting import SCENARIO_KEY
 
 
 class RemoteIterableDataset(tud.IterableDataset):
@@ -87,8 +88,13 @@ class RemoteIterableDataset(tud.IterableDataset):
             # Sampled frame-trace contexts end here: a torch consumer
             # has no terminal stage to complete the record, and torch's
             # default_collate requires uniform keys across items (one
-            # stamped item in a batch raises KeyError).
+            # stamped item in a batch raises KeyError). The scenario
+            # stamp (blendjax.scenario) goes the same way: it is a dict
+            # default_collate can't stack, and frames from stamped and
+            # unstamped producers interleave in one fan-in — the jax
+            # pipeline is where per-scenario accounting lives.
             msg.pop(TRACE_KEY, None)
+            msg.pop(SCENARIO_KEY, None)
             batched = bool(msg.pop("_batched", False)) | bool(
                 msg.pop("_prebatched", False)
             )

@@ -130,6 +130,18 @@ class FleetController:
     ``None`` inherits the running fleet's args at the launcher (a new
     instance must match its peers' shape/encoding config).
 
+    ``scenario_service`` (a :class:`blendjax.scenario.ScenarioService`)
+    keeps scenario distribution consistent under elastic membership:
+    a scaled-up instance's ``ctrl_socket_name`` duplex address is
+    attached — and the CURRENT space published to it — BEFORE its data
+    address joins the ingest fan-in, so the newcomer's first counted
+    frame already carries the current space version (producers hold
+    publishing for the first space — see
+    ``blendjax.fleet.synthetic --scenario-wait``); a retiring
+    instance's duplex channel closes cleanly at retire time; remote
+    producers announcing a ``ctrl_addr`` in their telemetry join the
+    scenario fleet the same way.
+
     Drive it yourself (``tick()`` per loop — the bench does this) or
     let ``start()`` run a daemon control thread every ``interval_s``.
     The thread is the sanctioned home for the blocking subprocess
@@ -151,11 +163,15 @@ class FleetController:
         lineage=None,
         registry=metrics,
         event_log: int = 64,
+        scenario_service=None,
+        ctrl_socket_name: str = "CTRL",
     ):
         self.launcher = launcher
         self.connector = connector
         self.policy = policy or FleetPolicy()
         self.socket_name = socket_name
+        self.scenario_service = scenario_service
+        self.ctrl_socket_name = ctrl_socket_name
         self.interval_s = float(interval_s)
         self.diagnose = diagnose
         self.health = health
@@ -232,6 +248,14 @@ class FleetController:
                 self._pending_disconnects.append(
                     (now_ + self.policy.drain_grace_s, prev, None)
                 )
+            ctrl_addr = (telemetry or {}).get("ctrl_addr")
+            if (
+                self.scenario_service is not None
+                and ctrl_addr and _valid_endpoint(ctrl_addr)
+            ):
+                # scenario before data, like _scale_up: the announced
+                # duplex endpoint receives the current space first
+                self.scenario_service.attach(btid, str(ctrl_addr))
             self.connector.connect(data_addr)
             self.remote[btid] = data_addr
             self.lineage.register(btid)
@@ -251,6 +275,8 @@ class FleetController:
             addr = self.remote.pop(btid, None)
             if addr is None:
                 return {"ok": False, "error": f"unknown btid {btid!r}"}
+            if self.scenario_service is not None:
+                self.scenario_service.detach(btid)
             now = time.monotonic() if now is None else now
             self._pending_disconnects.append(
                 (now + self.policy.drain_grace_s, addr, btid)
@@ -382,6 +408,13 @@ class FleetController:
             )
             addr = sockets[self.socket_name]
             self.lineage.register(i)
+            ctrl_addr = sockets.get(self.ctrl_socket_name)
+            if self.scenario_service is not None and ctrl_addr:
+                # scenario BEFORE data: attach publishes the current
+                # space to the newcomer's duplex channel, and the
+                # producer holds its first frame for it — so by the
+                # time ingest counts a frame, it is version-stamped
+                self.scenario_service.attach(i, ctrl_addr)
             if self.connector is not None:
                 self.connector.connect(addr)
             self.registry.count("fleet.scale_ups")
@@ -393,6 +426,13 @@ class FleetController:
         victim = self.launcher.active_indices()[-1]
         sockets = self.launcher.retire_instance(victim, drain=True)
         addr = sockets[self.socket_name]
+        if self.scenario_service is not None and sockets.get(
+            self.ctrl_socket_name
+        ):
+            # the duplex channel closes NOW (cleanly, on the service's
+            # owning thread): the producer is gone; only its already-
+            # published data tail rides out the drain grace window
+            self.scenario_service.detach(victim)
         # drain-then-disconnect: the producer's TERM flush is delivered
         # through the still-connected pipe; the disconnect lands a
         # grace window later (step 2 of a future tick).

@@ -71,12 +71,23 @@ def announce_addr(bound_addr: str) -> str:
 def synthetic_fleet(num_instances: int = 1, shape=DEFAULT_SHAPE,
                     batch: int = DEFAULT_BATCH, rate: float = 0.0,
                     frames: int = -1, trace_every: int = 0,
+                    scenario: bool = False,
+                    scenario_wait_s: float = 15.0,
                     extra_args=None, **launcher_kwargs):
     """A ready-to-enter :class:`~blendjax.launcher.
     PythonProducerLauncher` over ``num_instances`` synthetic producers.
     ``rate`` caps each instance's frames/s (0 = as fast as the
     rasterizer goes); remaining kwargs pass through to the launcher
-    (``seed``, ``proto``, ``bind_addr``, ...)."""
+    (``seed``, ``proto``, ``bind_addr``, ...).
+
+    ``scenario=True`` allocates a ``CTRL`` duplex socket per instance
+    and makes each producer a scenario consumer (docs/scenarios.md):
+    it binds the duplex channel, WAITS up to ``scenario_wait_s`` for
+    the first :class:`~blendjax.scenario.ScenarioSpace` from the
+    consumer's :class:`~blendjax.scenario.ScenarioService` (so its
+    first published frame already carries the current space version),
+    then re-samples + applies a scenario per batch and stamps
+    ``_scenario`` into every message."""
     from blendjax.launcher import PythonProducerLauncher
 
     args = [
@@ -85,9 +96,12 @@ def synthetic_fleet(num_instances: int = 1, shape=DEFAULT_SHAPE,
         "--frames", str(frames),
         "--rate", str(rate),
         "--trace-every", str(trace_every),
+        *(["--scenario-wait", str(scenario_wait_s)] if scenario else []),
         *[str(a) for a in (extra_args or [])],
     ]
-    launcher_kwargs.setdefault("named_sockets", ["DATA"])
+    launcher_kwargs.setdefault(
+        "named_sockets", ["DATA", "CTRL"] if scenario else ["DATA"]
+    )
     return PythonProducerLauncher(
         script=SYNTHETIC_PRODUCER,
         num_instances=num_instances,
@@ -119,9 +133,23 @@ def _parse(argv):
     )
     parser.add_argument("--trace-every", type=int, default=0)
     parser.add_argument(
+        "--scenario-wait", type=float, default=None, metavar="S",
+        help="consume a scenario space over the CTRL duplex socket "
+        "(blendjax.scenario): wait up to S seconds for the first "
+        "published space before the first frame, then re-sample a "
+        "scenario per batch and stamp _scenario into every message",
+    )
+    parser.add_argument(
         "--bind", default=None, metavar="ADDR",
         help="standalone mode: bind the data socket here (wildcard "
         "port ok) instead of taking it from the launcher handshake",
+    )
+    parser.add_argument(
+        "--ctrl-bind", default=None, metavar="ADDR",
+        help="standalone mode: bind the scenario duplex channel here "
+        "(wildcard port ok); with --announce, the resolved address is "
+        "announced as telemetry ctrl_addr so the consumer's fleet "
+        "controller attaches its ScenarioService",
     )
     parser.add_argument(
         "--btid", default=None,
@@ -162,16 +190,43 @@ def main(argv=None) -> int:
         trace_every=opts.trace_every,
     )
 
+    # Scenario consumer (docs/scenarios.md): the duplex channel binds
+    # next to the data socket — launcher mode takes the CTRL handshake
+    # address, standalone mode --ctrl-bind — and the applicator samples
+    # + applies + stamps one scenario per batch.
+    applicator = None
+    ctrl_addr = (
+        (args.btsockets or {}).get("CTRL") if launcher_mode
+        else opts.ctrl_bind
+    )
+    if ctrl_addr:
+        from blendjax.producer import DuplexChannel
+        from blendjax.producer.scenario import ScenarioApplicator
+
+        # allow_pickle=False: this endpoint's address may be announced
+        # off-host (admission telemetry) — a pickled payload must never
+        # execute here, same contract as the admission endpoint
+        chan = DuplexChannel(ctrl_addr, btid=btid, allow_pickle=False)
+        applicator = ScenarioApplicator(
+            chan, apply=scene.apply_scenario, rng=seed
+        )
+
     announced = False
     if opts.announce:
         from blendjax.fleet.admission import announce
 
         data_addr = announce_addr(pub.addr)
+        telemetry = (
+            {"ctrl_addr": announce_addr(chan.addr)}
+            if applicator is not None else None
+        )
         # retry briefly — the consumer's endpoint may still be
         # coming up.
         for attempt in range(10):
             try:
-                reply = announce(opts.announce, btid, data_addr)
+                reply = announce(
+                    opts.announce, btid, data_addr, telemetry=telemetry
+                )
             except Exception:
                 reply = None
             if reply and reply.get("ok"):
@@ -183,6 +238,18 @@ def main(argv=None) -> int:
             raise SystemExit(
                 f"admission endpoint {opts.announce} refused or "
                 "unreachable"
+            )
+
+    if applicator is not None and opts.scenario_wait:
+        # Hold the first frame until the consumer's current space is
+        # held (and acked): a newcomer's first COUNTED frame carries
+        # the current version, the fleet-controller contract.
+        if not applicator.wait_for_space(timeout_s=opts.scenario_wait):
+            import logging
+
+            logging.getLogger("blendjax.producer").warning(
+                "no scenario space within %.1fs; publishing unstamped "
+                "frames until one arrives", opts.scenario_wait,
             )
 
     # Zero-copy batch pool (cube_producer's shape): render straight
@@ -199,17 +266,24 @@ def main(argv=None) -> int:
     trackers = [None] * len(pool)
     cursor = {"slot": 0, "i": 0}
     pace = {"t0": time.monotonic(), "frames": 0}
+    stamp = {"fields": {}}
 
     def publish(frame: int) -> None:
         slot = cursor["slot"]
-        if cursor["i"] == 0 and trackers[slot] is not None:
-            trackers[slot].wait()  # backpressure: slot still in flight
-            trackers[slot] = None
+        if cursor["i"] == 0:
+            if trackers[slot] is not None:
+                trackers[slot].wait()  # backpressure: slot in flight
+                trackers[slot] = None
+            if applicator is not None:
+                # one scenario per BATCH: every row of the published
+                # message shares the draw, so the batch-level _scenario
+                # stamp attributes each row exactly
+                stamp["fields"] = applicator.next_scenario()
         scene.observation_into(frame, pool[slot], cursor["i"])
         cursor["i"] += 1
         if cursor["i"] == b:
             trackers[slot] = pub.publish_tracked(
-                _batched=True, **pool[slot]
+                _batched=True, **stamp["fields"], **pool[slot]
             )
             cursor["i"] = 0
             cursor["slot"] = (slot + 1) % len(pool)
@@ -231,7 +305,8 @@ def main(argv=None) -> int:
             # partial tail: copy the filled prefix — the pool slot is
             # reused, publish-by-reference would race the IO thread
             pub.publish(
-                _batched=True, **{k: v[:i].copy() for k, v in buf.items()}
+                _batched=True, **stamp["fields"],
+                **{k: v[:i].copy() for k, v in buf.items()},
             )
 
     # Graceful drain on SIGTERM (retire_instance's drain contract):
@@ -255,6 +330,8 @@ def main(argv=None) -> int:
                 leave(opts.announce, btid, timeoutms=2000)
             except Exception:
                 pass  # consumer gone: nothing left to drain into
+        if applicator is not None:
+            applicator.close()
         pub.close()
         term_context()  # block until the tail is flushed (bounded by linger)
     return 0

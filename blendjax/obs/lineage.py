@@ -53,6 +53,11 @@ PUB_WALL_KEY = "_pub_wall"
 PUB_MONO_KEY = "_pub_mono"
 TELEMETRY_KEY = "_telemetry"
 
+# Deliberately NOT a stamp: "_scenario" (blendjax.scenario). Lineage
+# stamps describe the TRANSPORT of a frame (when/in what order it was
+# published) and go stale on replay; the scenario stamp describes the
+# CONTENT (which distribution rendered it) and must survive replay so
+# recorded streams re-account per scenario deterministically.
 _STAMP_KEYS = (SEQ_KEY, PUB_WALL_KEY, PUB_MONO_KEY, TELEMETRY_KEY,
                TRACE_KEY)
 

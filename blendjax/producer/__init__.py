@@ -18,6 +18,7 @@ from blendjax.producer.camera import Camera
 from blendjax.producer.duplex import DuplexChannel
 from blendjax.producer.env import BaseEnv, RemoteControlledAgent
 from blendjax.producer.publisher import DataPublisher
+from blendjax.producer.scenario import ScenarioApplicator
 from blendjax.producer.signal import Signal
 from blendjax.producer.tile_publisher import TileBatchPublisher
 
@@ -27,6 +28,7 @@ __all__ = [
     "Camera",
     "DataPublisher",
     "DuplexChannel",
+    "ScenarioApplicator",
     "Signal",
     "BaseEnv",
     "RemoteControlledAgent",

@@ -19,7 +19,12 @@ class DuplexChannel(PairChannel):
         lingerms: int = 0,
         hwm: int = constants.DEFAULT_SEND_HWM,
         codec: str = "tensor",
+        allow_pickle: bool = True,
     ):
+        # ``allow_pickle`` defaults True for reference-producer compat;
+        # network-facing control consumers (the scenario applicator's
+        # channel, whose address may be announced off-host) pass False
+        # so a pickled payload can never execute in the producer.
         super().__init__(
             addr,
             btid=btid,
@@ -28,4 +33,5 @@ class DuplexChannel(PairChannel):
             lingerms=lingerms,
             codec=codec,
             default_timeoutms=constants.DEFAULT_PRODUCER_TIMEOUTMS,
+            allow_pickle=allow_pickle,
         )

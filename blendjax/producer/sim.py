@@ -370,10 +370,53 @@ class CubeScene(SimScene):
         self.half_extent = half_extent
         self.rotation = np.eye(3)
         self.color = np.array([200, 80, 40], np.uint8)
+        # Domain-randomization hooks (blendjax.scenario): label noise in
+        # pixels — the knob that makes a scenario irreducibly harder —
+        # applied in observation()/observation_into().
+        self.xy_jitter = 0.0
         super().__init__(shape=shape, seed=seed)
+        # apply_scenario reverts unnamed known params to these — a
+        # scenario draw is a complete description, never a delta on the
+        # previous draw's state
+        self._scenario_defaults = {
+            "half_extent": float(half_extent),
+            "background": self.raster.background.copy(),
+        }
 
     def reset(self) -> None:
         self.rotation = np.eye(3)
+
+    def apply_scenario(self, params: dict) -> None:
+        """Apply one sampled scenario-parameter dict (the
+        :class:`blendjax.producer.scenario.ScenarioApplicator` hook).
+        Known params: ``half_extent`` (cube size), ``xy_jitter`` (label
+        noise sigma, pixels; clamped >= 0), ``bg`` (background gray
+        level 0-255). Unknown params are ignored — a space may carry
+        params for scenes of several kinds.
+
+        A draw describes the scene COMPLETELY for the known keys:
+        params absent from this draw revert to their defaults. Without
+        the revert, a scenario that doesn't name ``xy_jitter`` would
+        silently keep the PREVIOUS scenario's noise — cross-scenario
+        state leakage that flattens the per-scenario loss gap the
+        curriculum feeds on (observed: both scenarios converged to the
+        same loss and the weights wandered)."""
+        self.half_extent = float(
+            params.get("half_extent", self._scenario_defaults["half_extent"])
+        )
+        self.xy_jitter = max(0.0, float(params.get("xy_jitter", 0.0)))
+        bg = params.get("bg")
+        g = (
+            self._scenario_defaults["background"] if bg is None
+            else np.ascontiguousarray(
+                [int(np.clip(float(bg), 0, 255))] * 3 + [255], np.uint8
+            )
+        )
+        if not np.array_equal(g, self.raster.background):
+            self.raster.background = g
+            # dirty-rect clears assume a constant background: force a
+            # full repaint so stale pixels of the old background die
+            self.raster.invalidate()
 
     def step(self, frame: int) -> None:
         self.rotation = rotation_xyz(*self.rng.uniform(0, 2 * np.pi, size=3))
@@ -392,17 +435,28 @@ class CubeScene(SimScene):
         colors = np.clip(base[None, :] * tint[:, None], 0, 255).astype(np.uint8)
         return self.raster.render(self.camera, tris, colors, out=out)
 
+    def _label_xy(self) -> np.ndarray:
+        xy = self.camera.world_to_pixel(self.corners_world())
+        if self.xy_jitter:
+            # irreducible label noise: the scenario axis a curriculum
+            # can detect purely from training loss
+            xy = xy + self.rng.normal(0.0, self.xy_jitter, xy.shape)
+        return xy
+
     def observation(self, frame: int) -> dict:
         img = self.render()
-        xy = self.camera.world_to_pixel(self.corners_world())
-        return {"image": img, "xy": xy.astype(np.float32), "frameid": frame}
+        return {
+            "image": img,
+            "xy": self._label_xy().astype(np.float32),
+            "frameid": frame,
+        }
 
     def observation_into(self, frame: int, buf: dict, i: int) -> None:
         """Write frame ``frame``'s observation into slot ``i`` of a batch
         buffer dict (``image`` (B,H,W,4) u8, ``xy`` (B,8,2) f32, ``frameid``
         (B,) i64) — the zero-copy path for batch-publishing producers."""
         self.render(out=buf["image"][i])
-        buf["xy"][i] = self.camera.world_to_pixel(self.corners_world())
+        buf["xy"][i] = self._label_xy()
         buf["frameid"][i] = frame
 
 

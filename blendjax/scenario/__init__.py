@@ -1,0 +1,70 @@
+"""blendjax.scenario — closed-loop domain randomization (docs/scenarios.md).
+
+blendtorch's signature capability (the densityopt example's consumer-
+driven simulation-parameter optimization over the duplex channel)
+promoted to a subsystem spanning the whole pipeline:
+
+- :mod:`~blendjax.scenario.space` — a declarative, versioned,
+  pickle-free :class:`ScenarioSpace`: named scenarios over
+  uniform/gaussian/categorical/mixture parameter distributions with
+  mixture weights, plus the compact CLI grammar;
+- :mod:`~blendjax.scenario.service` — :class:`ScenarioService`
+  publishes the space (version-stamped, acked) to every producer over
+  the existing PAIR duplex sockets, including producers that join or
+  leave mid-run via the fleet controller / admission server;
+- producer side: :class:`blendjax.producer.scenario.ScenarioApplicator`
+  samples from the latest space, applies the draw to the scene
+  (Blender or the synthetic tier), and stamps ``_scenario`` into every
+  message;
+- :mod:`~blendjax.scenario.accounting` — exact per-scenario row counts,
+  fresh-vs-echoed splits (echoed rows carry the anchor row's scenario),
+  per-scenario loss histograms, per-version attribution — bounded
+  keying, never dynamic metric names (bjx-lint BJX113);
+- :mod:`~blendjax.scenario.curriculum` — :class:`ScenarioCurriculum`
+  feeds per-scenario losses back into mixture weights (bandit) and the
+  continuous params (REINFORCE, generalizing
+  ``train.score.GaussianSimParams``), re-published on a cadence.
+
+Import-cheap: numpy/stdlib only — producer processes import the space
+and the stamp keys without jax.
+"""
+
+from __future__ import annotations
+
+from blendjax.scenario.accounting import (  # noqa: F401
+    SCENARIO_KEY,
+    SCENARIO_ROWS_KEY,
+    ScenarioAccounting,
+    accounting,
+    batch_row_scenarios,
+)
+from blendjax.scenario.curriculum import ScenarioCurriculum  # noqa: F401
+from blendjax.scenario.service import ScenarioService  # noqa: F401
+from blendjax.scenario.space import (  # noqa: F401
+    Choice,
+    Const,
+    Dist,
+    Gaussian,
+    Mixture,
+    Scenario,
+    ScenarioSpace,
+    Uniform,
+)
+
+__all__ = [
+    "SCENARIO_KEY",
+    "SCENARIO_ROWS_KEY",
+    "ScenarioAccounting",
+    "accounting",
+    "batch_row_scenarios",
+    "ScenarioCurriculum",
+    "ScenarioService",
+    "Choice",
+    "Const",
+    "Dist",
+    "Gaussian",
+    "Mixture",
+    "Scenario",
+    "ScenarioSpace",
+    "Uniform",
+]

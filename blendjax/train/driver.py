@@ -229,6 +229,16 @@ class TrainDriver:
         if traces:
             for tr in traces:
                 trace_stage(tr, "step_dispatch")
+        # Scenario stamps (blendjax.scenario) are the same kind of
+        # host-side sidecar: string/None leaves a jit flattens and
+        # rejects. The eager echo path attaches per-row stamps to
+        # SAMPLE batches (the fused token path filters keys itself),
+        # so pop them here — accounting reads them BEFORE submit.
+        if "_scenario_rows" in batch or "_scenario" in batch:
+            batch = {
+                k: v for k, v in batch.items()
+                if k not in ("_scenario_rows", "_scenario")
+            }
         images = self._batch_images(batch)
         if self._t_first_dispatch is None:
             self._t_first_dispatch = time.monotonic()

@@ -130,6 +130,30 @@ def main():
         "cannot. Composes with --fleet (MIN wins as the start count)",
     )
     ap.add_argument(
+        "--scenarios", default=None, metavar="SPEC",
+        help="closed-loop domain randomization (blendjax.scenario, "
+        "docs/scenarios.md): publish a scenario space over a per-"
+        "producer duplex channel and account every train row to the "
+        "scenario that rendered it. SPEC uses the space grammar, e.g. "
+        "'easy:half_extent=u(0.8,1.2) / "
+        "hard:half_extent=u(0.8,1.2),xy_jitter=g(6,0.5)'. Needs "
+        "--synthetic-producers (the synthetic tier consumes the "
+        "duplex channel; Blender scenes wire their own "
+        "ScenarioApplicator)",
+    )
+    ap.add_argument(
+        "--curriculum", action="store_true",
+        help="adapt the scenario space from per-scenario training "
+        "loss: mixture weights move toward high-loss scenarios "
+        "(bandit) and gaussian params update by REINFORCE, "
+        "re-published on a cadence (needs --scenarios; incompatible "
+        "with --inflight — the curriculum reads the loss every step)",
+    )
+    ap.add_argument(
+        "--curriculum-every", type=int, default=50, metavar="STEPS",
+        help="curriculum update cadence in train steps",
+    )
+    ap.add_argument(
         "--augment", action="store_true",
         help="on-device color jitter inside the jitted step "
         "(blendjax.ops.augment; per-step deterministic keys). Only "
@@ -154,6 +178,20 @@ def main():
         ap.error(
             "--synthetic-producers publishes raw frames: use "
             "--encoding raw"
+        )
+    if args.scenarios and not args.synthetic_producers:
+        ap.error(
+            "--scenarios needs --synthetic-producers (the synthetic "
+            "tier consumes the scenario duplex channel; Blender scenes "
+            "wire a ScenarioApplicator in their producer script)"
+        )
+    if args.scenarios and args.replay:
+        ap.error("--scenarios publishes to live producers; drop --replay")
+    if args.curriculum and not args.scenarios:
+        ap.error("--curriculum needs a --scenarios space to adapt")
+    if args.curriculum and args.inflight > 0:
+        ap.error(
+            "--curriculum reads the loss every step: drop --inflight"
         )
 
     import jax
@@ -279,6 +317,8 @@ def main():
             warm_start_allow_pickle=args.allow_pickle,
         )
 
+    scenario_ctx: dict = {}
+
     def run_steps(batches):
         nonlocal state
         t0, n = time.perf_counter(), 0
@@ -286,12 +326,37 @@ def main():
             if i >= args.steps:
                 break
             if driver is not None:
+                if scenario_ctx:
+                    # rows only (no per-step loss fetch in driver
+                    # mode), accounted BEFORE submit — the driver
+                    # strips the host-side scenario sidecar off the
+                    # batch it hands to the jit
+                    scenario_ctx["accounting"].account_batch(batch)
                 driver.submit(batch)
             else:
                 fields = {"image": batch["image"], "xy": batch["xy"]}
                 if "_mask" in batch:  # bucket-padded tail: loss-masked
                     fields["_mask"] = batch["_mask"]
                 state, metrics = step(state, fields)
+                if scenario_ctx:
+                    loss_val = None
+                    cur = scenario_ctx.get("curriculum")
+                    if cur is not None:
+                        loss = metrics["loss"]
+                        loss = (
+                            loss[-1] if getattr(loss, "ndim", 0) else loss
+                        )
+                        loss_val = float(loss)  # the curriculum's evidence
+                    scenario_ctx["accounting"].account_batch(
+                        batch, loss=loss_val
+                    )
+                    if cur is not None:
+                        report = cur.step(1)
+                        if report:
+                            print(
+                                f"curriculum v{report['version']}: "
+                                f"weights={report['weights']}"
+                            )
                 if i % 10 == 0:
                     loss = metrics["loss"]
                     loss = loss[-1] if getattr(loss, "ndim", 0) else loss
@@ -341,10 +406,14 @@ def main():
             start_n = args.instances
         if fleet_bounds:
             start_n = fleet_bounds[0]
+        named_sockets = ["DATA"]
+        if args.scenarios:
+            named_sockets = ["DATA", "CTRL"]
+            producer_args = producer_args + ["--scenario-wait", "15"]
         with PythonProducerLauncher(
             script=script,
             num_instances=start_n,
-            named_sockets=["DATA"],
+            named_sockets=named_sockets,
             seed=0,
             instance_args=[producer_args] * start_n,
         ) as launcher:
@@ -356,6 +425,31 @@ def main():
                 emit_packed=use_fused,
                 record_path_prefix=args.record,
             )
+            svc = None
+            if args.scenarios:
+                from blendjax.scenario import (
+                    ScenarioCurriculum,
+                    ScenarioService,
+                    ScenarioSpace,
+                    accounting,
+                )
+
+                space = ScenarioSpace.parse(args.scenarios)
+                svc = ScenarioService(space)
+                for i, addr in enumerate(launcher.addresses["CTRL"]):
+                    svc.attach(i, addr)
+                if not svc.wait_acked(timeout=15):
+                    print(
+                        "warning: not every producer acked the scenario "
+                        f"space yet: {svc.state()}"
+                    )
+                scenario_ctx["accounting"] = accounting
+                scenario_ctx["service"] = svc
+                if args.curriculum:
+                    scenario_ctx["curriculum"] = ScenarioCurriculum(
+                        space, service=svc,
+                        every_steps=args.curriculum_every,
+                    )
             ctrl = None
             if fleet_bounds:
                 from blendjax.fleet import FleetController, FleetPolicy
@@ -375,6 +469,10 @@ def main():
                         if reporter is not None else None
                     ),
                     instance_args=producer_args,
+                    # elastic scenario membership: a scaled-up producer
+                    # receives the current space before its data
+                    # address joins the fan-in
+                    scenario_service=svc,
                 ).start()
                 if reporter is not None:
                     # fleet state rides the JSONL archive per tick
@@ -384,6 +482,26 @@ def main():
                     run_steps(iter(source))
                     if echo_mode:
                         print(f"echo={source.stats}")
+                    if scenario_ctx:
+                        rep = scenario_ctx["accounting"].report()
+                        print(
+                            f"scenario space v{rep['space_version']}: "
+                            + ", ".join(
+                                f"{sid}: {s['rows']} rows "
+                                f"({s['fresh']} fresh/{s['echoed']} "
+                                f"echoed, loss p50 "
+                                f"{s['loss']['p50']:.4f})"
+                                for sid, s in rep["scenarios"].items()
+                            )
+                        )
+                        if "curriculum" in scenario_ctx:
+                            w = scenario_ctx["curriculum"].space.weights()
+                            print(
+                                "curriculum weights: "
+                                + ", ".join(
+                                    f"{k}={v:.3f}" for k, v in w.items()
+                                )
+                            )
                     print(source.doctor(driver).render())
                     if ctrl is not None:
                         st = ctrl.state()
@@ -402,6 +520,8 @@ def main():
             finally:
                 if ctrl is not None:
                     ctrl.stop()
+                if svc is not None:
+                    svc.stop()
     finally:
         if reporter is not None:
             reporter.stop()  # final tick logs the closing verdict

@@ -1191,10 +1191,73 @@ def test_bjx112_non_step_jits_and_suppressions_pass():
     assert rule_ids(suppressed, select=["BJX112"]) == []
 
 
+# -- BJX113 scenario-id-cardinality ------------------------------------------
+
+
+def test_bjx113_flags_scenario_id_fstring_anywhere():
+    # NOT a hot-path module: BJX107 stays silent, BJX113 fires — the
+    # scenario-id rule covers every module.
+    src = """
+        from blendjax.utils.metrics import metrics
+
+        def account(sid, loss):
+            metrics.count(f"scenario.{sid}.rows")
+            metrics.observe("loss_" + sid, loss)
+    """
+    assert rule_ids(src, select=["BJX113"]) == ["BJX113", "BJX113"]
+    assert rule_ids(src, select=["BJX107"]) == []
+
+
+def test_bjx113_flags_format_and_bare_variable_forms():
+    src = """
+        from blendjax.utils.metrics import metrics
+
+        def account(scenario_id, batch):
+            metrics.gauge("scenario.{}.fill".format(scenario_id), 1)
+            metrics.count(scenario_id)
+    """
+    assert rule_ids(src, select=["BJX113"]) == ["BJX113", "BJX113"]
+
+
+def test_bjx113_ignores_constant_and_non_scenario_dynamic_names():
+    src = """
+        from blendjax.utils.metrics import metrics
+
+        def account(shard, sids):
+            metrics.count("scenario.rows", len(sids))
+            metrics.gauge("scenario.space_version", 3)
+            # dynamic but not scenario identity: BJX107's (hot-path)
+            # business, not BJX113's
+            metrics.count(f"ingest.shard{shard}.items")
+    """
+    assert rule_ids(src, select=["BJX113"]) == []
+
+
+def test_bjx113_non_registry_receivers_untouched():
+    src = """
+        def f(ledger, sid):
+            ledger.count(f"scenario.{sid}")
+    """
+    assert rule_ids(src, select=["BJX113"]) == []
+
+
+def test_bjx113_suppressible_inline():
+    src = """
+        from blendjax.utils.metrics import metrics
+
+        def account(sid):
+            # bounded: test fixture with exactly two ids
+            # bjx: ignore[BJX113]
+            metrics.count(f"scenario.{sid}.rows")
+    """
+    assert rule_ids(src, select=["BJX113"]) == []
+
+
 def test_every_rule_registered():
     assert set(all_rules()) == {
         "BJX101", "BJX102", "BJX103", "BJX104", "BJX105", "BJX106",
         "BJX107", "BJX108", "BJX109", "BJX110", "BJX111", "BJX112",
+        "BJX113",
     }
 
 

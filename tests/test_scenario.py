@@ -1,0 +1,837 @@
+"""blendjax.scenario: the closed-loop domain-randomization service.
+
+Covers the tentpole contracts (docs/scenarios.md): pickle-free space
+serialization, the version/ack duplex protocol over a real PairChannel,
+exact per-scenario accounting with stale-version attribution, the
+echo-path sidecar (echoed rows attributed to their TRUE scenario),
+curriculum adaptation on synthetic fixtures, fleet-controller
+membership integration with a mid-run scale-up, and an end-to-end
+synthetic-fleet run with exact per-scenario histograms.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+from blendjax.scenario import (
+    SCENARIO_KEY,
+    SCENARIO_ROWS_KEY,
+    Gaussian,
+    ScenarioAccounting,
+    ScenarioCurriculum,
+    ScenarioService,
+    ScenarioSpace,
+    Uniform,
+    batch_row_scenarios,
+)
+from blendjax.scenario.space import Choice, Mixture
+
+# ---------------------------------------------------------------------------
+# space: grammar, sampling, wire form
+# ---------------------------------------------------------------------------
+
+
+def test_space_grammar_distributions_and_weights():
+    sp = ScenarioSpace.parse(
+        "easy:half_extent=u(0.5,0.8) / "
+        "hard*3:xy_jitter=g(6,0.5),style=c(a|b|c),size=m(u(0,1)@0.7|g(2,0.1)@0.3),k=42"
+    )
+    assert sp.names == ("easy", "hard")
+    w = sp.weights()
+    assert abs(w["hard"] - 0.75) < 1e-9 and abs(sum(w.values()) - 1) < 1e-9
+    hard = sp.scenarios["hard"]
+    assert isinstance(hard.params["xy_jitter"], Gaussian)
+    assert isinstance(hard.params["style"], Choice)
+    assert isinstance(hard.params["size"], Mixture)
+    assert hard.params["k"].sample(np.random.default_rng(0)) == 42
+    assert isinstance(sp.scenarios["easy"].params["half_extent"], Uniform)
+
+
+def test_space_sampling_bounds_and_theta_order():
+    sp = ScenarioSpace.parse("s:a=u(1,2),b=g(10,0.1),c=g(-5,0.1)")
+    rng = np.random.default_rng(0)
+    for _ in range(32):
+        name, params, theta = sp.sample(rng)
+        assert name == "s"
+        assert 1 <= params["a"] <= 2
+        # theta lists GAUSSIAN param draws in declaration order (b, c)
+        assert theta == [params["b"], params["c"]]
+
+
+def test_space_wire_roundtrip_is_pickle_free():
+    from blendjax.transport.wire import decode_message, encode_message
+
+    sp = ScenarioSpace.parse(
+        "easy*2:half_extent=u(0.8,1.2) / "
+        "hard:xy_jitter=g(6,0.5),style=c(a|b@0.25|c)", version=7,
+    )
+    frames = encode_message(
+        {"scenario_space": sp.to_wire(), "scenario_version": sp.version}
+    )
+    # allow_pickle=False: a pkl entry anywhere in the payload would raise
+    msg = decode_message([bytes(f) for f in frames], allow_pickle=False)
+    sp2 = ScenarioSpace.from_wire(msg["scenario_space"])
+    assert sp2.version == 7
+    assert sp2.names == sp.names
+    assert sp2.weights() == sp.weights()
+    hard = sp2.scenarios["hard"]
+    assert isinstance(hard.params["xy_jitter"], Gaussian)
+    assert hard.params["xy_jitter"].mu == 6.0
+    assert hard.params["style"].values == ["a", "b", "c"]
+
+
+def test_space_grammar_slash_inside_categorical_values():
+    sp = ScenarioSpace.parse(
+        "a:tex=c(wood/oak|stone/slate) / b:x=u(0,1)"
+    )
+    assert sp.names == ("a", "b")
+    assert sp.scenarios["a"].params["tex"].values == [
+        "wood/oak", "stone/slate"
+    ]
+
+
+def test_space_grammar_partial_weights_are_honored():
+    # mixed '@w' specs: unweighted entries default to 1.0 — never a
+    # silent fall-back to uniform
+    sp = ScenarioSpace.parse("s:style=c(a@0.9|b),mix=m(u(0,1)@3|g(5,1))")
+    c = sp.scenarios["s"].params["style"]
+    assert c.probs is not None and abs(c.probs[0] - 0.9 / 1.9) < 1e-9
+    m = sp.scenarios["s"].params["mix"]
+    assert abs(m.weights[0] - 0.75) < 1e-9
+
+
+def test_space_grammar_errors():
+    with pytest.raises(ValueError):
+        ScenarioSpace.parse("")
+    with pytest.raises(ValueError):
+        ScenarioSpace.parse("noparams-and-no-colon")
+    with pytest.raises(ValueError):
+        ScenarioSpace.parse("s:a=zzz(1,2)")
+    with pytest.raises(ValueError):
+        ScenarioSpace([])
+
+
+# ---------------------------------------------------------------------------
+# version/ack protocol over a real PairChannel
+# ---------------------------------------------------------------------------
+
+
+def test_service_publish_ack_over_real_pair_channel():
+    from blendjax.producer import DuplexChannel
+    from blendjax.producer.scenario import ScenarioApplicator
+
+    applied = []
+    chan = DuplexChannel("tcp://127.0.0.1:0", btid=0)
+    app = ScenarioApplicator(chan, apply=applied.append, rng=0)
+    sp = ScenarioSpace.parse("s:a=u(0,1)")
+    svc = ScenarioService(sp)
+    try:
+        svc.attach(0, chan.addr)
+        assert app.wait_for_space(timeout_s=10)
+        assert app.version == 1
+        assert svc.wait_acked(version=1, timeout=10), svc.state()
+        # re-publish a bumped space: producer adopts the new version
+        sp.bump()
+        svc.publish(sp)
+        deadline = time.monotonic() + 10
+        while app.version < 2 and time.monotonic() < deadline:
+            app.poll()
+            time.sleep(0.01)
+        assert app.version == 2
+        assert svc.wait_acked(version=2, timeout=10), svc.state()
+        draw = app.sample()
+        assert draw.scenario == "s" and 0 <= draw.params["a"] <= 1
+        assert applied and applied[-1] == draw.params
+        stamp = app.next_scenario()[SCENARIO_KEY]
+        assert stamp["ver"] == 2 and stamp["id"] == "s"
+    finally:
+        svc.stop()
+        chan.close()
+
+
+def test_service_detach_closes_member():
+    from blendjax.producer import DuplexChannel
+
+    from blendjax.producer.scenario import ScenarioApplicator
+
+    chan = DuplexChannel("tcp://127.0.0.1:0", btid=0)
+    app = ScenarioApplicator(chan)
+    svc = ScenarioService(ScenarioSpace.parse("s:a=1"))
+    try:
+        svc.attach(7, chan.addr)
+        assert app.wait_for_space(timeout_s=10)
+        assert svc.wait_acked(timeout=10), svc.state()
+        assert 7 in svc.members()
+        svc.detach(7)
+        assert 7 not in svc.members()
+    finally:
+        svc.stop()
+        chan.close()
+
+
+def test_service_survives_dead_member_and_malformed_acks():
+    """One silently-dead member (connected endpoint, nobody there) and
+    one hostile member (junk acks) must cost log lines, never the
+    fleet's distribution thread: a healthy member still receives every
+    republish and its acks still land."""
+    from blendjax.producer import DuplexChannel
+    from blendjax.producer.scenario import ScenarioApplicator
+
+    chan = DuplexChannel("tcp://127.0.0.1:0", btid=0)
+    app = ScenarioApplicator(chan)
+    sp = ScenarioSpace.parse("s:a=u(0,1)")
+    svc = ScenarioService(sp)
+    try:
+        # dead member: nothing listens on this endpoint, and PAIR send
+        # would BLOCK forever without the service's send timeout
+        svc.attach(99, "tcp://127.0.0.1:9")
+        svc.attach(0, chan.addr)
+        assert app.wait_for_space(timeout_s=10)
+        # hostile member: malformed acks must not kill the thread
+        chan.send(scenario_ack="junk")
+        chan.send(scenario_ack=None)
+        for _ in range(3):  # several republishes through the dead member
+            sp.bump()
+            svc.publish(sp)
+        deadline = time.monotonic() + 15
+        while app.version < sp.version and time.monotonic() < deadline:
+            app.poll()
+            time.sleep(0.01)
+        assert app.version == sp.version
+        assert svc.wait_acked(version=sp.version, btids=[0], timeout=10), (
+            svc.state()
+        )
+    finally:
+        svc.stop()
+        chan.close()
+
+
+# ---------------------------------------------------------------------------
+# accounting: exact counts, versions, losses
+# ---------------------------------------------------------------------------
+
+
+def _stamped_batch(sid, ver, n=4, theta=None):
+    stamp = {"id": sid, "ver": ver}
+    if theta is not None:
+        stamp["theta"] = theta
+    return {
+        "image": np.zeros((n, 4, 4, 3), np.uint8),
+        "_meta": [{"btid": 0, SCENARIO_KEY: dict(stamp)}] * n,
+    }
+
+
+def test_accounting_exact_counts_and_stale_version_attribution():
+    led = ScenarioAccounting()
+    led.declare(ScenarioSpace.parse("a:x=1 / b:x=2", version=2))
+    led.account_batch(_stamped_batch("a", 1, n=4), loss=0.5)
+    led.account_batch(_stamped_batch("b", 2, n=4), loss=1.5)
+    # stale-version frames (produced before the v2 publish) land under
+    # the version stamped on them, not the current one
+    led.account_batch(_stamped_batch("a", 1, n=2), loss=0.25)
+    rep = led.report()
+    a, b = rep["scenarios"]["a"], rep["scenarios"]["b"]
+    assert a["rows"] == 6 and a["fresh"] == 6 and a["echoed"] == 0
+    assert b["rows"] == 4
+    assert a["versions"] == {1: 6}
+    assert b["versions"] == {2: 4}
+    # loss histograms: one observation per scored row (exact counts)
+    assert a["loss"]["count"] == 6 and b["loss"]["count"] == 4
+    assert a["declared"] and b["declared"]
+    assert rep["space_version"] == 2
+
+
+def test_accounting_batch_level_stamp_and_lead_inference():
+    led = ScenarioAccounting()
+    batch = {
+        "image": np.zeros((3, 4, 4, 3), np.uint8),
+        SCENARIO_KEY: {"id": "solo", "ver": 1},
+    }
+    assert led.account_batch(batch, loss=1.0) == 3
+    assert led.totals() == {"solo": (3, 0)}
+
+
+def test_accounting_unstamped_batches_are_a_noop():
+    led = ScenarioAccounting()
+    batch = {"image": np.zeros((3, 4, 4, 3), np.uint8)}
+    assert led.account_batch(batch, loss=1.0) == 0
+    assert led.totals() == {}
+
+
+def test_accounting_overflow_folds_into_one_bucket():
+    from blendjax.utils.metrics import metrics
+
+    led = ScenarioAccounting(max_scenarios=2)
+    led.account_batch(_stamped_batch("a", 1, n=1))
+    led.account_batch(_stamped_batch("b", 1, n=1))
+    before = metrics.counter_value("scenario.overflow_rows")
+    for i in range(5):
+        # loss given too: the overflow METRIC must count each row
+        # once, not once per (observe_rows, observe_loss) lookup
+        led.account_batch(_stamped_batch(f"junk{i}", 1, n=1), loss=1.0)
+    totals = led.totals()
+    assert set(totals) == {"a", "b", "__overflow__"}
+    assert totals["__overflow__"] == (5, 0)
+    assert metrics.counter_value("scenario.overflow_rows") - before == 5
+
+
+def test_schema_keeps_scenario_meta_even_when_first_item_unstamped():
+    """A mixed fleet's (or a space-timeout producer's) FIRST decoded
+    item may be unstamped; the frozen schema must still carry later
+    stamps into ``_meta`` or accounting reads zero forever."""
+    from blendjax.data.batcher import BatchAssembler
+    from blendjax.data.schema import StreamSchema
+
+    first = {"image": np.zeros((4, 4, 3), np.uint8), "btid": 0}
+    schema = StreamSchema.infer(first)
+    assert SCENARIO_KEY in schema.meta_keys
+    asm = BatchAssembler(schema, batch_size=2)
+    assert asm.add(first) is None
+    stamped = dict(first)
+    stamped[SCENARIO_KEY] = {"id": "late", "ver": 2}
+    batch = asm.add(stamped)
+    rows = batch_row_scenarios(batch, 2)
+    assert rows == [None, {"id": "late", "ver": 2}]
+
+
+def test_account_batch_chunked_superbatch_meta():
+    """Chunked (K, B, ...) batches carry _meta as K rest dicts each
+    nesting a per-item _meta list (pipeline.py's chunk plans):
+    accounting must flatten them, not silently read zero."""
+    led = ScenarioAccounting()
+    rests = [
+        {"btid": 0, "_meta": [
+            {"btid": 0, SCENARIO_KEY: {"id": "a", "ver": 1}},
+            {"btid": 0, SCENARIO_KEY: {"id": "b", "ver": 1}},
+        ]}
+        for _ in range(3)
+    ]
+    batch = {"image": np.zeros((3, 2, 4, 4, 3), np.uint8), "_meta": rests}
+    assert led.account_batch(batch, loss=0.5) == 6
+    assert led.totals() == {"a": (3, 0), "b": (3, 0)}
+
+
+def test_batch_row_scenarios_precedence():
+    rows = [{"id": "r", "ver": 3}] * 2
+    batch = {
+        SCENARIO_ROWS_KEY: rows,
+        "_meta": [{SCENARIO_KEY: {"id": "m", "ver": 1}}] * 2,
+        SCENARIO_KEY: {"id": "b", "ver": 1},
+    }
+    assert batch_row_scenarios(batch, 2) == rows
+    del batch[SCENARIO_ROWS_KEY]
+    assert [r["id"] for r in batch_row_scenarios(batch, 2)] == ["m", "m"]
+    del batch["_meta"]
+    assert [r["id"] for r in batch_row_scenarios(batch, 2)] == ["b", "b"]
+
+
+# ---------------------------------------------------------------------------
+# echo path: per-row attribution stays exact
+# ---------------------------------------------------------------------------
+
+
+def test_echo_rows_attributed_to_true_scenario_exactly(monkeypatch):
+    import blendjax.data.echo as echo_mod
+    from blendjax.data.echo import EchoingPipeline
+
+    led = ScenarioAccounting()
+    monkeypatch.setattr(echo_mod, "scenario_accounting", led)
+
+    def batches():
+        # scenario alternates per INSERTED batch: echoed draws mix
+        # slots across batches, so per-row attribution is the only
+        # correct accounting (a batch-level stamp would lie)
+        for i in range(8):
+            yield _stamped_batch("even" if i % 2 == 0 else "odd", 1, n=4)
+
+    pipe = EchoingPipeline(
+        batches(), capacity=32, max_echo_factor=4, batch_size=4,
+        augment=None,
+    )
+    steps = 0
+    with pipe:
+        for b in pipe:
+            rows = b[SCENARIO_ROWS_KEY]
+            assert len(rows) == 4 and all(
+                r["id"] in ("even", "odd") for r in rows
+            )
+            steps += 1
+    totals = led.totals()
+    assert set(totals) == {"even", "odd"}
+    # the exactness identity, per scenario and in total:
+    # fresh + echoed == steps * batch, and fresh == first uses
+    assert sum(f + e for f, e in totals.values()) == steps * 4
+    assert sum(f for f, _ in totals.values()) == pipe.fresh
+    assert sum(e for _, e in totals.values()) == pipe.echoed
+    # each scenario inserted 16 rows; fresh can never exceed that
+    assert totals["even"][0] <= 16 and totals["odd"][0] <= 16
+    assert pipe.fresh + pipe.echoed == steps * 4
+
+
+def test_echo_unstamped_batches_clear_slot_sidecar(monkeypatch):
+    import blendjax.data.echo as echo_mod
+    from blendjax.data.echo import EchoingPipeline
+
+    led = ScenarioAccounting()
+    monkeypatch.setattr(echo_mod, "scenario_accounting", led)
+
+    def batches():
+        yield _stamped_batch("a", 1, n=4)
+        yield {"image": np.ones((4, 4, 4, 3), np.uint8)}  # unstamped
+
+    pipe = EchoingPipeline(
+        batches(), capacity=4, max_echo_factor=2, batch_size=4,
+        augment=None,
+    )
+    drawn = 0
+    with pipe:
+        for b in pipe:
+            drawn += 4
+    # capacity 4: the unstamped batch overwrote every 'a' slot; rows
+    # drawn after the overwrite must NOT still read as scenario 'a'
+    f, e = led.totals().get("a", (0, 0))
+    assert f + e <= 8  # at most the stamped batch's own echo budget
+
+
+# ---------------------------------------------------------------------------
+# curriculum: weights toward high loss, REINFORCE on theta
+# ---------------------------------------------------------------------------
+
+
+def test_curriculum_moves_weight_toward_high_loss_scenario():
+    led = ScenarioAccounting()
+    sp = ScenarioSpace.parse("calm:x=1 / storm:x=2")
+    cur = ScenarioCurriculum(
+        sp, ledger=led, every_steps=1, adapt_params=False, min_rows=4,
+    )
+    for _ in range(4):
+        led.account_batch(_stamped_batch("calm", 1, n=4), loss=0.1)
+        led.account_batch(_stamped_batch("storm", 1, n=4), loss=1.0)
+    report = cur.update()
+    w = sp.weights()
+    assert report is not None and report["version"] == 2
+    assert w["storm"] > 0.5 > w["calm"]
+    # exploration floor: the easy scenario never starves
+    assert w["calm"] >= cur.weight_floor
+
+
+def test_curriculum_frozen_mode_never_mutates():
+    led = ScenarioAccounting()
+    sp = ScenarioSpace.parse("a:x=1 / b:x=2")
+    cur = ScenarioCurriculum(
+        sp, ledger=led, every_steps=1, adapt_params=False, frozen=True,
+    )
+    led.account_batch(_stamped_batch("a", 1, n=8), loss=0.1)
+    led.account_batch(_stamped_batch("b", 1, n=8), loss=9.0)
+    assert cur.step(1) is None
+    assert sp.version == 1 and sp.weights()["a"] == 0.5
+
+
+def test_curriculum_reinforce_moves_gaussian_mu():
+    from blendjax.scenario import Scenario
+
+    led = ScenarioAccounting()
+    # one scenario, one gaussian param starting at 0
+    sp = ScenarioSpace([Scenario("s", {"jit": Gaussian(0.0, 1.0)})])
+    cur = ScenarioCurriculum(
+        sp, ledger=led, every_steps=1, min_rows=2, param_lr=0.2,
+        weight_lr=0.0,
+    )
+    rng = np.random.default_rng(0)
+    # loss = (theta - 2)^2: REINFORCE should pull mu toward 2
+    for _ in range(3):
+        for _ in range(16):
+            theta = float(rng.normal(0.0, 1.0) + sp.scenarios["s"].params["jit"].mu)
+            led.observe_rows([{"id": "s", "ver": sp.version}])
+            led.observe_loss(
+                [{"id": "s", "ver": sp.version, "theta": [theta]}],
+                (theta - 2.0) ** 2,
+            )
+        cur.update()
+    assert sp.scenarios["s"].params["jit"].mu > 0.15
+    assert sp.version > 1
+
+
+def test_curriculum_min_rows_holds_update():
+    led = ScenarioAccounting()
+    sp = ScenarioSpace.parse("a:x=1 / b:x=2")
+    cur = ScenarioCurriculum(
+        sp, ledger=led, every_steps=1, min_rows=100, adapt_params=False,
+    )
+    led.account_batch(_stamped_batch("a", 1, n=4), loss=1.0)
+    assert cur.update() is None
+    assert sp.version == 1
+
+
+def test_curriculum_starved_scenario_accumulates_across_windows():
+    """A floored low-weight scenario below min_rows per window must
+    keep its evidence ACCUMULATING (not be reset), so once enough rows
+    gather the weights can move back — adaptation is never one-way."""
+    led = ScenarioAccounting()
+    sp = ScenarioSpace.parse("rich:x=1 / poor:x=2")
+    cur = ScenarioCurriculum(
+        sp, ledger=led, every_steps=1, min_rows=8, adapt_params=False,
+    )
+    # three windows: rich has plenty, poor trickles 4 rows per window
+    # at a HIGHER loss than rich
+    for _ in range(3):
+        led.account_batch(_stamped_batch("rich", 1, n=16), loss=0.1)
+        led.account_batch(_stamped_batch("poor", 1, n=4), loss=2.0)
+        cur.update()
+    # by window 2 poor accumulated >= 8 rows: the update saw it and
+    # moved weight toward the high-loss starved scenario
+    assert sp.weights()["poor"] > 0.5
+    assert sp.version >= 2
+
+
+def test_curriculum_no_signal_means_no_version_churn():
+    led = ScenarioAccounting()
+    sp = ScenarioSpace.parse("a:x=1 / b:x=2")
+    cur = ScenarioCurriculum(
+        sp, ledger=led, every_steps=1, min_rows=2, adapt_params=False,
+    )
+    # tied losses: nothing to adapt — the space must NOT bump or
+    # republish (per-version accounting would fragment over identical
+    # spaces)
+    led.account_batch(_stamped_batch("a", 1, n=8), loss=1.0)
+    led.account_batch(_stamped_batch("b", 1, n=8), loss=1.0)
+    assert cur.update() is None
+    assert sp.version == 1
+
+
+def test_curriculum_noop_cadence_keeps_evidence():
+    """A no-op update (tied losses) must not consume the evidence
+    windows: the next cadence still sees the accumulated history."""
+    led = ScenarioAccounting()
+    sp = ScenarioSpace.parse("a:x=1 / b:x=2")
+    cur = ScenarioCurriculum(
+        sp, ledger=led, every_steps=1, min_rows=4, adapt_params=False,
+    )
+    led.account_batch(_stamped_batch("a", 1, n=8), loss=1.0)
+    led.account_batch(_stamped_batch("b", 1, n=8), loss=1.0)
+    assert cur.update() is None  # tie: no-op, windows untouched
+    # one differentiating batch later, the FULL history participates
+    led.account_batch(_stamped_batch("b", 1, n=8), loss=3.0)
+    report = cur.update()
+    assert report is not None and sp.weights()["b"] > 0.5
+    # windows were consumed by the real update
+    assert led.window_losses(reset=False, min_rows=1) == {}
+
+
+def test_cube_scene_scenario_draw_is_complete_not_a_delta():
+    """apply_scenario reverts unnamed known params to defaults: a
+    scenario without xy_jitter must NOT inherit the previous draw's
+    noise (cross-scenario leakage flattens the loss gap the curriculum
+    feeds on)."""
+    from blendjax.producer.sim import CubeScene
+
+    scene = CubeScene(shape=(16, 16), seed=0, half_extent=1.25)
+    scene.apply_scenario({"xy_jitter": 9.0, "half_extent": 0.5})
+    assert scene.xy_jitter == 9.0 and scene.half_extent == 0.5
+    scene.apply_scenario({"half_extent": 0.7})
+    assert scene.xy_jitter == 0.0  # reverted, not inherited
+    scene.apply_scenario({})
+    assert scene.half_extent == 1.25  # constructor default restored
+
+
+def test_driver_strips_scenario_sidecar_before_jit():
+    """Eager echo draws carry a host `_scenario_rows` sidecar (string/
+    None leaves): TrainDriver.submit must strip it before the jitted
+    step sees the batch — the scenario+echo+inflight combination."""
+    import jax.numpy as jnp
+
+    from blendjax.models import CubeRegressor
+    from blendjax.train import (
+        TrainDriver,
+        make_supervised_step,
+        make_train_state,
+    )
+
+    state = make_train_state(
+        CubeRegressor(), np.zeros((4, 16, 16, 4), np.uint8)
+    )
+    driver = TrainDriver(
+        make_supervised_step(), state, inflight=2, sync_every=1
+    )
+    batch = {
+        "image": jnp.zeros((4, 16, 16, 4), jnp.uint8),
+        "xy": jnp.zeros((4, 8, 2), jnp.float32),
+        SCENARIO_ROWS_KEY: [{"id": "a", "ver": 1}, None, None, None],
+        SCENARIO_KEY: {"id": "a", "ver": 1},
+    }
+    driver.submit(batch)
+    driver.submit(batch)
+    loss = driver.finish()[1]
+    assert loss is not None and np.isfinite(loss)
+    # the caller's batch keeps its sidecar (accounting reads it)
+    assert SCENARIO_ROWS_KEY in batch
+
+
+def test_applicator_sets_bounded_ack_send_timeout():
+    import zmq
+
+    from blendjax.producer import DuplexChannel
+    from blendjax.producer.scenario import ScenarioApplicator
+
+    chan = DuplexChannel("tcp://127.0.0.1:0", btid=0, allow_pickle=False)
+    try:
+        ScenarioApplicator(chan)
+        # a mute consumer must cost a bounded send, never a wedged
+        # render loop (the service-side channels carry the same bound)
+        assert chan.sock.getsockopt(zmq.SNDTIMEO) == 500
+    finally:
+        chan.close()
+
+
+def test_applicator_survives_malformed_control_message():
+    from blendjax.producer import DuplexChannel
+    from blendjax.producer.scenario import ScenarioApplicator
+    from blendjax.transport import PairChannel
+
+    chan = DuplexChannel("tcp://127.0.0.1:0", btid=0, allow_pickle=False)
+    app = ScenarioApplicator(chan)
+    peer = PairChannel(chan.addr, bind=False)
+    try:
+        # a pickle-bearing control payload (a set is not msgpack-able,
+        # so it ships as an embedded pkl entry) must be REFUSED without
+        # killing the producer's poll loop...
+        peer.send(scenario_space={1, 2, 3})
+        # ...and a well-formed space right behind it still lands
+        peer.send(
+            scenario_space=ScenarioSpace.parse("s:a=1").to_wire(),
+            scenario_version=1,
+        )
+        assert app.wait_for_space(timeout_s=10)
+        assert app.version == 1
+    finally:
+        peer.close()
+        chan.close()
+
+
+# ---------------------------------------------------------------------------
+# replay / torch-compat handling of the stamp
+# ---------------------------------------------------------------------------
+
+
+def test_strip_stamps_keeps_scenario_for_replay_reaccounting():
+    from blendjax.obs.lineage import strip_stamps
+
+    msg = {
+        "_seq": 4, "_pub_wall": 1.0, "_pub_mono": 2.0,
+        "_trace": {"id": "x"}, SCENARIO_KEY: {"id": "s", "ver": 3},
+        "image": 1,
+    }
+    out = strip_stamps(msg)
+    # transport stamps die on replay; the CONTENT stamp survives so a
+    # recorded stream re-accounts per scenario deterministically
+    assert "_seq" not in out and "_trace" not in out
+    assert out[SCENARIO_KEY] == {"id": "s", "ver": 3}
+
+
+# ---------------------------------------------------------------------------
+# fleet integration: membership changes keep the space consistent
+# ---------------------------------------------------------------------------
+
+
+class _StubScenarioService:
+    def __init__(self):
+        self.attached = []
+        self.detached = []
+
+    def attach(self, btid, addr):
+        self.attached.append((btid, addr))
+
+    def detach(self, btid):
+        self.detached.append(btid)
+
+
+class _StubLauncher:
+    def __init__(self):
+        self.n = 1
+
+    def active_count(self):
+        return self.n
+
+    def active_indices(self):
+        return list(range(self.n))
+
+    def poll_processes(self):
+        return [None] * self.n
+
+    def add_instance(self, extra_args=None):
+        i = self.n
+        self.n += 1
+        return i, {"DATA": f"tcp://127.0.0.1:9{i}00",
+                   "CTRL": f"tcp://127.0.0.1:9{i}01"}
+
+    def retire_instance(self, i, drain=True):
+        self.n -= 1
+        return {"DATA": f"tcp://127.0.0.1:9{i}00",
+                "CTRL": f"tcp://127.0.0.1:9{i}01"}
+
+
+class _StubConnector:
+    def __init__(self):
+        self.ops = []
+
+    def connect(self, addr):
+        self.ops.append(("connect", addr))
+
+    def disconnect(self, addr):
+        self.ops.append(("disconnect", addr))
+
+
+class _StubLineage:
+    def register(self, btid):
+        pass
+
+    def retire(self, btid):
+        pass
+
+
+def test_controller_attaches_scenario_before_data_connect():
+    from blendjax.fleet import FleetController, FleetPolicy
+
+    svc = _StubScenarioService()
+    conn = _StubConnector()
+    ctrl = FleetController(
+        _StubLauncher(), connector=conn,
+        policy=FleetPolicy(min_instances=1, max_instances=3, up_after=1,
+                           cooldown_s=0.0),
+        scenario_service=svc, lineage=_StubLineage(),
+    )
+    d = ctrl.tick(verdict="producer-bound", now=100.0)
+    assert d["action"] == "scale_up"
+    assert svc.attached == [(1, "tcp://127.0.0.1:9101")]
+    # scenario BEFORE data: the newcomer held the space before ingest
+    # could count one of its frames
+    assert conn.ops == [("connect", "tcp://127.0.0.1:9100")]
+    # scale down detaches the duplex channel at retire time
+    d = ctrl.tick(verdict="step-bound", now=200.0)
+    d = ctrl.tick(verdict="step-bound", now=300.0)
+    d = ctrl.tick(verdict="step-bound", now=400.0)
+    d = ctrl.tick(verdict="step-bound", now=500.0)
+    assert d["action"] == "scale_down"
+    assert svc.detached == [1]
+
+
+def test_controller_remote_admission_attaches_ctrl_addr():
+    from blendjax.fleet import FleetController
+
+    svc = _StubScenarioService()
+    conn = _StubConnector()
+    ctrl = FleetController(
+        _StubLauncher(), connector=conn, scenario_service=svc,
+        lineage=_StubLineage(),
+    )
+    r = ctrl.admit_remote(
+        "box-1", "tcp://10.0.0.5:5555",
+        telemetry={"ctrl_addr": "tcp://10.0.0.5:5556"},
+    )
+    assert r["ok"]
+    assert svc.attached == [("box-1", "tcp://10.0.0.5:5556")]
+    ctrl.retire_remote("box-1", now=0.0)
+    assert svc.detached == ["box-1"]
+
+
+@pytest.mark.slow
+def test_mid_run_scale_up_newcomer_holds_current_version():
+    """The satellite contract: a mid-run scale-up's newcomer receives
+    the CURRENT space version before its first frame is counted."""
+    from blendjax.data import RemoteStream
+    from blendjax.fleet import FleetController, FleetPolicy, synthetic_fleet
+
+    sp = ScenarioSpace.parse("a:half_extent=u(0.8,1.2) / b:xy_jitter=4")
+    sp.bump()  # current version is 2, not the default 1
+    svc = ScenarioService(sp)
+    try:
+        with synthetic_fleet(
+            1, shape=(32, 32), batch=4, rate=40, scenario=True,
+            bind_grace_s=0.5,
+        ) as launcher:
+            svc.attach(0, launcher.addresses["CTRL"][0])
+            assert svc.wait_acked(timeout=15), svc.state()
+            stream = RemoteStream(
+                list(launcher.addresses["DATA"]), timeoutms=20_000,
+                copy_arrays=True,
+            )
+            it = iter(stream)
+            assert next(it)[SCENARIO_KEY]["ver"] == 2
+            ctrl = FleetController(
+                launcher, connector=stream,
+                policy=FleetPolicy(min_instances=1, max_instances=2,
+                                   up_after=1, cooldown_s=0.0),
+                scenario_service=svc, respawn_dead=False,
+            )
+            d = ctrl.tick(verdict="producer-bound")
+            assert d["action"] == "scale_up"
+            assert svc.wait_acked(version=2, timeout=15), svc.state()
+            # EVERY frame the newcomer publishes carries the current
+            # version (it held publishing until the space arrived)
+            deadline = time.monotonic() + 20
+            saw_newcomer = False
+            while not saw_newcomer and time.monotonic() < deadline:
+                msg = next(it)
+                assert msg[SCENARIO_KEY]["ver"] == 2, msg[SCENARIO_KEY]
+                if msg.get("btid") == 1:
+                    saw_newcomer = True
+            assert saw_newcomer
+    finally:
+        svc.stop()
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: synthetic fleet, exact per-scenario histograms
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_e2e_synthetic_fleet_exact_per_scenario_histograms(monkeypatch):
+    import blendjax.data.echo as echo_mod
+    from blendjax.data import EchoingPipeline, StreamDataPipeline
+    from blendjax.fleet import synthetic_fleet
+
+    led = ScenarioAccounting()
+    monkeypatch.setattr(echo_mod, "scenario_accounting", led)
+    sp = ScenarioSpace.parse(
+        "easy:half_extent=u(0.8,1.2) / hard:xy_jitter=g(6,0.5)"
+    )
+    svc = ScenarioService(sp)
+    try:
+        with synthetic_fleet(
+            2, shape=(32, 32), batch=4, rate=60, scenario=True,
+            bind_grace_s=0.5,
+        ) as launcher:
+            for i, addr in enumerate(launcher.addresses["CTRL"]):
+                svc.attach(i, addr)
+            assert svc.wait_acked(timeout=15), svc.state()
+            led.declare(sp)
+            pipe = StreamDataPipeline(
+                launcher.addresses["DATA"], batch_size=8,
+                timeoutms=30_000,
+            )
+            echo = EchoingPipeline(
+                pipe, capacity=64, max_echo_factor=4, augment=None
+            )
+            steps = 0
+            with echo:
+                for b in echo:
+                    led.observe_loss(
+                        b[SCENARIO_ROWS_KEY], 0.5 + 0.01 * steps
+                    )
+                    steps += 1
+                    if steps >= 25:
+                        break
+            totals = led.totals()
+            assert set(totals) == {"easy", "hard"}
+            assert sum(f + e for f, e in totals.values()) == steps * 8
+            rep = led.report()
+            for sid in ("easy", "hard"):
+                s = rep["scenarios"][sid]
+                # loss histogram count == rows scored, exactly
+                assert s["loss"]["count"] == s["rows"]
+                assert s["declared"]
+                assert set(s["versions"]) == {1}
+    finally:
+        svc.stop()

@@ -229,3 +229,29 @@ def test_torch_adapter_decodes_pal_streams_host_side():
         np.testing.assert_array_equal(
             it["image"], local[int(it["frameid"])]
         )
+
+
+def test_scenario_stamp_tolerated_by_collate():
+    """A ``_scenario``-stamped stream (blendjax.scenario) collates
+    cleanly: the stamp is dropped like ``_trace`` — it is a dict
+    default_collate can't stack, and stamped/unstamped producers may
+    share one fan-in."""
+    from torch.utils.data import DataLoader
+
+    pub = DataPublisherSocket("tcp://127.0.0.1:*", btid=0)
+    ds = RemoteIterableDataset([pub.addr], max_items=8, timeoutms=10000)
+
+    def produce():
+        for i in range(8):
+            pub.publish(
+                image=np.full((8, 8), i, np.uint8), frameid=i,
+                _scenario={"id": "easy", "ver": 1},
+            )
+
+    t = threading.Thread(target=produce, daemon=True)
+    t.start()
+    batches = list(DataLoader(ds, batch_size=4, num_workers=0))
+    t.join(timeout=10)
+    assert len(batches) == 2
+    assert "_scenario" not in batches[0]
+    pub.close()
