@@ -127,6 +127,22 @@ SCENARIO_TIME_CAP_S = float(
 SCENARIO_MIN_STEPS = int(
     os.environ.get("BLENDJAX_BENCH_SCENARIO_MIN_STEPS", "40")
 )
+# Live kill-9/resume row (docs/checkpointing.md): a child process
+# trains a deterministic stream over a REAL publisher socket with
+# async checkpointing enabled; the parent SIGKILLs it after the first
+# COMMITTED snapshot, resumes in a fresh child (train state + session:
+# driver counters, lineage seq positions), and compares the full f32
+# loss vector against an uninterrupted run — the PR 8 equality trick
+# applied to time. CI asserts: trajectories identical, seq_gaps == 0
+# across the restart (the resumed publisher's fresh numbering reads as
+# a RESTART through the restored lineage, never a gap storm), and
+# dispatch_per_step == 1.0 with checkpointing enabled (ckpt.save_ms
+# lives on the writer thread, never inside a step dispatch). Pure
+# CPU/loopback — weather-independent. On failure the snapshot dirs are
+# kept (BLENDJAX_BENCH_RESUME_DIR) for artifact upload.
+LIVE_RESUME = os.environ.get("BLENDJAX_BENCH_LIVE_RESUME", "1") == "1"
+RESUME_STEPS = int(os.environ.get("BLENDJAX_BENCH_RESUME_STEPS", "16"))
+RESUME_DIR = os.environ.get("BLENDJAX_BENCH_RESUME_DIR", "")
 # Multi-chip live row (docs/performance.md "Going multi-chip"): the
 # SAME live pipeline (synthetic producers -> ShardedHostIngest ->
 # DeviceFeeder -> MeshTrainDriver) at mesh sizes 1/2/4/8 with a FIXED
@@ -1877,6 +1893,280 @@ def measure_live_scenario(time_cap: float | None = None,
     return row
 
 
+_RESUME_BATCH = 8
+_RESUME_HW = 16
+_RESUME_SEED = 11
+
+
+def _resume_messages(n: int, skip: int = 0):
+    """The deterministic message sequence both live_resume legs train
+    on: resuming regenerates it and skips the consumed prefix, exactly
+    like fast-forwarding a recorded stream."""
+    rng = np.random.default_rng(_RESUME_SEED)
+    for i in range(n):
+        msg = {
+            "_prebatched": True,
+            "image": rng.integers(
+                0, 255, (_RESUME_BATCH, _RESUME_HW, _RESUME_HW, 4),
+                np.uint8,
+            ),
+            "xy": (
+                rng.random((_RESUME_BATCH, 8, 2)) * _RESUME_HW
+            ).astype(np.float32),
+        }
+        if i >= skip:
+            yield msg
+
+
+def _live_resume_child_main() -> int:
+    """Child mode: train the deterministic stream over a REAL
+    publisher socket with checkpointing on; write losses + structural
+    evidence to --out. ``--resume`` restores train state + session
+    (driver counters, lineage positions) from the snapshot dir first.
+    The parent may SIGKILL this process at any time — everything a
+    resume sees is what the async writer COMMITTED."""
+    import argparse
+    import threading
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--live-resume-child", action="store_true")
+    ap.add_argument("directory")
+    ap.add_argument("--steps", type=int, default=16)
+    ap.add_argument("--ckpt-every", type=int, default=4)
+    ap.add_argument("--resume", action="store_true")
+    ap.add_argument("--pace", type=float, default=0.0)
+    ap.add_argument("--out", default=None)
+    args = ap.parse_args()
+
+    import jax  # noqa: F401  (backend init before any device work)
+
+    from blendjax.checkpoint import (
+        SnapshotManager,
+        collect_session,
+        restore_session,
+    )
+    from blendjax.data import StreamDataPipeline
+    from blendjax.models import CubeRegressor
+    from blendjax.obs.lineage import lineage
+    from blendjax.train import (
+        TrainDriver,
+        make_supervised_step,
+        make_train_state,
+    )
+    from blendjax.utils.metrics import metrics as reg
+
+    mgr = SnapshotManager(args.directory, keep=3)
+    state = make_train_state(
+        CubeRegressor(features=(8,)),
+        np.zeros((_RESUME_BATCH, _RESUME_HW, _RESUME_HW, 4), np.uint8),
+    )
+    start = 0
+    restored_driver = None
+    if args.resume:
+        restored = mgr.restore(state)
+        assert restored is not None, "resume requested, no snapshot"
+        state = restored.state
+        restored_driver = restored.session["driver"]
+        start = int(restored_driver["steps"])
+        # restored lineage seq positions: the fresh publisher below
+        # numbers from 0, which must read as a producer RESTART, not a
+        # gap storm (wire.seq_gaps stays 0 across the restart)
+        restore_session(restored.session, lineage=lineage)
+
+    drv = TrainDriver(
+        make_supervised_step(), state, inflight=2, sync_every=1,
+        checkpoint=mgr, checkpoint_every=args.ckpt_every,
+        session_state=lambda: collect_session(lineage=lineage),
+    )
+    if restored_driver is not None:
+        drv.load_state_dict(restored_driver)
+
+    addr_ready = threading.Event()
+    addr_box: list = []
+
+    def publish():
+        # socket created ON this thread (BJX104); fresh numbering from
+        # 0 every run — the restart the resumed lineage must absorb
+        from blendjax.transport.channels import DataPublisherSocket
+
+        # linger: the thread may finish publishing long before the
+        # consumer drains — close() must not drop queued messages
+        # (the default lingerms=0 would)
+        ch = DataPublisherSocket(
+            "tcp://127.0.0.1:*", btid=0, lingerms=30_000
+        )
+        addr_box.append(ch.addr)
+        addr_ready.set()
+        # a few margin messages past the step target: the pipeline's
+        # prefetch ring pulls ahead of the train loop, and a PUSH
+        # stream has no EOS — without margin the loop would block
+        # prefetching past the final trained batch. The driver breaks
+        # at --steps, so margin messages never train.
+        for msg in _resume_messages(args.steps + 4, skip=start):
+            ch.publish(**msg)
+            if args.pace:
+                time.sleep(args.pace)
+        ch.close()
+
+    pub = threading.Thread(target=publish, daemon=True)
+    pub.start()
+    assert addr_ready.wait(timeout=10), "publisher never bound"
+    with StreamDataPipeline(
+        [addr_box[0]], batch_size=_RESUME_BATCH, timeoutms=30_000,
+    ) as pipe:
+        for sb in pipe:
+            drv.submit(sb)
+            if drv.steps >= args.steps:
+                break
+    drv.finish()
+    mgr.wait()
+    mgr.close()
+    pub.join(timeout=10)
+    report = reg.report()
+    counters = report["counters"]
+    result = {
+        "losses": [float(v) for v in drv.losses],
+        "start": start,
+        "steps": drv.steps,
+        "checkpoints": drv.checkpoints,
+        "ckpt_saves": int(counters.get("ckpt.saves", 0)),
+        "ckpt_skipped": int(counters.get("ckpt.skipped", 0)),
+        "ckpt_save_p95_ms": round(
+            report["histograms"].get("ckpt.save_ms", {}).get("p95", 0.0),
+            3,
+        ),
+        "seq_gaps": int(counters.get("wire.seq_gaps", 0)),
+        "producer_restarts": int(
+            counters.get("wire.producer_restarts", 0)
+        ),
+        "dispatch_per_step": round(
+            report["spans"].get("train.dispatch", {}).get("count", 0)
+            / max(drv.steps - start, 1), 3,
+        ),
+    }
+    if args.out:
+        with open(args.out, "w", encoding="utf-8") as f:
+            json.dump(result, f)
+    print("live-resume-child done", json.dumps(
+        {k: result[k] for k in ("start", "steps", "seq_gaps")}
+    ))
+    return 0
+
+
+def measure_live_resume(steps: int | None = None) -> dict:
+    """Kill -9 / resume equality row (docs/checkpointing.md): an
+    uninterrupted reference run, a paced run SIGKILLed after its first
+    COMMITTED snapshot, and a resumed run continuing from that
+    snapshot — all child processes over real loopback sockets. The
+    headline is ``equality.identical``: the resumed f32 loss
+    trajectory equals the uninterrupted one element for element."""
+    import shutil
+    import signal
+    import subprocess
+    import tempfile
+
+    steps = RESUME_STEPS if steps is None else steps
+    base = RESUME_DIR or tempfile.mkdtemp(prefix="bjx-live-resume-")
+    os.makedirs(base, exist_ok=True)
+    ref_dir = os.path.join(base, "ref")
+    kill_dir = os.path.join(base, "kill")
+    for d in (ref_dir, kill_dir):
+        shutil.rmtree(d, ignore_errors=True)
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"  # loopback row: weather-independent
+
+    bench_path = os.path.abspath(__file__)
+
+    def child(extra, timeout=240.0):
+        proc = subprocess.run(
+            [sys.executable, bench_path, "--live-resume-child", *extra],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True, timeout=timeout,
+        )
+        assert proc.returncode == 0, proc.stdout[-2000:]
+        return proc.stdout
+
+    def load(path):
+        with open(path) as f:
+            return json.load(f)
+
+    ref_out = os.path.join(base, "ref.json")
+    child([ref_dir, "--steps", str(steps), "--ckpt-every", "4",
+           "--out", ref_out])
+    ref = load(ref_out)
+
+    # kill leg: paced so >= 1 snapshot commits well before the run ends
+    proc = subprocess.Popen(
+        [sys.executable, bench_path, "--live-resume-child", kill_dir,
+         "--steps", str(steps), "--ckpt-every", "4", "--pace", "0.4"],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True,
+    )
+    from blendjax.checkpoint import committed_steps
+
+    committed = False
+    deadline = time.monotonic() + 180
+    try:
+        while time.monotonic() < deadline:
+            if committed_steps(kill_dir):
+                committed = True
+                break
+            if proc.poll() is not None:
+                break  # child died pre-commit: don't burn the deadline
+            time.sleep(0.05)
+    finally:
+        if proc.poll() is None:
+            os.kill(proc.pid, signal.SIGKILL)
+    kill_out, _ = proc.communicate(timeout=60)
+    killed_mid_run = proc.returncode == -signal.SIGKILL
+
+    res_out = os.path.join(base, "res.json")
+    child([kill_dir, "--steps", str(steps), "--ckpt-every", "4",
+           "--resume", "--out", res_out])
+    res = load(res_out)
+
+    diffs = [
+        abs(a - b) for a, b in zip(ref["losses"], res["losses"])
+    ]
+    identical = (
+        len(ref["losses"]) == len(res["losses"]) == steps
+        and ref["losses"] == res["losses"]
+    )
+    row = {
+        "steps": steps,
+        "killed_mid_run": killed_mid_run,
+        "committed_before_kill": committed,
+        "resumed_at": res["start"],
+        "equality": {
+            "identical": identical,
+            "compared": len(diffs),
+            "max_abs_diff": max(diffs, default=float("inf")),
+        },
+        # every leg ran with checkpointing enabled: the contract is
+        # exactly one train dispatch per step anyway (ckpt.save_ms
+        # lives on the writer thread)
+        "dispatch_per_step": max(
+            ref["dispatch_per_step"], res["dispatch_per_step"]
+        ),
+        "seq_gaps": ref["seq_gaps"] + res["seq_gaps"],
+        "restart_detected": res["producer_restarts"] >= 1,
+        "ckpt": {
+            "saves": ref["ckpt_saves"] + res["ckpt_saves"],
+            "skipped": ref["ckpt_skipped"] + res["ckpt_skipped"],
+            "save_p95_ms": ref["ckpt_save_p95_ms"],
+        },
+        "value": 1.0 if identical else 0.0,
+    }
+    if identical:
+        shutil.rmtree(base, ignore_errors=True)
+    else:
+        # keep the evidence: CI uploads the snapshot dir on failure
+        # (BLENDJAX_BENCH_RESUME_DIR points it into the workspace)
+        row["snapshot_dir"] = base
+        row["kill_leg_tail"] = (kill_out or "")[-500:]
+    return row
+
+
 def _multichip_live_legs(mesh_sizes=None, time_cap: float | None = None,
                          b_dev: int = 2, shape=(16, 16)) -> dict:
     """The in-process body of the ``multichip_live`` row: the live
@@ -2490,6 +2780,16 @@ def _build_record(progress: dict) -> dict:
             detail["live_scenario"] = measure_live_scenario()
         except Exception as e:  # pragma: no cover - spawn flake path
             detail["live_scenario"] = {"error": repr(e)[:200]}
+    if LIVE_RESUME:
+        # Kill -9 / resume equality row (docs/checkpointing.md): child
+        # processes over loopback sockets, pure CPU — weather-
+        # independent like the fleet row. CI asserts the resumed f32
+        # trajectory is identical, seq_gaps == 0 across the restart,
+        # and dispatch_per_step == 1.0 with checkpointing enabled.
+        try:
+            detail["live_resume"] = measure_live_resume()
+        except Exception as e:  # pragma: no cover - spawn flake path
+            detail["live_resume"] = {"error": repr(e)[:200]}
     if MULTICHIP_LIVE:
         # Multi-chip live row (docs/performance.md "Going multi-chip"):
         # the live pipeline at mesh sizes 1/2/4/8 on a forced 8-device
@@ -2655,4 +2955,6 @@ def main() -> None:
 if __name__ == "__main__":
     if "--multichip-live" in sys.argv:
         sys.exit(_multichip_live_main())
+    if "--live-resume-child" in sys.argv:
+        sys.exit(_live_resume_child_main())
     sys.exit(main())

@@ -1,0 +1,43 @@
+"""blendjax.checkpoint — survive anything (docs/checkpointing.md).
+
+The robustness layer the elastic producer fleet (PR 7) never had a
+consumer-side twin for: async per-shard snapshots of the sharded train
+state, a versioned pickle-free session store for the host-side run
+state (echo reservoir accounting, scenario space + curriculum
+evidence, lineage positions, fleet membership), elastic resume onto a
+different mesh size, and preemption wiring (SIGTERM drain-and-
+snapshot; the watchdog's checkpoint-on-breach arm).
+
+The orbax-backed :class:`blendjax.train.CheckpointManager` remains as
+an optional thin wrapper for orbax-format interop; this package is
+self-contained (numpy + msgpack, both core dependencies).
+"""
+
+from blendjax.checkpoint.format import pack_session, unpack_session
+from blendjax.checkpoint.preempt import (
+    PreemptionGuard,
+    PreemptionRequested,
+)
+from blendjax.checkpoint.session import (
+    SESSION_VERSION,
+    collect_session,
+    restore_session,
+)
+from blendjax.checkpoint.snapshot import (
+    Restored,
+    SnapshotManager,
+    committed_steps,
+)
+
+__all__ = [
+    "SESSION_VERSION",
+    "PreemptionGuard",
+    "PreemptionRequested",
+    "Restored",
+    "SnapshotManager",
+    "collect_session",
+    "committed_steps",
+    "pack_session",
+    "restore_session",
+    "unpack_session",
+]

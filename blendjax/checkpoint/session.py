@@ -1,0 +1,91 @@
+"""Session-state assembly: one versioned dict over every run component.
+
+The train state (params + optimizer moments) is the easy half of a
+resumable run; the hard half is the HOST-side state the consumer stack
+accumulates — the echo reservoir's slot accounting and RNG fold
+counters, the scenario space/ledger/curriculum evidence, per-producer
+lineage positions, the fleet membership. Each component exposes the
+torch-style pair ``state_dict() -> dict`` / ``load_state_dict(dict)``
+(pickle-free values only — the snapshot codec refuses anything else),
+and this module composes them:
+
+>>> session = collect_session(
+...     echo=echo, scenario=accounting, curriculum=curriculum,
+...     lineage=lineage, fleet=controller,
+... )
+>>> mgr.save_async(step, state, session=session)
+... # later, in a fresh process:
+>>> restored = mgr.restore(template)
+>>> restore_session(restored.session, echo=echo, scenario=accounting,
+...                 curriculum=curriculum, lineage=lineage,
+...                 fleet=controller)
+
+The determinism contract (docs/checkpointing.md): a component's
+``load_state_dict`` must leave it *bitwise-continuable* — the resumed
+echo pipeline draws the same slots with the same augmentation keys the
+uninterrupted run would have, the curriculum resumes the same evidence
+windows, lineage reads the producers' fresh numbering as restarts (not
+drop storms). ``tests/test_checkpoint.py`` pins each of those.
+"""
+
+from __future__ import annotations
+
+import time
+
+#: Bumped when the session layout changes incompatibly; ``restore_session``
+#: refuses documents newer than the running build understands.
+SESSION_VERSION = 1
+
+_META_KEYS = ("_version", "_wall_time")
+
+
+def collect_session(**components) -> dict:
+    """One session dict from named components: each contributes its
+    ``state_dict()`` under its keyword name (``None`` components are
+    skipped; a plain dict passes through as-is, for caller-owned state
+    like a replay stream's consumed-batch position)."""
+    out: dict = {
+        "_version": SESSION_VERSION,
+        "_wall_time": time.time(),
+    }
+    for name, comp in components.items():
+        if comp is None:
+            continue
+        if isinstance(comp, dict):
+            out[name] = comp
+        else:
+            out[name] = comp.state_dict()
+    return out
+
+
+def restore_session(session: dict, strict: bool = False,
+                    **components) -> list:
+    """Load each named component's slice of ``session``; returns the
+    names actually restored. Components without a saved slice are left
+    untouched (``strict=True`` raises instead — for resume paths that
+    must not silently run with half a session)."""
+    version = int(session.get("_version", 0))
+    if version > SESSION_VERSION:
+        raise ValueError(
+            f"session snapshot is version {version}; this build reads "
+            f"<= {SESSION_VERSION} — resume with a newer blendjax"
+        )
+    restored = []
+    missing = []
+    for name, comp in components.items():
+        if comp is None:
+            continue
+        if name not in session:
+            missing.append(name)
+            continue
+        comp.load_state_dict(session[name])
+        restored.append(name)
+    if strict and missing:
+        raise ValueError(
+            f"session snapshot has no state for {missing} (present: "
+            f"{sorted(k for k in session if k not in _META_KEYS)})"
+        )
+    return restored
+
+
+__all__ = ["SESSION_VERSION", "collect_session", "restore_session"]

@@ -137,7 +137,7 @@ class SampleReservoir:
 
     # -- lazy jit construction ----------------------------------------------
 
-    def _build(self, fields: dict) -> None:
+    def _build(self, fields: dict, initial: dict | None = None) -> None:
         jax = _require_jax()
         import jax.numpy as jnp
 
@@ -145,15 +145,28 @@ class SampleReservoir:
             k: (tuple(v.shape[1:]), np.dtype(v.dtype))
             for k, v in fields.items()
         }
-        self._buffers = {
-            k: jnp.zeros((self.capacity, *shape), dtype)
-            for k, (shape, dtype) in self._spec.items()
-        }
-        if self.sharding is not None:
-            # One placement for the whole ring pytree: the storage is
-            # born sharded, so the donated scatter below reuses the
-            # sharded buffers in place forever after.
-            self._buffers = jax.device_put(self._buffers, self.sharding)
+        if initial is None:
+            self._buffers = {
+                k: jnp.zeros((self.capacity, *shape), dtype)
+                for k, (shape, dtype) in self._spec.items()
+            }
+            if self.sharding is not None:
+                # One placement for the whole ring pytree: the storage
+                # is born sharded, so the donated scatter below reuses
+                # the sharded buffers in place forever after.
+                self._buffers = jax.device_put(
+                    self._buffers, self.sharding
+                )
+        elif self.sharding is not None:
+            # restore path: place the snapshot's ring DIRECTLY — going
+            # through the zeros allocation first would transiently
+            # double the (potentially multi-GB) ring on device, and a
+            # run that trained fine could OOM exactly at resume
+            self._buffers = jax.device_put(dict(initial), self.sharding)
+        else:
+            self._buffers = {
+                k: jnp.asarray(v) for k, v in initial.items()
+            }
         capacity = self.capacity
 
         def _insert(bufs, batch, cursor):
@@ -317,6 +330,50 @@ class SampleReservoir:
     @property
     def fields(self) -> tuple:
         return tuple(self._spec) if self._spec else ()
+
+    # -- session snapshot (blendjax.checkpoint) -------------------------------
+
+    def state_dict(self) -> dict:
+        """Snapshot the ring + host counters. The buffers ride by
+        DEVICE reference — the SnapshotManager clones them on enqueue
+        and materializes on its writer thread, so taking a reservoir
+        snapshot costs the draw loop nothing (the BJX108 discipline
+        extends to checkpointing)."""
+        d = {
+            "capacity": self.capacity,
+            "cursor": self._cursor,
+            "size": self.size,
+            "inserts": self.inserts,
+            "draws": self._draws,
+            "built": self._buffers is not None,
+        }
+        if self._buffers is not None:
+            d["buffers"] = dict(self._buffers)
+        return d
+
+    def load_state_dict(self, d: dict) -> None:
+        """Rebuild the ring from a snapshot under the CURRENT sharding
+        (an 8-chip snapshot restores onto a 4-chip ring by plain
+        re-placement — the session store holds global host arrays).
+        Restoring ``draws`` is what makes resumed augmentation
+        *bitwise-continuable*: the next draw folds the same counter
+        into the same construction rng the uninterrupted run would
+        have."""
+        if int(d["capacity"]) != self.capacity:
+            raise ValueError(
+                f"snapshot reservoir capacity {d['capacity']} != "
+                f"configured {self.capacity}"
+            )
+        self._cursor = int(d["cursor"])
+        self.size = int(d["size"])
+        self.inserts = int(d["inserts"])
+        self._draws = int(d["draws"])
+        if not d.get("built"):
+            return
+        bufs = {k: np.asarray(v) for k, v in d["buffers"].items()}
+        # spec + jits from the ring's own shapes; the restored ring is
+        # placed directly (no throwaway zeros allocation)
+        self._build(bufs, initial=bufs)
 
 
 class EchoingPipeline:
@@ -829,6 +886,65 @@ class EchoingPipeline:
             "warm-started reservoir with %d samples from %r",
             int(self._filled.sum()), self.warm_start,
         )
+
+    # -- session snapshot (blendjax.checkpoint) -------------------------------
+
+    def state_dict(self) -> dict:
+        """Everything a resumed echo pipeline needs to be bitwise-
+        continuable: the reservoir (ring + draw counter), the per-slot
+        budget/age/scenario sidecars, the host RNG's bit-generator
+        state (so draw composition replays exactly), and the lifetime
+        counters. Insert times are stored as AGES — monotonic clocks
+        don't survive a process boundary. Parked sampled traces are
+        deliberately not persisted: a frame trace is transport
+        evidence and dies with its process."""
+        now = time.monotonic()
+        return {
+            "reservoir": self.reservoir.state_dict(),
+            # COPIES, not references: the snapshot writer serializes on
+            # its own thread while this thread keeps mutating the slot
+            # accounting — a by-reference array would mix post-snapshot
+            # use counts with snapshot-time ring/RNG state and break
+            # the bitwise-continuable resume contract
+            "use": self._use.copy(),
+            "filled": self._filled.copy(),
+            "age_s": now - self._t_insert,
+            "slot_scen": list(self._slot_scen),
+            "scen_active": self._scen_active,
+            "rng": self._np_rng.bit_generator.state,
+            "batch_size": self.batch_size,
+            "steps": self.steps,
+            "fresh": self.fresh,
+            "echoed": self.echoed,
+            "inserted": self.inserted,
+            "saturated_waits": self.saturated_waits,
+        }
+
+    def load_state_dict(self, d: dict) -> None:
+        """Restore BEFORE iteration starts (the drain thread hasn't
+        touched the reservoir yet); raises once iterating. Instance
+        counters resume; the process-local metrics registry starts its
+        own window (echo.* gauges read post-resume counters — see
+        docs/checkpointing.md)."""
+        if self._thread is not None:
+            raise RuntimeError(
+                "load_state_dict must run before iteration starts"
+            )
+        self.reservoir.load_state_dict(d["reservoir"])
+        self._use = np.asarray(d["use"], np.int64).copy()
+        self._filled = np.asarray(d["filled"], bool).copy()
+        now = time.monotonic()
+        self._t_insert = now - np.asarray(d["age_s"], np.float64)
+        self._slot_scen = list(d.get("slot_scen") or [None] * self.capacity)
+        self._scen_active = bool(d.get("scen_active", False))
+        self._np_rng.bit_generator.state = d["rng"]
+        if d.get("batch_size"):
+            self.batch_size = int(d["batch_size"])
+        self.steps = int(d.get("steps", 0))
+        self.fresh = int(d.get("fresh", 0))
+        self.echoed = int(d.get("echoed", 0))
+        self.inserted = int(d.get("inserted", 0))
+        self.saturated_waits = int(d.get("saturated_waits", 0))
 
     # -- lifecycle / observability --------------------------------------------
 

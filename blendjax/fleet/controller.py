@@ -462,6 +462,47 @@ class FleetController:
                 "events": list(self.events),
             }
 
+    # -- session snapshot (blendjax.checkpoint) -------------------------------
+
+    def state_dict(self) -> dict:
+        """Fleet membership for the session store: the launched
+        instance count and every admitted remote member. Streaks,
+        cooldowns, and the event log are deliberately transient — a
+        resumed controller re-earns its scaling evidence from fresh
+        verdicts instead of acting on a dead run's momentum."""
+        with self._lock:
+            return {
+                "launched": self.launcher.active_count(),
+                "remote": dict(self.remote),
+            }
+
+    def load_state_dict(self, d: dict) -> None:
+        """Reconnect the fleet a snapshot describes: grow the local
+        launcher back to the saved instance count (never shrink — a
+        snapshot must not SIGTERM producers that outlived the
+        consumer) and re-admit every saved remote member
+        (``admit_remote`` is idempotent; a remote that died while the
+        consumer was down simply never sends a frame and the doctor/
+        lineage surface it like any silent producer)."""
+        with self._lock:
+            target = min(
+                int(d.get("launched", 0)), self.policy.max_instances
+            )
+            grow = target - self.launcher.active_count()
+            if grow > 0:
+                self._scale_up(grow, kind="resume")
+            for btid, addr in (d.get("remote") or {}).items():
+                result = self.admit_remote(btid, addr)
+                if not result.get("ok"):
+                    # a saved member that can't re-admit must not
+                    # vanish silently: name it, so a smaller resumed
+                    # fleet has evidence in the log
+                    logger.warning(
+                        "resume: remote member %r (%s) not re-admitted:"
+                        " %s", btid, addr, result.get("error"),
+                    )
+            self._gauge_instances()
+
     def scale_events(self) -> list:
         with self._lock:
             return [

@@ -258,6 +258,46 @@ class FrameLineage:
         with self._lock:
             self._producers.clear()
 
+    # -- session snapshot (blendjax.checkpoint) -------------------------------
+
+    def state_dict(self) -> dict:
+        """Per-producer seq positions + exact counters for the session
+        store. Staleness histograms are deliberately dropped: they
+        describe the dead process's transport window, and stale
+        percentiles would poison the resumed doctor's wire/producer
+        discrimination. Keys keep their native type (btids are ints on
+        the wire; msgpack carries them)."""
+        with self._lock:
+            return {
+                btid: {
+                    "received": p.received,
+                    "last_seq": p.last_seq,
+                    "gaps": p.gaps,
+                    "reorders": p.reorders,
+                    "restarts": p.restarts,
+                }
+                for btid, p in self._producers.items()
+            }
+
+    def load_state_dict(self, d: dict) -> None:
+        """Restore seq positions so cross-restart accounting stays
+        exact: a producer that kept publishing while the consumer was
+        down resumes gap tracking from its last counted seq, and a
+        producer that restarted alongside the consumer (fresh
+        numbering from 0) is detected as a RESTART by the existing
+        seq==0 arm — never as a gap storm."""
+        with self._lock:
+            for btid, e in d.items():
+                p = self._producers.get(btid)
+                if p is None:
+                    p = self._producers[btid] = _Producer()
+                p.received = int(e.get("received", 0))
+                seq = e.get("last_seq")
+                p.last_seq = int(seq) if seq is not None else None
+                p.gaps = int(e.get("gaps", 0))
+                p.reorders = int(e.get("reorders", 0))
+                p.restarts = int(e.get("restarts", 0))
+
 
 # Default process-wide tracker (mirrors ``blendjax.utils.metrics.metrics``).
 lineage = FrameLineage()

@@ -73,6 +73,7 @@ class StatsReporter:
         jsonl_rotate_bytes: int | None = DEFAULT_ROTATE_BYTES,
         jsonl_keep: int = 3,
         fleet=None,
+        checkpoint_on_breach=None,
     ):
         self.interval_s = float(interval_s)
         self.registry = registry
@@ -107,8 +108,13 @@ class StatsReporter:
         if flight_dir:
             from blendjax.obs.watchdog import FlightRecorder
 
+            # checkpoint_on_breach: zero-arg callable fired inside the
+            # breach bundle dump — wire ``driver.request_checkpoint``
+            # so a breached run snapshots at its next step boundary
+            # (docs/checkpointing.md "Checkpoint on breach").
             self.flight = FlightRecorder(
-                flight_dir, profile_s=flight_profile_s
+                flight_dir, profile_s=flight_profile_s,
+                checkpoint=checkpoint_on_breach,
             )
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None

@@ -264,11 +264,19 @@ class FlightRecorder:
     """
 
     def __init__(self, directory: str, max_bundles: int = 4,
-                 profile_s: float = 0.0, keep_traces: int = 64):
+                 profile_s: float = 0.0, keep_traces: int = 64,
+                 checkpoint=None):
         self.directory = directory
         self.max_bundles = max(1, int(max_bundles))
         self.profile_s = float(profile_s)
         self.keep_traces = int(keep_traces)
+        # Checkpoint-on-breach arm (docs/checkpointing.md): a zero-arg
+        # callable — typically ``driver.request_checkpoint``, which
+        # flags the TRAIN thread to snapshot at its next step boundary
+        # (the recorder must never serialize device state from the
+        # reporter thread itself). Its invocation + return value are
+        # recorded in the bundle's checkpoint.json.
+        self.checkpoint = checkpoint
         self._lock = threading.Lock()
         os.makedirs(directory, exist_ok=True)
         # Resume numbering after existing bundles: a restarted run must
@@ -333,6 +341,19 @@ class FlightRecorder:
             "report": frame_tracer.report(),
             "records": frame_tracer.records()[-self.keep_traces:],
         }))
+        if self.checkpoint is not None:
+            def _ckpt_arm(p):
+                result = self.checkpoint()
+                with open(p, "w", encoding="utf-8") as f:
+                    json.dump(
+                        {
+                            "t": time.time(),
+                            "requested": True,
+                            "result": result,
+                        },
+                        f, default=str, indent=2,
+                    )
+            _write("checkpoint.json", _ckpt_arm)
         if self.profile_s > 0:
             t = threading.Thread(
                 target=self._profile,

@@ -347,6 +347,61 @@ class ScenarioAccounting:
             declared = self._declared
             self._sc = {sid: _ScenarioStats() for sid in declared}
 
+    # -- session snapshot (blendjax.checkpoint) -------------------------------
+
+    def state_dict(self) -> dict:
+        """The full ledger for the session store: per-scenario exact
+        counts, per-version attribution, the curriculum's evidence
+        window (win_loss_sum/win_rows) and bounded theta ring, and the
+        exact loss histograms — so a resumed curriculum update sees
+        the same evidence the uninterrupted run would have."""
+        with self._lock:
+            return {
+                "space_version": self.space_version,
+                "declared": sorted(self._declared),
+                "scenarios": {
+                    sid: {
+                        "rows": st.rows,
+                        "fresh": st.fresh,
+                        "echoed": st.echoed,
+                        "win_loss_sum": st.win_loss_sum,
+                        "win_rows": st.win_rows,
+                        "theta": [
+                            [list(t), float(l)] for t, l in st.theta
+                        ],
+                        "versions": {
+                            int(k): int(v) for k, v in st.versions.items()
+                        },
+                        "loss": st.loss.state_dict(),
+                    }
+                    for sid, st in self._sc.items()
+                },
+            }
+
+    def load_state_dict(self, d: dict) -> None:
+        with self._lock:
+            self._declared = {str(s) for s in d.get("declared", [])}
+            self.space_version = int(d.get("space_version", 0))
+            self._sc = {}
+            for sid, e in d.get("scenarios", {}).items():
+                st = _ScenarioStats()
+                st.rows = int(e["rows"])
+                st.fresh = int(e["fresh"])
+                st.echoed = int(e["echoed"])
+                st.win_loss_sum = float(e["win_loss_sum"])
+                st.win_rows = int(e["win_rows"])
+                st.theta.extend(
+                    (list(t), float(l)) for t, l in e.get("theta", [])
+                )
+                st.versions = {
+                    int(k): int(v)
+                    for k, v in e.get("versions", {}).items()
+                }
+                if "loss" in e:
+                    st.loss.load_state_dict(e["loss"])
+                self._sc[str(sid)] = st
+        metrics.gauge("scenario.space_version", self.space_version)
+
 
 def _batch_lead(batch: dict) -> int:
     meta = batch.get("_meta")

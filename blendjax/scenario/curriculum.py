@@ -94,6 +94,9 @@ class ScenarioCurriculum:
         self.updates = 0
         self._since = 0
         self._sim: dict = {}  # scenario name -> GaussianSimParams
+        # REINFORCE baselines restored from a session snapshot, applied
+        # when a scenario's GaussianSimParams is (re)built lazily.
+        self._restored_baselines: dict = {}
         self.ledger.declare(space)
         if service is not None and service.version < space.version:
             service.publish(space)
@@ -229,6 +232,11 @@ class ScenarioCurriculum:
                     learning_rate=self.param_lr,
                     baseline_decay=self.baseline_decay,
                 )
+                b0 = self._restored_baselines.pop(name, None)
+                if b0 is not None:
+                    # resume continuity: the running-mean baseline the
+                    # uninterrupted run would carry into this update
+                    sim.baseline = float(b0)
             else:
                 # the space is the source of truth between updates (a
                 # peer may have edited it); resync before stepping
@@ -251,6 +259,48 @@ class ScenarioCurriculum:
                 for (k, _), m, s in zip(gauss, new_mu, new_sigma)
             }
         return adapted
+
+    # -- session snapshot (blendjax.checkpoint) -------------------------------
+
+    def state_dict(self) -> dict:
+        """Session snapshot: the authoritative space (wire form —
+        already pickle-free and versioned), the update cadence
+        position, and the per-scenario REINFORCE baselines. The
+        evidence windows and theta rings live in the LEDGER's snapshot
+        (``ScenarioAccounting.state_dict``) — one owner per fact."""
+        return {
+            "updates": self.updates,
+            "since": self._since,
+            "space": self.space.to_wire(),
+            "baselines": {
+                name: float(sim.baseline)
+                for name, sim in self._sim.items()
+                if sim.baseline is not None
+            },
+        }
+
+    def load_state_dict(self, d: dict) -> None:
+        """Restore IN PLACE: the space object's scenarios/weights/
+        version are replaced on the existing instance, so the service,
+        ledger, and any producer-side references keep pointing at the
+        authoritative copy. When a service is attached the restored
+        space re-publishes immediately — producers that outlived the
+        consumer (remote fleet) adopt the resumed version on their
+        next poll."""
+        self.updates = int(d.get("updates", 0))
+        self._since = int(d.get("since", 0))
+        if "space" in d:
+            restored = type(self.space).from_wire(d["space"])
+            self.space.scenarios = restored.scenarios
+            self.space.version = restored.version
+            self.ledger.declare(self.space)
+            if self.service is not None:
+                self.service.publish(self.space)
+        self._sim = {}
+        self._restored_baselines = {
+            str(k): float(v)
+            for k, v in (d.get("baselines") or {}).items()
+        }
 
 
 __all__ = ["ScenarioCurriculum"]

@@ -15,6 +15,11 @@ from blendjax.train.steps import (
     make_train_state,
     make_supervised_step,
 )
+from blendjax.checkpoint import (
+    PreemptionGuard,
+    PreemptionRequested,
+    SnapshotManager,
+)
 from blendjax.train.checkpoint import CheckpointManager
 from blendjax.train.driver import TrainDriver
 from blendjax.train.mesh_driver import (
@@ -39,6 +44,9 @@ __all__ = [
     "make_fused_tile_step",
     "corner_loss",
     "CheckpointManager",
+    "SnapshotManager",
+    "PreemptionGuard",
+    "PreemptionRequested",
     "TrainDriver",
     "MeshTrainDriver",
     "make_mesh_echo_fused_step",

@@ -1,9 +1,13 @@
-"""Train-state checkpointing (orbax).
+"""Train-state checkpointing via orbax (optional extra).
 
-The reference has no model checkpointing (SURVEY.md §5 — its only
-persistence is the data-stream recorder, covered by
-``blendjax.data.replay``); this adds the standard orbax save/restore the
-train-loop layer needs, including sharded multi-host states.
+The real checkpoint subsystem is :mod:`blendjax.checkpoint`
+(docs/checkpointing.md): async sharded snapshots, the pickle-free
+session store, elastic resume, preemption wiring — self-contained on
+the core numpy+msgpack dependencies. This module remains as a thin
+wrapper for runs that want orbax's on-disk FORMAT (interop with
+orbax-based tooling, multi-host GCS writes); it needs the
+``orbax-checkpoint`` package, installed via the ``blendjax[orbax]``
+extra (or ``blendjax[tpu]``, which includes it).
 """
 
 from __future__ import annotations
@@ -17,7 +21,19 @@ class CheckpointManager:
     """Thin orbax wrapper: ``save(step, state)`` / ``restore(state)``."""
 
     def __init__(self, directory: str, max_to_keep: int = 3):
-        import orbax.checkpoint as ocp
+        try:
+            import orbax.checkpoint as ocp
+        except ImportError as e:
+            # Fail at CONSTRUCTION with a way forward, not mid-init
+            # with a bare ModuleNotFoundError three frames deep.
+            raise ImportError(
+                "orbax-checkpoint is not installed; the orbax-backed "
+                "CheckpointManager is an optional extra. Either "
+                "`pip install blendjax[orbax]` (or `[tpu]`, which "
+                "includes it), or use the dependency-free "
+                "blendjax.checkpoint.SnapshotManager — the subsystem "
+                "documented in docs/checkpointing.md."
+            ) from e
 
         self._ocp = ocp
         self.directory = os.path.abspath(directory)

@@ -25,6 +25,7 @@ from __future__ import annotations
 # outputs inside this module's dispatch loops)
 
 import collections
+import threading
 import time
 
 import numpy as np
@@ -82,7 +83,9 @@ class TrainDriver:
     def __init__(self, step, state, inflight: int = 4,
                  sync_every: int = 32, pad_partial: bool = True,
                  buckets=None, flops_per_image: float | None = None,
-                 peak_flops: float | None = None):
+                 peak_flops: float | None = None,
+                 checkpoint=None, checkpoint_every: int = 0,
+                 session_state=None):
         self.step = step
         self.state = state
         self.inflight = max(1, int(inflight))
@@ -93,6 +96,24 @@ class TrainDriver:
             float(flops_per_image) if flops_per_image else None
         )
         self.peak_flops = float(peak_flops) if peak_flops else None
+        # Checkpointing (blendjax.checkpoint, docs/checkpointing.md):
+        # every `checkpoint_every` steps — and whenever
+        # request_checkpoint() was called from any thread — submit()
+        # hands the freshly-retired state to the SnapshotManager at
+        # the step boundary. save_async clones the device leaves
+        # before returning, so the NEXT dispatch's donation can never
+        # invalidate a snapshot mid-write, and the serialization runs
+        # on the manager's own thread: ckpt.save_ms never lands
+        # inside a step dispatch.
+        self.checkpoint = checkpoint
+        self.checkpoint_every = max(0, int(checkpoint_every or 0))
+        self.session_state = session_state
+        self.checkpoints = 0
+        self._ckpt_request = threading.Event()
+        # A PreemptionGuard (blendjax.checkpoint.preempt) attaches
+        # itself here; submit() honors the flag at the next step
+        # boundary with a drain + synchronous snapshot.
+        self.preempt = None
         # ring entries: [loss, t_dispatch_mono, images, traces]
         self._pending: collections.deque = collections.deque()
         self.losses: list = []
@@ -215,6 +236,8 @@ class TrainDriver:
 
     def submit(self, batch) -> None:
         """Dispatch one step without waiting on its result."""
+        if self.preempt is not None and self.preempt.requested:
+            self._preempt_flush()
         if (
             self.pad_partial and batch.get("_partial")
             and "_mask" not in batch
@@ -263,6 +286,116 @@ class TrainDriver:
         metrics.gauge_max("train.inflight_hwm", len(pending))
         if self.sync_every and self.steps % self.sync_every == 0:
             self._sync_oldest()
+        if self.checkpoint is not None and (
+            self._ckpt_request.is_set()
+            or (
+                self.checkpoint_every
+                and self.steps % self.checkpoint_every == 0
+            )
+        ):
+            self._ckpt_request.clear()
+            self._dispatch_checkpoint()
+
+    # -- checkpointing ---------------------------------------------------------
+
+    def request_checkpoint(self) -> None:
+        """Thread-safe: snapshot at the NEXT step boundary (the SLO
+        watchdog's checkpoint-on-breach arm calls this from the
+        reporter thread — the save itself still happens at
+        retirement, never mid-flight)."""
+        self._ckpt_request.set()
+
+    def _dispatch_checkpoint(self) -> None:
+        """Hand the current state + session to the SnapshotManager.
+        Async by design: device leaves are cloned before this returns
+        (a handful of non-train dispatches), the d2h + file writes run
+        on the manager's writer thread."""
+        session = {}
+        if callable(self.session_state):
+            session = dict(self.session_state() or {})
+        session.setdefault("driver", self.state_dict())
+        self.checkpoint.save_async(
+            self.steps, self.state, session=session
+        )
+        self.checkpoints += 1
+
+    def _preempt_flush(self) -> None:
+        """The SIGTERM path: drain the ring (every in-flight dispatch
+        retires — donated buffers settle), snapshot the final state,
+        block until it commits, then raise for the run loop to exit.
+        See blendjax.checkpoint.preempt."""
+        from blendjax.checkpoint.preempt import PreemptionRequested
+
+        self.drain()
+        outcome = "no checkpoint manager attached"
+        if self.checkpoint is not None:
+            self._dispatch_checkpoint()
+            # The one sanctioned synchronous checkpoint wait on the hot
+            # path: the process is exiting on a preemption deadline —
+            # an un-flushed async write would race interpreter teardown.
+            # bjx: ignore[BJX114]
+            self.checkpoint.wait()
+            # the writer never raises into the train loop, so silence
+            # is not evidence: report what actually landed — a
+            # scheduler that believes a failed flush committed loses
+            # every step since the last cadence save
+            err = getattr(self.checkpoint, "last_error", None)
+            outcome = (
+                f"snapshot FAILED ({err!r}) — resuming from the last "
+                "committed step" if err is not None
+                else "snapshot committed"
+            )
+        metrics.count("ckpt.preemptions")
+        raise PreemptionRequested(
+            f"preemption honored at step {self.steps}: {outcome}"
+        )
+
+    def checkpoint_now(self, wait: bool = True) -> None:
+        """Synchronous out-of-band snapshot (teardown / eval
+        boundaries): drain the ring, snapshot, optionally block until
+        committed. NOT for the hot loop — cadence saves go through
+        ``checkpoint_every``/``request_checkpoint`` and stay async."""
+        if self.checkpoint is None:
+            raise RuntimeError("no checkpoint manager attached")
+        self.drain()
+        self._dispatch_checkpoint()
+        if wait:
+            # teardown flush, same justification as _preempt_flush
+            # bjx: ignore[BJX114]
+            self.checkpoint.wait()
+            err = getattr(self.checkpoint, "last_error", None)
+            if err is not None:
+                raise RuntimeError(
+                    f"checkpoint_now: snapshot write failed: {err!r}"
+                ) from err
+
+    #: Loss-history tail kept in the session snapshot: continuity only
+    #: needs the step counters (cadence alignment), so bounding the
+    #: tail keeps per-snapshot work and session size O(1) over a long
+    #: run instead of re-serializing an ever-growing list every save.
+    LOSS_TAIL = 4096
+
+    def state_dict(self) -> dict:
+        """Driver counters for the session snapshot: a resumed driver
+        continues the same step numbering, so sync/checkpoint cadence
+        and the augment key folds (keyed by ``state.step``) line up
+        with the uninterrupted run."""
+        tail = self.losses[-self.LOSS_TAIL:]
+        return {
+            "steps": self.steps,
+            "dispatches": self.dispatches,
+            "images_retired": self.images_retired,
+            "checkpoints": self.checkpoints,
+            "losses": [float(v) for v in tail],
+            "losses_total": len(self.losses),
+        }
+
+    def load_state_dict(self, d: dict) -> None:
+        self.steps = int(d["steps"])
+        self.dispatches = int(d.get("dispatches", d["steps"]))
+        self.images_retired = int(d.get("images_retired", 0))
+        self.checkpoints = int(d.get("checkpoints", 0))
+        self.losses = [float(v) for v in d.get("losses", [])]
 
     def drain(self):
         """Block until every dispatched step completed and return the
@@ -319,4 +452,5 @@ class TrainDriver:
             "host_blocks": self.host_blocks,
             "syncs": len(self.losses),
             "images_retired": self.images_retired,
+            "checkpoints": self.checkpoints,
         }

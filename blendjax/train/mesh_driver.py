@@ -246,7 +246,9 @@ class MeshTrainDriver(TrainDriver):
                  pad_partial: bool = True, buckets=None,
                  flops_per_image: float | None = None,
                  peak_flops_per_chip: float | None = None,
-                 peak_flops: float | None = None):
+                 peak_flops: float | None = None,
+                 checkpoint=None, checkpoint_every: int = 0,
+                 session_state=None):
         from blendjax.parallel.sharding import mesh_chip_count
 
         self.mesh = mesh
@@ -258,6 +260,8 @@ class MeshTrainDriver(TrainDriver):
             step, state, inflight=inflight, sync_every=sync_every,
             pad_partial=pad_partial, buckets=buckets,
             flops_per_image=flops_per_image, peak_flops=peak_flops,
+            checkpoint=checkpoint, checkpoint_every=checkpoint_every,
+            session_state=session_state,
         )
 
     @classmethod

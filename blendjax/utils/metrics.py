@@ -119,6 +119,31 @@ class Histogram:
             out["nonfinite"] = self.nonfinite
         return out
 
+    def state_dict(self) -> dict:
+        """Snapshot for the session store (blendjax.checkpoint):
+        exact counts + bucket map; min/max only when observed (±inf
+        sentinels don't belong in a wire document)."""
+        d = {
+            "count": self.count,
+            "sum": self.total,
+            "zeros": self.zeros,
+            "nonfinite": self.nonfinite,
+            "buckets": dict(self.buckets),
+        }
+        if self.count:
+            d["min"] = self.min
+            d["max"] = self.max
+        return d
+
+    def load_state_dict(self, d: dict) -> None:
+        self.count = int(d["count"])
+        self.total = float(d["sum"])
+        self.zeros = int(d.get("zeros", 0))
+        self.nonfinite = int(d.get("nonfinite", 0))
+        self.buckets = {int(k): int(v) for k, v in d["buckets"].items()}
+        self.min = float(d["min"]) if "min" in d else math.inf
+        self.max = float(d["max"]) if "max" in d else -math.inf
+
     def cumulative_buckets(self) -> list:
         """``(upper_bound, cumulative_count)`` pairs in ascending bound
         order — the Prometheus histogram exposition shape (the exporter

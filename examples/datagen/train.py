@@ -112,6 +112,27 @@ def main():
         "trace, and the breaching rule states (needs --slo)",
     )
     ap.add_argument(
+        "--checkpoint", default=None, metavar="DIR",
+        help="async sharded snapshots (blendjax.checkpoint, docs/"
+        "checkpointing.md): every --checkpoint-every steps the driver "
+        "hands the train state + session (driver counters, lineage "
+        "positions, echo/scenario state when active) to a background "
+        "writer — a kill -9 resumes from the last committed step. "
+        "SIGTERM drains the ring and snapshots before exit; with "
+        "--slo, a breach also requests a snapshot at the next step",
+    )
+    ap.add_argument(
+        "--checkpoint-every", type=int, default=50, metavar="STEPS",
+        help="snapshot cadence in train steps (0 = only the exit/"
+        "preemption snapshot)",
+    )
+    ap.add_argument(
+        "--resume", action="store_true",
+        help="restore the latest committed snapshot from --checkpoint "
+        "before training (elastic: the state re-places under THIS "
+        "run's mesh, so a job preempted on 8 chips resumes on fewer)",
+    )
+    ap.add_argument(
         "--fleet", default=None, metavar="MIN:MAX",
         help="elastic producer autoscaling (blendjax.fleet, docs/"
         "fleet.md): start MIN producers and let a FleetController "
@@ -193,6 +214,8 @@ def main():
         ap.error(
             "--curriculum reads the loss every step: drop --inflight"
         )
+    if args.resume and not args.checkpoint:
+        ap.error("--resume needs a --checkpoint directory")
 
     import jax
 
@@ -211,6 +234,21 @@ def main():
     # recorder, and/or a Chrome-trace of the pipeline spans — torn
     # down in the finally below.
     exporter = reporter = None
+    # Checkpoint plumbing shared across modes: ckpt_refs carries the
+    # live driver (for the breach arm) or the direct loop's breach
+    # flag; ckpt_session is the restored session, applied per
+    # component as each one is constructed.
+    ckpt_refs: dict = {}
+    scenario_ctx: dict = {}
+
+    def _ckpt_on_breach():
+        drv = ckpt_refs.get("driver")
+        if drv is not None:
+            drv.request_checkpoint()
+        else:
+            ckpt_refs["breach"] = True
+        return {"mode": "driver" if drv is not None else "direct"}
+
     if args.flight_dir and not args.slo:
         ap.error("--flight-dir needs at least one --slo rule to breach")
     if args.metrics_port is not None or args.slo:
@@ -218,6 +256,9 @@ def main():
 
         reporter = StatsReporter(
             interval_s=10.0, slos=args.slo, flight_dir=args.flight_dir,
+            checkpoint_on_breach=(
+                _ckpt_on_breach if args.checkpoint else None
+            ),
         ).start()
         if args.metrics_port is not None:
             # /healthz serves 200/503 from the reporter's SLO state —
@@ -242,6 +283,55 @@ def main():
     state = make_train_state(
         model, np.zeros((args.batch, h, w, 4), np.uint8), mesh=mesh
     )
+    ckpt_mgr = None
+    ckpt_session: dict = {}
+    if args.checkpoint:
+        from blendjax.checkpoint import SnapshotManager, restore_session
+        from blendjax.parallel.sharding import state_shardings
+
+        ckpt_mgr = SnapshotManager(args.checkpoint)
+        if args.resume:
+            restored = ckpt_mgr.restore(
+                state, shardings=state_shardings(state, mesh=mesh)
+            )
+            if restored is None:
+                print(f"no snapshot in {args.checkpoint}: starting fresh")
+            else:
+                state = restored.state
+                ckpt_session = restored.session
+                from blendjax.obs.lineage import lineage
+
+                restore_session(ckpt_session, lineage=lineage)
+                print(
+                    f"resumed from snapshot step {restored.step}"
+                    + (" (resharded onto this mesh)"
+                       if restored.resharded else "")
+                )
+
+    def _session_state() -> dict:
+        from blendjax.checkpoint import collect_session
+        from blendjax.obs.lineage import lineage
+
+        comps = {"lineage": lineage}
+        if "accounting" in scenario_ctx:
+            comps["scenario"] = scenario_ctx["accounting"]
+        if "curriculum" in scenario_ctx:
+            comps["curriculum"] = scenario_ctx["curriculum"]
+        if ckpt_refs.get("echo") is not None:
+            comps["echo"] = ckpt_refs["echo"]
+        if ckpt_refs.get("fleet") is not None:
+            comps["fleet"] = ckpt_refs["fleet"]
+        return collect_session(**comps)
+
+    def _direct_session(steps_done: int) -> dict:
+        # the direct loop has no TrainDriver to stamp its counters:
+        # record the step position itself, so a resumed run continues
+        # the same numbering (snapshot names, cadence) the driver
+        # mode gets for free
+        session = _session_state()
+        session["driver"] = {"steps": int(steps_done)}
+        return session
+
     augment = None
     if args.augment:
         # Label-safe augmentation only: the corner labels live in pixel
@@ -271,6 +361,9 @@ def main():
             driver = TrainDriver(
                 step, state, inflight=args.inflight,
                 sync_every=args.sync_every,
+                checkpoint=ckpt_mgr,
+                checkpoint_every=args.checkpoint_every,
+                session_state=_session_state,
             )
     elif use_fused:
         # Fused decode + async overlap: exactly one device dispatch per
@@ -282,6 +375,8 @@ def main():
         driver = TrainDriver(
             step, state, inflight=args.inflight,
             sync_every=args.sync_every,
+            checkpoint=ckpt_mgr, checkpoint_every=args.checkpoint_every,
+            session_state=_session_state,
         )
     elif chunk > 1:
         # K sequential updates per device call (see docs/performance.md);
@@ -305,23 +400,74 @@ def main():
         shp = batch["image"].shape
         return shp[0] * shp[1] if chunk > 1 or use_fused else shp[0]
 
+    if driver is not None:
+        ckpt_refs["driver"] = driver
+        if ckpt_session.get("driver"):
+            driver.load_state_dict(ckpt_session["driver"])
+    guard = None
+    if ckpt_mgr is not None:
+        from blendjax.checkpoint import PreemptionGuard
+
+        # SIGTERM -> drain + snapshot + clean exit (docs/
+        # checkpointing.md); with no driver the direct loop polls the
+        # flag itself
+        guard = PreemptionGuard(driver) if driver is not None else (
+            PreemptionGuard()
+        )
+
     def wrap_echo(pipe):
         if not echo_mode:
             return pipe
         from blendjax.data import EchoingPipeline
 
-        return EchoingPipeline(
+        echo = EchoingPipeline(
             pipe, capacity=args.echo_capacity,
             max_echo_factor=args.echo,
             warm_start=args.echo_warm_start,
             warm_start_allow_pickle=args.allow_pickle,
         )
-
-    scenario_ctx: dict = {}
+        ckpt_refs["echo"] = echo
+        if ckpt_session.get("echo"):
+            echo.load_state_dict(ckpt_session["echo"])
+            print("resumed echo reservoir "
+                  f"(fill={echo.stats['reservoir_fill']})")
+        return echo
 
     def run_steps(batches):
         nonlocal state
+        from blendjax.checkpoint import PreemptionRequested
+
         t0, n = time.perf_counter(), 0
+        preempted = False
+        start_step = (ckpt_session.get("driver") or {}).get("steps", 0)
+        try:
+            n = _run_steps_inner(batches, start_step)
+        except PreemptionRequested as e:
+            preempted = True
+            print(f"preempted cleanly: {e}")
+        if driver is not None and not preempted:
+            state, final = driver.finish()
+            if final is not None:  # None = zero batches submitted
+                print(f"final loss={final:.5f}  driver={driver.stats}")
+        if ckpt_mgr is not None and not preempted:
+            # exit snapshot: the run's last word (close() in the outer
+            # finally flushes it)
+            if driver is not None:
+                steps_done = driver.steps
+                session = _session_state()
+                session["driver"] = driver.state_dict()
+            else:
+                steps_done = start_step + ckpt_refs.get("steps", 0)
+                session = _direct_session(steps_done)
+            ckpt_mgr.save_async(steps_done, state, session)
+        dt = time.perf_counter() - t0
+        print(f"{n / dt:.1f} images/sec ({n} images in {dt:.1f}s)")
+
+    def _run_steps_inner(batches, start_step):
+        nonlocal state
+        from blendjax.checkpoint import PreemptionRequested
+
+        n = 0
         for i, batch in enumerate(batches):
             if i >= args.steps:
                 break
@@ -361,13 +507,34 @@ def main():
                     loss = metrics["loss"]
                     loss = loss[-1] if getattr(loss, "ndim", 0) else loss
                     print(f"step {i}: loss={float(loss):.5f}")
+                if ckpt_mgr is not None:
+                    # direct-loop twin of the driver cadence: async
+                    # snapshot every N steps, on breach request, and a
+                    # drain-free SIGTERM flush (no ring to drain here)
+                    ckpt_refs["steps"] = i + 1
+                    done = start_step + i + 1
+                    if (
+                        ckpt_refs.pop("breach", None)
+                        or (args.checkpoint_every
+                            and (i + 1) % args.checkpoint_every == 0)
+                    ):
+                        ckpt_mgr.save_async(
+                            done, state, _direct_session(done)
+                        )
+                    if guard is not None and guard.requested:
+                        ckpt_mgr.save_async(
+                            done, state, _direct_session(done)
+                        )
+                        ckpt_mgr.wait()
+                        err = ckpt_mgr.last_error
+                        raise PreemptionRequested(
+                            f"snapshot FAILED at step {done} "
+                            f"({err!r}) — resuming from the last "
+                            "committed step" if err is not None
+                            else f"snapshot committed at step {done}"
+                        )
             n += batch_count(batch)
-        if driver is not None:
-            state, final = driver.finish()
-            if final is not None:  # None = zero batches submitted
-                print(f"final loss={final:.5f}  driver={driver.stats}")
-        dt = time.perf_counter() - t0
-        print(f"{n / dt:.1f} images/sec ({n} images in {dt:.1f}s)")
+        return n
 
     del jax  # device work happens inside the pipeline/step
 
@@ -450,6 +617,15 @@ def main():
                         space, service=svc,
                         every_steps=args.curriculum_every,
                     )
+                if ckpt_session:
+                    from blendjax.checkpoint import restore_session
+
+                    # restored curriculum re-publishes its space (and
+                    # version) through the freshly-attached service
+                    restore_session(
+                        ckpt_session, scenario=accounting,
+                        curriculum=scenario_ctx.get("curriculum"),
+                    )
             ctrl = None
             if fleet_bounds:
                 from blendjax.fleet import FleetController, FleetPolicy
@@ -474,6 +650,11 @@ def main():
                     # address joins the fan-in
                     scenario_service=svc,
                 ).start()
+                ckpt_refs["fleet"] = ctrl
+                if ckpt_session.get("fleet"):
+                    # reconnect the snapshot's fleet: grow back to the
+                    # saved count, re-admit remote members
+                    ctrl.load_state_dict(ckpt_session["fleet"])
                 if reporter is not None:
                     # fleet state rides the JSONL archive per tick
                     reporter.fleet = ctrl
@@ -523,6 +704,14 @@ def main():
                 if svc is not None:
                     svc.stop()
     finally:
+        if guard is not None:
+            guard.uninstall()
+        if ckpt_mgr is not None:
+            ckpt_mgr.close()  # flushes the exit snapshot
+            print(
+                f"checkpoints in {args.checkpoint}: "
+                f"steps {ckpt_mgr.steps()}"
+            )
         if reporter is not None:
             reporter.stop()  # final tick logs the closing verdict
         if exporter is not None:

@@ -1,0 +1,129 @@
+"""Subprocess worker for the kill -9 resume-equality tests.
+
+Trains a tiny CubeRegressor on a DETERMINISTIC seeded stream through
+the real mesh pipeline (StreamDataPipeline -> MeshTrainDriver) with
+async checkpointing enabled, and writes its per-step f32 loss vector
+to ``--out`` at the end. The parent test runs it three ways:
+
+- uninterrupted (the reference trajectory),
+- to-be-killed (``--pace`` slows the loop so the parent can observe a
+  committed snapshot and SIGKILL mid-run),
+- ``--resume`` (restores the latest snapshot — onto ``--mesh``, which
+  may DIFFER from the snapshot's mesh: elastic resume — fast-forwards
+  the deterministic stream by the restored step count, and continues
+  to ``--steps``).
+
+Equality of the resumed and uninterrupted loss vectors is the
+acceptance contract: a restart is invisible to the training math.
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+B = 8
+HW = 16
+SEED = 7
+
+
+def _messages(n, skip=0):
+    """The same deterministic message sequence every call (the
+    recorded-stream stand-in): resuming = regenerating and skipping
+    the consumed prefix, exactly like fast-forwarding a replay."""
+    import numpy as np
+
+    rng = np.random.default_rng(SEED)
+    for i in range(n):
+        msg = {
+            "_prebatched": True,
+            "btid": 0,
+            "image": rng.integers(0, 255, (B, HW, HW, 4), np.uint8),
+            "xy": (rng.random((B, 8, 2)) * HW).astype(np.float32),
+        }
+        if i >= skip:
+            yield msg
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("directory")
+    ap.add_argument("--steps", type=int, default=10)
+    ap.add_argument("--mesh", type=int, default=1)
+    ap.add_argument("--ckpt-every", type=int, default=3)
+    ap.add_argument("--resume", action="store_true")
+    ap.add_argument("--pace", type=float, default=0.0)
+    ap.add_argument("--out", default=None)
+    args = ap.parse_args()
+
+    import jax
+    import numpy as np
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from blendjax.checkpoint import SnapshotManager
+    from blendjax.data import StreamDataPipeline
+    from blendjax.models import CubeRegressor
+    from blendjax.parallel import create_mesh
+    from blendjax.parallel.sharding import state_shardings
+    from blendjax.train import MeshTrainDriver, make_train_state
+    from blendjax.train.mesh_driver import make_mesh_supervised_step
+
+    mesh = create_mesh(
+        {"data": args.mesh}, devices=jax.devices()[: args.mesh]
+    )
+    model = CubeRegressor(features=(8,))
+    example = np.zeros((B, HW, HW, 4), np.uint8)
+    mgr = SnapshotManager(args.directory, keep=3)
+    state = make_train_state(model, example, mesh=mesh)
+    start = 0
+    restored_driver = None
+    if args.resume:
+        restored = mgr.restore(
+            state, shardings=state_shardings(state, mesh=mesh)
+        )
+        assert restored is not None, "resume requested but no snapshot"
+        state = restored.state
+        restored_driver = restored.session["driver"]
+        start = int(restored_driver["steps"])
+    step = make_mesh_supervised_step(state, mesh)
+    drv = MeshTrainDriver(
+        step, state, mesh, inflight=2, sync_every=1,
+        checkpoint=mgr, checkpoint_every=args.ckpt_every,
+    )
+    if restored_driver is not None:
+        drv.load_state_dict(restored_driver)
+    with StreamDataPipeline(
+        _messages(args.steps, skip=start), batch_size=B, mesh=mesh
+    ) as pipe:
+        for sb in pipe:
+            drv.submit(sb)
+            if args.pace:
+                time.sleep(args.pace)
+    drv.finish()
+    mgr.close()
+    result = {
+        "losses": [float(v) for v in drv.losses],
+        "start": start,
+        "steps": drv.steps,
+        "checkpoints": drv.checkpoints,
+        "mesh": args.mesh,
+    }
+    if args.out:
+        with open(args.out, "w", encoding="utf-8") as f:
+            json.dump(result, f)
+    print("ckpt_worker done", json.dumps({k: result[k] for k in (
+        "start", "steps", "checkpoints", "mesh")}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1257,8 +1257,79 @@ def test_every_rule_registered():
     assert set(all_rules()) == {
         "BJX101", "BJX102", "BJX103", "BJX104", "BJX105", "BJX106",
         "BJX107", "BJX108", "BJX109", "BJX110", "BJX111", "BJX112",
-        "BJX113",
+        "BJX113", "BJX114",
     }
+
+
+# -- BJX114 checkpoint-in-hot-path -------------------------------------------
+
+
+def test_bjx114_flags_sync_checkpoint_calls_in_driver_hot_path():
+    src = """
+        # bjx: driver-hot-path
+        def loop(self, batches):
+            for b in batches:
+                self.state, m = self.step(self.state, b)
+                self.checkpoint.save(self.steps, self.state)
+                self.checkpoint.wait()
+    """
+    assert rule_ids(src, select=["BJX114"]) == ["BJX114", "BJX114"]
+
+
+def test_bjx114_flags_dataflow_from_manager_construction():
+    src = """
+        # bjx: driver-hot-path
+        from blendjax.checkpoint import SnapshotManager
+
+        def run(step, state, batches):
+            mgr = SnapshotManager("ckpt/")
+            for b in batches:
+                state, m = step(state, b)
+                mgr.save(1, state)
+            mgr.restore(state)
+    """
+    assert rule_ids(src, select=["BJX114"]) == ["BJX114", "BJX114"]
+
+
+def test_bjx114_driver_basename_always_checked():
+    src = """
+        def drain_and_save(self):
+            self.ckpt_manager.wait_until_finished()
+    """
+    assert rule_ids(src, relpath="driver.py", select=["BJX114"]) == [
+        "BJX114"
+    ]
+
+
+def test_bjx114_async_and_non_checkpoint_receivers_untouched():
+    src = """
+        # bjx: driver-hot-path
+        def loop(self, batches):
+            for b in batches:
+                self.state, m = self.step(self.state, b)
+                self.checkpoint.save_async(self.steps, self.state)
+                self.checkpoint.latest_step(wait=False)
+                self.driver.request_checkpoint()
+                self.queue.wait()       # not a checkpoint receiver
+                self.recorder.save(b)   # not a checkpoint receiver
+    """
+    assert rule_ids(src, select=["BJX114"]) == []
+
+
+def test_bjx114_silent_outside_hot_path_and_suppressible():
+    src = """
+        def teardown(self):
+            self.checkpoint.save(self.steps, self.state)
+    """
+    assert rule_ids(src, select=["BJX114"]) == []
+    suppressed = """
+        # bjx: driver-hot-path
+        def teardown(self):
+            # the process is exiting: sanctioned sync flush
+            # bjx: ignore[BJX114]
+            self.checkpoint.wait()
+    """
+    assert rule_ids(suppressed, select=["BJX114"]) == []
 
 
 # -- self-gate ---------------------------------------------------------------

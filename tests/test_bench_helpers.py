@@ -625,3 +625,26 @@ def test_multichip_live_legs_shape(monkeypatch):
     assert row["scaling_efficiency"] == pytest.approx(
         row["speedup"] / 4, rel=1e-2
     )
+
+
+def test_live_resume_row_shape(tmp_path, monkeypatch):
+    """The kill-9/resume row runs its three child processes for real
+    (uninterrupted reference, paced-then-SIGKILLed, resumed) and
+    reports the record's contracts: identical f32 trajectories, zero
+    wire gaps with the restart detected through the restored lineage,
+    one dispatch per step with checkpointing enabled, and >= 1
+    committed async save. Shrunk step count for the CPU suite."""
+    import bench
+
+    monkeypatch.setattr(bench, "RESUME_DIR", str(tmp_path / "snaps"))
+    row = bench.measure_live_resume(steps=8)
+    assert row["equality"]["identical"] is True
+    assert row["equality"]["max_abs_diff"] == 0.0
+    assert row["killed_mid_run"] is True
+    assert row["committed_before_kill"] is True
+    assert row["resumed_at"] >= 1
+    assert row["seq_gaps"] == 0
+    assert row["restart_detected"] is True
+    assert row["dispatch_per_step"] == 1.0
+    assert row["ckpt"]["saves"] >= 1
+    assert row["value"] == 1.0

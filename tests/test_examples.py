@@ -99,6 +99,27 @@ def test_datagen_train_synthetic_fleet(monkeypatch, capsys):
     assert "fleet: instances=" in out and "(bounds 1:2)" in out
 
 
+def test_datagen_train_checkpoint_then_resume(monkeypatch, capsys,
+                                              tmp_path):
+    ckpt = str(tmp_path / "snapshots")
+    run_main(
+        monkeypatch, "datagen/train.py",
+        "--steps", "4", "--instances", "1", "--batch", "8",
+        "--shape", "64", "64", "--checkpoint", ckpt,
+        "--checkpoint-every", "2",
+    )
+    out = capsys.readouterr().out
+    assert "checkpoints in" in out and "steps [2, 4]" in out
+    run_main(
+        monkeypatch, "datagen/train.py",
+        "--steps", "2", "--instances", "1", "--batch", "8",
+        "--shape", "64", "64", "--checkpoint", ckpt, "--resume",
+    )
+    out = capsys.readouterr().out
+    assert "resumed from snapshot step 4" in out
+    assert "images/sec" in out
+
+
 def test_datagen_train_record_then_replay(monkeypatch, capsys, tmp_path):
     prefix = str(tmp_path / "rec")
     run_main(

@@ -1,0 +1,139 @@
+"""Kill -9 mid-run -> resume -> f32 loss trajectory identical to an
+uninterrupted run (ISSUE 12 acceptance): pinned on single-chip, on the
+8-device CPU mesh, and through an elastic 8 -> 4 resharded resume —
+the PR 8 mesh-equality trick applied to TIME instead of mesh size.
+
+Mechanism: ``tests/ckpt_worker.py`` trains a deterministic seeded
+stream through the real mesh pipeline with async checkpointing. The
+kill leg runs paced so the parent can observe a COMMITTED snapshot
+(manifest present — the atomic-rename contract) and SIGKILL the
+process mid-run; the resume leg restores the latest snapshot, fast-
+forwards the stream, and continues. SIGKILL gives no cleanup window,
+so everything the resumed run has IS what the async writer committed.
+"""
+
+import json
+import os
+import shutil
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+
+WORKER = os.path.join(os.path.dirname(__file__), "ckpt_worker.py")
+
+# same-mesh resume replays identical float ops -> exact equality;
+# cross-mesh resume inherits the collective-reduction-reorder bar the
+# 1-vs-8 equality tests pin (tests/test_mesh_driver.py)
+F32_EXACT_ATOL = 5e-6
+
+
+def _env():
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    return env
+
+
+def _run(args, timeout=300):
+    proc = subprocess.run(
+        [sys.executable, WORKER, *args],
+        env=_env(), stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True, timeout=timeout,
+    )
+    assert proc.returncode == 0, proc.stdout
+    return proc.stdout
+
+
+def _losses(path):
+    with open(path) as f:
+        return json.load(f)["losses"]
+
+
+def _wait_committed(directory, timeout=180):
+    """Poll for at least one COMMITTED snapshot — through the
+    subsystem's own read-only commit predicate, so a format rename
+    can't silently turn this poll into a timeout."""
+    from blendjax.checkpoint import committed_steps
+
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if committed_steps(directory):
+            return True
+        time.sleep(0.05)
+    return False
+
+
+def _kill9_mid_run(directory, mesh, steps):
+    """Start a paced worker, SIGKILL it after the first commit; assert
+    it really died mid-run."""
+    proc = subprocess.Popen(
+        [sys.executable, WORKER, directory, "--steps", str(steps),
+         "--mesh", str(mesh), "--ckpt-every", "2", "--pace", "0.5"],
+        env=_env(), stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True,
+    )
+    try:
+        committed = _wait_committed(directory)
+        assert committed, (
+            "no committed snapshot before timeout:\n"
+            + proc.communicate(timeout=10)[0]
+        )
+    finally:
+        if proc.poll() is None:
+            os.kill(proc.pid, signal.SIGKILL)
+    out, _ = proc.communicate(timeout=60)
+    assert proc.returncode == -signal.SIGKILL, (
+        f"worker was not killed mid-run (rc={proc.returncode}):\n{out}"
+    )
+
+
+def test_kill9_resume_single_chip_trajectory_identical(tmp_path):
+    steps = 10
+    ref_out = tmp_path / "ref.json"
+    _run([str(tmp_path / "ref"), "--steps", str(steps), "--mesh", "1",
+          "--ckpt-every", "2", "--out", str(ref_out)])
+    kill_dir = str(tmp_path / "kill")
+    _kill9_mid_run(kill_dir, mesh=1, steps=steps)
+    res_out = tmp_path / "res.json"
+    out = _run([kill_dir, "--steps", str(steps), "--mesh", "1",
+                "--resume", "--out", str(res_out)])
+    assert "ckpt_worker done" in out
+    ref, res = _losses(ref_out), _losses(res_out)
+    assert len(ref) == len(res) == steps
+    # identical, not close: same program, same stream, same backend —
+    # the restart is invisible to the math
+    assert res == ref
+
+
+def test_kill9_resume_8dev_mesh_and_elastic_8_to_4(tmp_path):
+    steps = 8
+    ref_out = tmp_path / "ref8.json"
+    _run([str(tmp_path / "ref8"), "--steps", str(steps), "--mesh", "8",
+          "--ckpt-every", "2", "--out", str(ref_out)])
+    kill_dir = str(tmp_path / "kill8")
+    _kill9_mid_run(kill_dir, mesh=8, steps=steps)
+    # each resume leg starts from the SAME kill-time snapshot: copy the
+    # directory so the first resume's own cadence saves can't feed the
+    # second
+    elastic_dir = str(tmp_path / "kill8-elastic")
+    shutil.copytree(kill_dir, elastic_dir)
+
+    res8_out = tmp_path / "res8.json"
+    _run([kill_dir, "--steps", str(steps), "--mesh", "8", "--resume",
+          "--out", str(res8_out)])
+    ref, res8 = _losses(ref_out), _losses(res8_out)
+    assert res8 == ref  # same mesh: bitwise
+
+    # elastic: the preempted 8-chip job continues on 4 chips — the
+    # snapshot's global arrays re-place under the 4-way shardings
+    # (state_shardings on the new mesh) and the trajectory matches to
+    # the established f32 collective-reorder bar
+    res4_out = tmp_path / "res4.json"
+    out = _run([elastic_dir, "--steps", str(steps), "--mesh", "4",
+                "--resume", "--out", str(res4_out)])
+    assert "ckpt_worker done" in out
+    res4 = _losses(res4_out)
+    assert len(res4) == steps
+    np.testing.assert_allclose(res4, ref, rtol=0, atol=F32_EXACT_ATOL)
