@@ -143,6 +143,27 @@ SCENARIO_MIN_STEPS = int(
 LIVE_RESUME = os.environ.get("BLENDJAX_BENCH_LIVE_RESUME", "1") == "1"
 RESUME_STEPS = int(os.environ.get("BLENDJAX_BENCH_RESUME_STEPS", "16"))
 RESUME_DIR = os.environ.get("BLENDJAX_BENCH_RESUME_DIR", "")
+# RL actor-learner row (docs/rl.md): cartpole trained END TO END by
+# blendjax.rl — remote producer envs under an ActorPool, a
+# TrajectoryReservoir, and the one-dispatch DQN learner — as a
+# uniform-vs-prioritized A/B, plus an 8-device CPU-mesh leg
+# (subprocess, like multichip_live) and a kill -9 -> resume leg
+# through the session store. Pure CPU/loopback — weather-independent.
+# CI asserts dispatch_per_step == 1.0 on the learner path, the
+# donation audit (ring + priorities + params updated in place), exact
+# transition accounting, and the episode-return sanity floor.
+LIVE_RL = os.environ.get("BLENDJAX_BENCH_LIVE_RL", "1") == "1"
+RL_STEPS = int(os.environ.get("BLENDJAX_BENCH_RL_STEPS", "300"))
+RL_MESH_STEPS = int(os.environ.get("BLENDJAX_BENCH_RL_MESH_STEPS", "80"))
+RL_ENVS = int(os.environ.get("BLENDJAX_BENCH_RL_ENVS", "2"))
+# the reward-SANITY floor (ROADMAP item 1): well below a healthy
+# random-policy baseline (~40 on this cartpole), far above the ~1-3 a
+# miswired env/reward/done path produces — the row proves the loop
+# trains, the curve ships in the record for the real claim
+RL_RETURN_FLOOR = float(
+    os.environ.get("BLENDJAX_BENCH_RL_RETURN_FLOOR", "15")
+)
+RL_DIR = os.environ.get("BLENDJAX_BENCH_RL_DIR", "")
 # Multi-chip live row (docs/performance.md "Going multi-chip"): the
 # SAME live pipeline (synthetic producers -> ShardedHostIngest ->
 # DeviceFeeder -> MeshTrainDriver) at mesh sizes 1/2/4/8 with a FIXED
@@ -2390,6 +2411,412 @@ def measure_rl_hz(seconds: float = 3.0) -> dict:
             "steps": steps, "seconds": round(dt, 2)}
 
 
+def _live_rl_leg(prioritized: bool, steps: int | None = None,
+                 envs: int | None = None, mesh=None,
+                 checkpoint_dir: str | None = None,
+                 ckpt_every: int = 0, resume: bool = False,
+                 pace: float = 0.0, batch: int = 32,
+                 capacity: int = 512, seed: int = 0) -> dict:
+    """One end-to-end RL training leg: cartpole producer envs under an
+    ActorPool -> TrajectoryReservoir -> one-dispatch DQN learner
+    (:mod:`blendjax.rl`), with the contracts measured the way
+    ``live_echo`` measures them — every device call at the STEP
+    cadence counted (the fused learner jit plus any standalone
+    reservoir gather, which the fused path makes zero), and the
+    donation audit pinning ring + priority + param buffer pointers
+    across the measured window.
+
+    ``checkpoint_dir`` arms the session store (``ckpt_every`` learner
+    steps); ``resume=True`` restores the latest snapshot and CONTINUES
+    to the same total ``steps`` — the kill -9 leg's two halves.
+    ``pace`` sleeps between learner steps so a parent can kill this
+    leg mid-run deterministically."""
+    import jax  # noqa: F401  (device backend must initialize first)
+
+    from blendjax.env import BatchedRemoteEnv
+    from blendjax.models import QNetwork
+    from blendjax.rl import (
+        ActorPool,
+        HostQPolicy,
+        RLTrainDriver,
+        TrajectoryReservoir,
+        make_dqn_step,
+        make_rl_train_state,
+        mesh_rl_step_kwargs,
+    )
+    from blendjax.testing.donation import DonationAudit
+    from blendjax.utils.metrics import metrics as reg
+
+    steps = RL_STEPS if steps is None else int(steps)
+    envs = RL_ENVS if envs is None else int(envs)
+    producer = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)),
+        "examples", "control", "cartpole_producer.py",
+    )
+    reg.reset()
+    reservoir = TrajectoryReservoir(
+        capacity, rng=seed, prioritized=prioritized, mesh=mesh,
+    )
+    model = QNetwork(hidden=(32, 32), n_actions=3)
+    state = make_rl_train_state(
+        model, np.zeros((1, 4), np.float32), learning_rate=1e-3,
+        mesh=mesh,
+    )
+    step_kwargs = (
+        mesh_rl_step_kwargs(state, mesh) if mesh is not None else {}
+    )
+    step = make_dqn_step(reservoir, model.apply, gamma=0.98,
+                         **step_kwargs)
+    mgr = None
+    if checkpoint_dir:
+        from blendjax.checkpoint import SnapshotManager
+
+        mgr = SnapshotManager(checkpoint_dir)
+    audit = DonationAudit()
+    with BatchedRemoteEnv(
+        script=producer, num_envs=envs, seed=seed,
+    ) as venv:
+        pool = ActorPool(
+            venv, reservoir,
+            HostQPolicy(3, eps_steps=1500, seed=seed),
+            # discrete index -> motor velocity (the cartpole action)
+            action_map=np.array([-2.0, 0.0, 2.0], np.float32),
+        )
+        driver = RLTrainDriver(
+            step, state, reservoir, actors=pool, mesh=mesh,
+            batch_size=batch, min_fill=2 * batch, sync_every=8,
+            inflight=2, checkpoint=mgr,
+            checkpoint_every=ckpt_every,
+        )
+        start_step = 0
+        restored_names: list = []
+        if resume:
+            restored = mgr.restore(state)
+            if restored is None:
+                raise RuntimeError(
+                    f"--resume with no committed snapshot in "
+                    f"{checkpoint_dir!r}"
+                )
+            driver.state = restored.state
+            restored_names = driver.restore_session(restored.session)
+            start_step = driver.steps
+        fill_at_start = reservoir.size
+        try:
+            with pool:
+                # warmup: reach min_fill + compile, and run the donated
+                # executable a few times so its buffer assignment
+                # settles before the audit marks (the multichip row's
+                # "donated layouts" dance)
+                for _ in range(min(3, max(steps - driver.steps - 1, 0))):
+                    driver.train_step()
+                driver.drain()
+                audit.snapshot("params", driver.state.params)
+                with reservoir.lock:
+                    # under the lock: a concurrent actor insert donates
+                    # these buffers, and a pointer read needs a live ref
+                    audit.snapshot("ring", reservoir._buffers)
+                    audit.snapshot("priorities", reservoir._priorities)
+                reg.reset()
+                drv0 = dict(driver.stats)
+                res0 = (reservoir.fresh, reservoir.replayed)
+                t0 = time.perf_counter()
+                while driver.steps < steps:
+                    driver.train_step()
+                    if pace:
+                        time.sleep(pace)
+                final_loss = driver.drain()
+                dt = time.perf_counter() - t0
+                audit.snapshot("params", driver.state.params)
+                with reservoir.lock:
+                    audit.snapshot("ring", reservoir._buffers)
+                    audit.snapshot("priorities", reservoir._priorities)
+        finally:
+            if mgr is not None:
+                mgr.wait()
+                mgr.close()
+    donation_ok = all(
+        audit.stable(k) for k in ("params", "ring", "priorities")
+    )
+    reg.gauge("train.donation_reuse", float(donation_ok))
+    report = reg.report()
+    spans = report["spans"]
+    window_steps = driver.steps - drv0["steps"]
+    train_calls = spans.get("train.dispatch", {}).get("count", 0)
+    # standalone reservoir gathers at the step cadence: ZERO on the
+    # fused path (the draw rides inside the learner jit) — the same
+    # honest count live_echo keeps
+    sample_calls = spans.get("rl.sample", {}).get("count", 0)
+    drawn = (reservoir.fresh - res0[0]) + (reservoir.replayed - res0[1])
+    returns = [r for _, r in pool.episode_returns]
+    half = len(returns) // 2
+    recent = returns[half:] if half else returns
+    leg = {
+        "prioritized": prioritized,
+        "learner_steps": window_steps,
+        "start_step": start_step,
+        "total_steps": driver.steps,
+        "seconds": round(dt, 2),
+        "learner_steps_s": round(window_steps / max(dt, 1e-9), 1),
+        "transitions_s": round(
+            window_steps * batch / max(dt, 1e-9), 1
+        ),
+        "final_loss": final_loss,
+        "dispatch_per_step": round(
+            (train_calls + sample_calls) / max(window_steps, 1), 3
+        ),
+        "rl_sample_dispatches": sample_calls,
+        "donation_reuse": donation_ok,
+        "donation_audit": audit.report(),
+        # the seq-style exact identities (CI-asserted): every drawn
+        # row accounted exactly once, every env row inserted exactly
+        # once
+        "accounting_exact": drawn == window_steps * batch,
+        "env_steps": pool.env_steps,
+        "transitions_inserted": reservoir.inserts,
+        "env_accounting_exact": pool.env_steps == reservoir.inserts,
+        "episodes": pool.episodes,
+        "mean_return": (
+            round(float(np.mean(recent)), 2) if recent else None
+        ),
+        "mean_return_first_half": (
+            round(float(np.mean(returns[:half])), 2) if half else None
+        ),
+        "replay_ratio": reservoir.stats["replay_ratio"],
+        "policy_syncs": pool.policy_version,
+        "sample_waits": driver.sample_waits,
+        # the reward curve (bounded): (env_step, episode_return)
+        "reward_curve": [
+            [int(s), round(float(r), 1)]
+            for s, r in pool.episode_returns[-100:]
+        ],
+    }
+    if mesh is not None:
+        leg["mesh_devices"] = int(
+            np.prod([int(s) for s in mesh.shape.values()])
+        )
+    if mgr is not None:
+        leg["ckpt_saves"] = driver.checkpoints
+        leg["restored"] = restored_names
+        leg["reservoir_fill_at_start"] = fill_at_start
+    return leg
+
+
+def measure_live_rl() -> dict:
+    """The ``live_rl`` row: cartpole trained end to end by the
+    actor-learner stack, four legs —
+
+    - ``uniform`` / ``prioritized``: the sampling A/B on the local
+      1-device path (same envs, same step budget);
+    - ``mesh``: the prioritized leg on a forced 8-device CPU mesh in a
+      subprocess (``bench.py --live-rl-mesh``), ring + priorities +
+      state sharded over ``data``;
+    - ``resume``: a paced child (``bench.py --live-rl-child``) is
+      SIGKILLed after its first COMMITTED snapshot, then a second
+      child restores the session and CONTINUES to the same total step
+      count — the PR 11 survive-anything contract applied to RL.
+
+    CI asserts (bench-smoke): ``dispatch_per_step == 1.0`` and
+    ``donation_reuse`` on every local leg, exact transition
+    accounting, ``mean_return >= RL_RETURN_FLOOR`` on the best leg,
+    the mesh leg's single-dispatch contract, and the resume leg's
+    continuation (killed mid-run after a commit; the resumed half
+    starts where the snapshot ended and finishes the budget)."""
+    import shutil
+    import signal
+    import subprocess
+    import tempfile
+
+    row: dict = {}
+    contracts = []
+    for name, prioritized in (("uniform", False), ("prioritized", True)):
+        leg = _live_rl_leg(prioritized=prioritized)
+        row[name] = leg
+        contracts.append(
+            leg["dispatch_per_step"] == 1.0 and leg["donation_reuse"]
+            and leg["accounting_exact"]
+        )
+    row["dispatch_per_step"] = max(
+        row[k]["dispatch_per_step"] for k in ("uniform", "prioritized")
+    )
+    row["donation_reuse"] = all(
+        row[k]["donation_reuse"] for k in ("uniform", "prioritized")
+    )
+    row["accounting_exact"] = all(
+        row[k]["accounting_exact"] and row[k]["env_accounting_exact"]
+        for k in ("uniform", "prioritized")
+    )
+    best = max(
+        (row[k]["mean_return"] or 0.0)
+        for k in ("uniform", "prioritized")
+    )
+    row["mean_return"] = best
+    row["return_floor"] = RL_RETURN_FLOOR
+    row["reward_sane"] = best >= RL_RETURN_FLOOR
+    row["value"] = best
+
+    # -- mesh leg (subprocess: the device count must be forced before
+    # the backend initializes, the multichip_live dance) --------------
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__),
+             "--live-rl-mesh"],
+            capture_output=True, text=True, timeout=300.0,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+        )
+        lines = [
+            ln for ln in (proc.stdout or "").strip().splitlines()
+            if ln.startswith("{")
+        ]
+        if proc.returncode != 0 or not lines:
+            row["mesh"] = {
+                "error": f"rc={proc.returncode} "
+                         f"stderr={(proc.stderr or '')[-300:]}"
+            }
+        else:
+            row["mesh"] = json.loads(lines[-1])
+    except Exception as e:  # pragma: no cover - spawn flake path
+        row["mesh"] = {"error": repr(e)[:200]}
+
+    # -- kill -9 -> resume leg ----------------------------------------
+    base = RL_DIR or tempfile.mkdtemp(prefix="bjx-live-rl-")
+    os.makedirs(base, exist_ok=True)
+    kill_dir = os.path.join(base, "rl-kill")
+    shutil.rmtree(kill_dir, ignore_errors=True)
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    bench_path = os.path.abspath(__file__)
+    resume_steps = max(24, min(RL_STEPS, 48))
+    try:
+        proc = subprocess.Popen(
+            [sys.executable, bench_path, "--live-rl-child", kill_dir,
+             "--steps", str(resume_steps), "--ckpt-every", "4",
+             "--pace", "0.25"],
+            env=env, stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT, text=True,
+        )
+        from blendjax.checkpoint import committed_steps
+
+        committed = False
+        deadline = time.monotonic() + 180
+        try:
+            while time.monotonic() < deadline:
+                if committed_steps(kill_dir):
+                    committed = True
+                    break
+                if proc.poll() is not None:
+                    break  # child died pre-commit
+                time.sleep(0.05)
+        finally:
+            if proc.poll() is None:
+                os.kill(proc.pid, signal.SIGKILL)
+        kill_out, _ = proc.communicate(timeout=60)
+        killed_mid_run = proc.returncode == -signal.SIGKILL
+
+        res_out = os.path.join(base, "rl-res.json")
+        proc2 = subprocess.run(
+            [sys.executable, bench_path, "--live-rl-child", kill_dir,
+             "--steps", str(resume_steps), "--ckpt-every", "4",
+             "--resume", "--out", res_out],
+            env=env, stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT, text=True, timeout=240.0,
+        )
+        assert proc2.returncode == 0, proc2.stdout[-2000:]
+        with open(res_out) as f:
+            res = json.load(f)
+        resumed = {
+            "steps": resume_steps,
+            "killed_mid_run": killed_mid_run,
+            "committed_before_kill": committed,
+            "resumed_at": res["start_step"],
+            "continued": bool(
+                res["start_step"] > 0
+                and res["total_steps"] == resume_steps
+                and res["restored"]
+            ),
+            "restored_components": res["restored"],
+            "dispatch_per_step": res["dispatch_per_step"],
+            "reservoir_restored_fill": res["reservoir_fill_at_start"],
+            "ckpt_saves": res.get("ckpt_saves", 0),
+        }
+        row["resume"] = resumed
+        if resumed["continued"]:
+            shutil.rmtree(base, ignore_errors=True)
+        else:
+            row["resume"]["snapshot_dir"] = base
+            row["resume"]["kill_leg_tail"] = (kill_out or "")[-500:]
+    except Exception as e:  # pragma: no cover - spawn flake path
+        row["resume"] = {"error": repr(e)[:200]}
+
+    row["contracts_held"] = all(contracts)
+    return row
+
+
+def _live_rl_mesh_main() -> None:
+    """``bench.py --live-rl-mesh`` entry: force the 8-device CPU
+    platform BEFORE the first backend query, run one prioritized RL
+    leg on the full mesh (ring + priorities + train state sharded over
+    ``data``), print one JSON line."""
+    import jax
+
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            f"{flags} --xla_force_host_platform_device_count=8"
+        ).strip()
+    jax.config.update("jax_platforms", "cpu")
+    from blendjax.parallel import create_mesh
+
+    mesh = create_mesh({"data": -1})
+    print(json.dumps(
+        _live_rl_leg(prioritized=True, steps=RL_MESH_STEPS, mesh=mesh)
+    ))
+
+
+def _live_rl_child_main() -> int:
+    """``bench.py --live-rl-child`` entry: one checkpointed RL leg in a
+    fresh process — the kill -9 / resume row's two halves share this
+    body (``--resume`` restores the session store and continues to the
+    same total step budget)."""
+    import argparse
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--live-rl-child", action="store_true")
+    ap.add_argument("directory")
+    ap.add_argument("--steps", type=int, default=32)
+    ap.add_argument("--ckpt-every", type=int, default=4)
+    ap.add_argument("--resume", action="store_true")
+    ap.add_argument("--pace", type=float, default=0.0)
+    ap.add_argument("--out", default=None)
+    args = ap.parse_args()
+
+    # ONE leg body (``_live_rl_leg``) serves the A/B, mesh, AND resume
+    # legs — the child only adds the no-commit guard the parent's
+    # pre-kill race needs, and reports what the parent can't see:
+    # where the resumed half started and which session components
+    # actually restored
+    from blendjax.checkpoint import committed_steps
+
+    if args.resume and not committed_steps(args.directory):
+        print("no committed snapshot to resume", file=sys.stderr)
+        return 2
+    leg = _live_rl_leg(
+        prioritized=True, steps=args.steps, envs=2,
+        checkpoint_dir=args.directory, ckpt_every=args.ckpt_every,
+        resume=args.resume, pace=args.pace, batch=16, capacity=256,
+    )
+    keys = (
+        "start_step", "total_steps", "restored",
+        "reservoir_fill_at_start", "dispatch_per_step", "ckpt_saves",
+        "mean_return",
+    )
+    blob = json.dumps({k: leg[k] for k in keys})
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(blob)
+    print(blob)
+    return 0
+
+
 def _record(value: float, detail: dict) -> dict:
     """The one definition of the bench's JSON envelope."""
     return {
@@ -2790,6 +3217,17 @@ def _build_record(progress: dict) -> dict:
             detail["live_resume"] = measure_live_resume()
         except Exception as e:  # pragma: no cover - spawn flake path
             detail["live_resume"] = {"error": repr(e)[:200]}
+    if LIVE_RL:
+        # RL actor-learner row (docs/rl.md): cartpole trained end to
+        # end — uniform-vs-prioritized A/B, an 8-device CPU-mesh leg,
+        # and a kill -9 -> resume leg through the session store. Pure
+        # CPU/loopback, weather-independent; CI asserts the learner's
+        # one-dispatch contract, the donation audit, exact transition
+        # accounting, and the episode-return sanity floor.
+        try:
+            detail["live_rl"] = measure_live_rl()
+        except Exception as e:  # pragma: no cover - spawn flake path
+            detail["live_rl"] = {"error": repr(e)[:200]}
     if MULTICHIP_LIVE:
         # Multi-chip live row (docs/performance.md "Going multi-chip"):
         # the live pipeline at mesh sizes 1/2/4/8 on a forced 8-device
@@ -2957,4 +3395,8 @@ if __name__ == "__main__":
         sys.exit(_multichip_live_main())
     if "--live-resume-child" in sys.argv:
         sys.exit(_live_resume_child_main())
+    if "--live-rl-mesh" in sys.argv:
+        sys.exit(_live_rl_mesh_main())
+    if "--live-rl-child" in sys.argv:
+        sys.exit(_live_rl_child_main())
     sys.exit(main())

@@ -1,0 +1,176 @@
+"""BJX115 host-materialization-in-actor-loop: device fetch of a policy
+or reservoir output inside an actor hot loop.
+
+The actor-learner split (:mod:`blendjax.rl`) pins all device work to
+the LEARNER: actors step remote envs against a **host-side policy
+snapshot** (a numpy pytree the learner pushes at the ``sync_every``
+cadence) and feed the reservoir through its donated insert — the actor
+step loop itself touches no device values, so it runs at the env
+layer's native rendezvous rate regardless of device contention. One
+``np.asarray()``/``.item()``/``float()``/``jax.device_get()``/
+``block_until_ready()`` on a policy output or a reservoir
+``sample``/``gather``/``draw_token`` result inside that loop re-couples
+every env step to the device queue — a per-step host sync in the
+tightest loop in the system, exactly the regime BJX106/BJX108 guard on
+the driver side.
+
+Scope: modules opting in with a ``bjx: actor-hot-path`` marker comment
+(the BJX102/BJX106 mechanism), plus any module named ``actor.py``.
+Within those, ``.item()`` and ``block_until_ready`` are flagged
+anywhere (an actor module has no sanctioned use for either), while
+host casts/fetches are flagged only when their argument traces to a
+policy call (a call on a ``policy``-named receiver/attribute) or a
+reservoir draw (the BJX108 receiver heuristic extended with the
+trajectory-reservoir methods) — env outputs and plain host arithmetic
+stay unflagged, because those values never lived on a device. The
+sanctioned cadence-bounded syncs (the learner's policy snapshot fetch,
+the reservoir's priority-mirror refresh) live in learner/replay
+modules, outside this rule's scope, each under its own declared span.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Iterator
+
+from blendjax.analysis.core import (
+    Finding,
+    ModuleContext,
+    Rule,
+    register,
+    walk_shallow,
+)
+from blendjax.analysis.rules.driver_sync import _names
+
+ACTOR_BASENAMES = {"actor.py"}
+# Comment lines only (the BJX102 convention): the marker quoted in a
+# docstring — this module's own, say — must not opt a module in.
+ACTOR_MARKER_RE = re.compile(r"^\s*#.*bjx: actor-hot-path", re.MULTILINE)
+
+RESERVOIR_METHODS = {"sample", "insert", "gather", "draw", "draw_token"}
+HOST_CASTS = {"float", "int"}
+HOST_ARRAY_FETCHES = {
+    "numpy.asarray",
+    "numpy.array",
+    "numpy.ascontiguousarray",
+    "jax.device_get",
+}
+
+
+def _is_actor_hot(module: ModuleContext) -> bool:
+    if os.path.basename(module.relpath) in ACTOR_BASENAMES:
+        return True
+    return ACTOR_MARKER_RE.search(module.source[:4096]) is not None
+
+
+def _is_policy_call(node: ast.Call, module: ModuleContext) -> bool:
+    """A call whose callee names a policy: ``self.policy(...)``,
+    ``policy(...)``, ``self._policy.act(...)`` — any dotted segment
+    containing ``policy``."""
+    dotted = module.resolve(node.func) or ""
+    return any("policy" in part.lower() for part in dotted.split("."))
+
+
+def _is_reservoir_draw(node: ast.Call, module: ModuleContext) -> bool:
+    func = node.func
+    if not isinstance(func, ast.Attribute):
+        return False
+    if func.attr not in RESERVOIR_METHODS:
+        return False
+    dotted = module.resolve(func.value) or ""
+    return any("reservoir" in part.lower() for part in dotted.split("."))
+
+
+def _is_device_source(node: ast.AST, module: ModuleContext) -> bool:
+    return isinstance(node, ast.Call) and (
+        _is_policy_call(node, module) or _is_reservoir_draw(node, module)
+    )
+
+
+@register
+class ActorLoopMaterializationRule(Rule):
+    id = "BJX115"
+    name = "host-materialization-in-actor-loop"
+    description = (
+        "host materialization (.item()/np.asarray/float/device_get/"
+        "block_until_ready) of a policy or reservoir output inside an "
+        "actor hot loop"
+    )
+
+    def check(self, module: ModuleContext) -> Iterator[Finding]:
+        if not _is_actor_hot(module):
+            return
+        for qual, fn, _cls in module.iter_functions():
+            yield from self._scan_function(module, fn, qual)
+
+    def _scan_function(
+        self, module: ModuleContext, fn: ast.AST, qual: str
+    ) -> Iterator[Finding]:
+        nodes = list(walk_shallow(fn))
+        # Names bound from policy/reservoir-draw calls, keyed by first
+        # assignment line (a fetch textually above the assignment reads
+        # an unrelated earlier value — the BJX106/BJX108 convention).
+        tainted: dict[str, int] = {}
+        for node in nodes:
+            if isinstance(node, ast.Assign) and _is_device_source(
+                node.value, module
+            ):
+                for target in node.targets:
+                    for name in _names(target):
+                        line = getattr(node, "lineno", 0)
+                        if name not in tainted or line < tainted[name]:
+                            tainted[name] = line
+        for node in nodes:
+            if not isinstance(node, ast.Call):
+                continue
+            func = node.func
+            # .item() / block_until_ready: no sanctioned actor-loop use
+            if isinstance(func, ast.Attribute) and func.attr == "item" \
+                    and not node.args:
+                yield self.finding(
+                    module, node,
+                    f".item() in actor hot loop '{qual}' forces a "
+                    "per-step device->host transfer (act from the "
+                    "host-side policy snapshot instead)",
+                )
+                continue
+            resolved = module.resolve(func) or ""
+            if (
+                isinstance(func, ast.Attribute)
+                and func.attr == "block_until_ready"
+            ) or resolved.endswith(".block_until_ready"):
+                yield self.finding(
+                    module, node,
+                    f"block_until_ready() in actor hot loop '{qual}' "
+                    "couples env stepping to the device queue (the "
+                    "learner owns all device waits)",
+                )
+                continue
+            if not (
+                resolved in HOST_ARRAY_FETCHES or resolved in HOST_CASTS
+            ) or not node.args:
+                continue
+            arg = node.args[0]
+            nested = any(
+                _is_device_source(inner, module)
+                for inner in ast.walk(arg)
+            )
+            hit = sorted(
+                name for name in _names(arg)
+                if name in tainted
+                and getattr(node, "lineno", 0) >= tainted[name]
+            )
+            if nested or hit:
+                what = (
+                    f"'{hit[0]}'" if hit
+                    else "a policy/reservoir call result"
+                )
+                yield self.finding(
+                    module, node,
+                    f"{resolved}() of {what} in actor hot loop "
+                    f"'{qual}' materializes a device value per env "
+                    "step — push a host-side policy snapshot at the "
+                    "sync cadence instead (docs/rl.md)",
+                )

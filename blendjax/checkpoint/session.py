@@ -13,6 +13,9 @@ and this module composes them:
 ...     echo=echo, scenario=accounting, curriculum=curriculum,
 ...     lineage=lineage, fleet=controller,
 ... )
+>>> # RL runs bundle the replay ring + actor counters the same way
+>>> # (RLTrainDriver does this by default — see docs/rl.md):
+>>> session = collect_session(replay=reservoir, actor=actor_pool)
 >>> mgr.save_async(step, state, session=session)
 ... # later, in a fresh process:
 >>> restored = mgr.restore(template)

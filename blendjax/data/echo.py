@@ -114,15 +114,9 @@ class SampleReservoir:
         # single-dispatch invariants via pinned out_shardings.
         self.sharding = sharding
         if sharding is not None:
-            from blendjax.parallel.sharding import leading_shard_count
+            from blendjax.data.ring import validate_ring_capacity
 
-            ways = leading_shard_count(sharding)
-            if ways > 1 and self.capacity % ways:
-                raise ValueError(
-                    f"capacity={capacity} must divide evenly over the "
-                    f"{ways}-way sharded ring axis — every chip holds "
-                    "an equal slice of the reservoir"
-                )
+            validate_ring_capacity(self.capacity, sharding)
         self.augment = augment
         self._rng_seed = rng
         self._buffers: dict | None = None
@@ -139,57 +133,32 @@ class SampleReservoir:
 
     def _build(self, fields: dict, initial: dict | None = None) -> None:
         jax = _require_jax()
-        import jax.numpy as jnp
+
+        from blendjax.data.ring import (
+            allocate_ring,
+            make_ring_gather,
+            make_ring_insert,
+            ring_gather,
+        )
 
         self._spec = {
             k: (tuple(v.shape[1:]), np.dtype(v.dtype))
             for k, v in fields.items()
         }
-        if initial is None:
-            self._buffers = {
-                k: jnp.zeros((self.capacity, *shape), dtype)
-                for k, (shape, dtype) in self._spec.items()
-            }
-            if self.sharding is not None:
-                # One placement for the whole ring pytree: the storage
-                # is born sharded, so the donated scatter below reuses
-                # the sharded buffers in place forever after.
-                self._buffers = jax.device_put(
-                    self._buffers, self.sharding
-                )
-        elif self.sharding is not None:
-            # restore path: place the snapshot's ring DIRECTLY — going
-            # through the zeros allocation first would transiently
-            # double the (potentially multi-GB) ring on device, and a
-            # run that trained fine could OOM exactly at resume
-            self._buffers = jax.device_put(dict(initial), self.sharding)
-        else:
-            self._buffers = {
-                k: jnp.asarray(v) for k, v in initial.items()
-            }
-        capacity = self.capacity
-
-        def _insert(bufs, batch, cursor):
-            def put(buf, b):
-                idx = (cursor + jnp.arange(b.shape[0])) % capacity
-                return buf.at[idx].set(b)
-
-            return {k: put(bufs[k], batch[k]) for k in bufs}
-
+        self._buffers = allocate_ring(
+            self.capacity, fields=fields, sharding=self.sharding,
+            initial=dict(initial) if initial is not None else None,
+        )
         # Donated buffers: the scatter updates the ring in place, so
         # insert never reallocates the (potentially multi-GB) reservoir
         # and the train loop's memory footprint is flat. Under a mesh
         # sharding the output layout is PINNED to the ring sharding —
         # donation requires matching in/out layouts, and an inferred
         # output layout drifting (e.g. toward the incoming batch's)
-        # would silently break the stable-buffer contract.
-        self._insert_fn = jax.jit(
-            _insert, donate_argnums=(0,),
-            **(
-                {"out_shardings": self.sharding}
-                if self.sharding is not None else {}
-            ),
-        )
+        # would silently break the stable-buffer contract. (The scatter
+        # and gather mechanics are shared with the RL trajectory
+        # reservoir: blendjax.data.ring.)
+        self._insert_fn = make_ring_insert(self.capacity, self.sharding)
 
         augment = self.augment
         base_key = (
@@ -199,7 +168,7 @@ class SampleReservoir:
         )
 
         def _draw(bufs, idx, counter):
-            out = {k: v[idx] for k, v in bufs.items()}
+            out = ring_gather(bufs, idx)
             if augment is not None:
                 out = augment(jax.random.fold_in(base_key, counter), out)
             return out
@@ -217,9 +186,7 @@ class SampleReservoir:
             if self.sharding is not None else {}
         )
         self._draw_fn = jax.jit(_draw, **out_sh)
-        self._gather_fn = jax.jit(
-            lambda bufs, i: {k: v[i] for k, v in bufs.items()}, **out_sh
-        )
+        self._gather_fn = make_ring_gather(self.sharding)
 
     # -- operations -----------------------------------------------------------
 

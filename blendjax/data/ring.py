@@ -1,0 +1,167 @@
+"""Shared device-ring internals: the donated scatter / jitted gather
+core both reservoirs are built on.
+
+Two subsystems keep "the last N samples" resident on device as a
+preallocated ring with stable buffers: the supervised echo reservoir
+(:class:`blendjax.data.echo.SampleReservoir`, PR 5/9) and the RL
+trajectory replay (:class:`blendjax.rl.replay.TrajectoryReservoir`).
+Their invariants are identical and easy to regress independently —
+donated in-place insert (flat memory, no per-step realloc), one jitted
+gather per draw, optional mesh sharding of the capacity axis with
+PINNED out layouts (donation requires matching in/out shardings, and a
+drifting inferred layout silently breaks the stable-buffer contract) —
+so the mechanics live here once, as pytree-generic helpers:
+
+- :func:`validate_ring_capacity` — the capacity-divides-the-sharded-
+  axis early raise.
+- :func:`allocate_ring` — preallocate (or place a restored snapshot of)
+  the ring pytree, born under its sharding so the first donated scatter
+  already reuses the sharded buffers.
+- :func:`make_ring_insert` — the jitted donated batch scatter
+  ``(buffers, batch, cursor) -> buffers``.
+- :func:`make_ring_gather` — the jitted row gather
+  ``(buffers, idx) -> batch`` (also usable unjitted as a traceable
+  draw body inside a fused train step).
+
+Everything here is pytree-shaped (``jax.tree``), so a reservoir of
+flat ``{image, xy}`` dicts and one of nested transition pytrees
+(``obs``/``action``/``reward``/``done``/``next_obs`` plus bootstrap
+metadata) share one implementation. Callers own all HOST-side
+bookkeeping (cursor, size, per-slot accounting) — nothing in this
+module reads a device value back (the BJX108 discipline).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def _require_jax():
+    import jax  # deferred: producer processes never import jax
+
+    return jax
+
+
+def ring_ways(sharding) -> int:
+    """How many ways ``sharding`` splits the ring's capacity axis
+    (1 for ``None``/replicated)."""
+    if sharding is None:
+        return 1
+    from blendjax.parallel.sharding import leading_shard_count
+
+    return leading_shard_count(sharding)
+
+
+def validate_ring_capacity(capacity: int, sharding) -> None:
+    """Raise early when ``capacity`` can't split evenly over the
+    sharded ring axis — every chip must hold an equal slice, and the
+    alternative is an opaque XLA divisibility error at first insert."""
+    ways = ring_ways(sharding)
+    if ways > 1 and capacity % ways:
+        raise ValueError(
+            f"capacity={capacity} must divide evenly over the "
+            f"{ways}-way sharded ring axis — every chip holds an "
+            "equal slice of the reservoir"
+        )
+
+
+def ring_spec(fields) -> dict:
+    """``{flat key: (per-row shape, dtype)}`` of one example batch —
+    the structure every later insert must match."""
+    jax = _require_jax()
+
+    return {
+        jax.tree_util.keystr(path): (tuple(v.shape[1:]), np.dtype(v.dtype))
+        for path, v in jax.tree_util.tree_leaves_with_path(fields)
+    }
+
+
+def allocate_ring(capacity: int, fields=None, sharding=None, initial=None):
+    """Preallocate the ring pytree (zeros shaped from ``fields``' rows)
+    or place a restored snapshot (``initial``) directly.
+
+    The restore path deliberately skips the zeros allocation: going
+    through it first would transiently double the (potentially
+    multi-GB) ring on device, and a run that trained fine could OOM
+    exactly at resume. Either way the ring is born under ``sharding``
+    so the donated scatter reuses the sharded buffers in place forever
+    after.
+    """
+    jax = _require_jax()
+    import jax.numpy as jnp
+
+    if initial is not None:
+        if sharding is not None:
+            return jax.device_put(initial, sharding)
+        return jax.tree.map(jnp.asarray, initial)
+    buffers = jax.tree.map(
+        lambda v: jnp.zeros((capacity, *v.shape[1:]), v.dtype), fields
+    )
+    if sharding is not None:
+        # One placement for the whole ring pytree: the storage is born
+        # sharded, so the donated scatter reuses it in place.
+        buffers = jax.device_put(buffers, sharding)
+    return buffers
+
+
+def ring_slot_update(capacity: int, buffers, batch, cursor):
+    """The traceable scatter body: write ``batch``'s rows at
+    ``(cursor + arange(B)) % capacity`` across the whole pytree."""
+    import jax
+
+    import jax.numpy as jnp
+
+    def put(buf, b):
+        idx = (cursor + jnp.arange(b.shape[0])) % capacity
+        return buf.at[idx].set(b)
+
+    return jax.tree.map(put, buffers, batch)
+
+
+def make_ring_insert(capacity: int, sharding=None):
+    """Build the jitted donated insert ``(buffers, batch, cursor) ->
+    buffers``. Donation + pinned out sharding keep the ring's device
+    allocation made once and its buffers stable across the run."""
+    jax = _require_jax()
+
+    def _insert(bufs, batch, cursor):
+        return ring_slot_update(capacity, bufs, batch, cursor)
+
+    return jax.jit(
+        _insert, donate_argnums=(0,),
+        **({"out_shardings": sharding} if sharding is not None else {}),
+    )
+
+
+def ring_gather(buffers, idx):
+    """The traceable gather body: rows ``idx`` of every ring field —
+    usable directly inside a fused train jit (the reservoir draw
+    hooks) or jitted standalone via :func:`make_ring_gather`."""
+    jax = _require_jax()
+
+    return jax.tree.map(lambda v: v[idx], buffers)
+
+
+def make_ring_gather(sharding=None):
+    """Build the jitted gather ``(buffers, idx) -> batch``. A sharded
+    ring pins the emitted batch to the same data-axis layout the feeder
+    produces, so downstream jits see identical shardings for fresh and
+    reservoir-drawn batches."""
+    jax = _require_jax()
+
+    return jax.jit(
+        ring_gather,
+        **({"out_shardings": sharding} if sharding is not None else {}),
+    )
+
+
+__all__ = [
+    "allocate_ring",
+    "make_ring_gather",
+    "make_ring_insert",
+    "ring_gather",
+    "ring_slot_update",
+    "ring_spec",
+    "ring_ways",
+    "validate_ring_capacity",
+]

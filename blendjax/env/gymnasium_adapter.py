@@ -51,7 +51,11 @@ class GymnasiumRemoteEnv(gymnasium.Env):
     def reset(self, *, seed=None, options=None):
         super().reset(seed=seed)
         self._elapsed = 0
-        obs, info = self._env.reset()
+        # the seed crosses the wire: the PRODUCER's episode RNG is what
+        # determines the initial state, so seeding only the local
+        # np_random (what super().reset did alone) would leave seeded
+        # resets non-deterministic
+        obs, info = self._env.reset(seed=seed)
         return self._obs(obs), info
 
     def step(self, action):

@@ -36,10 +36,16 @@ class RemoteEnv:
         }
         return obs, float(rep.get("reward", 0.0)), bool(rep.get("done", False)), info
 
-    def reset(self):
-        """Start a fresh episode; returns ``(obs, info)``
-        (reference ``btt/env.py:47-60``)."""
-        obs, _, _, info = self._unpack(self.client.call(cmd="reset"))
+    def reset(self, seed=None):
+        """Start a fresh episode; returns ``(obs, info)`` (reference
+        ``btt/env.py:47-60``). ``seed`` reseeds the producer's episode
+        RNG before the episode starts (Gymnasium's ``reset(seed=)``
+        contract carried over the wire), so two resets with the same
+        seed start bit-identical episodes."""
+        req = {"cmd": "reset"}
+        if seed is not None:
+            req["seed"] = int(seed)
+        obs, _, _, info = self._unpack(self.client.call(**req))
         return obs, info
 
     def step(self, action):

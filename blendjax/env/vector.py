@@ -22,7 +22,11 @@ class BatchedRemoteEnv:
     ``step(actions)`` takes (N, ...) actions and returns stacked
     ``(obs (N,...), reward (N,), done (N,), infos list)``. Episodes
     auto-reset on done (the standard vector-env contract) so TPU policy
-    rollouts never stall.
+    rollouts never stall — and, per that contract, a done row's
+    TERMINAL observation rides in ``infos[i]["final_observation"]``
+    (the stacked ``obs`` holds the fresh episode's first observation):
+    bootstrapped TD targets must use the terminal obs as ``next_obs``,
+    never the new episode's start (:mod:`blendjax.rl.actor` reads it).
     """
 
     def __init__(self, script: str, num_envs: int = 4, seed: int = 0,
@@ -44,9 +48,24 @@ class BatchedRemoteEnv:
         ]
         self.num_envs = num_envs
         self._pool = ThreadPoolExecutor(max_workers=num_envs)
+        self._closed = False
 
-    def reset(self):
-        obs_info = list(self._pool.map(lambda e: e.reset(), self.envs))
+    def reset(self, seed=None):
+        """Reset every env; ``seed`` (an int or a per-env sequence)
+        reseeds each producer's episode RNG deterministically — env i
+        gets ``seed + i`` from a scalar, the vector-env convention."""
+        if seed is None:
+            seeds = [None] * self.num_envs
+        elif np.ndim(seed) == 0:
+            seeds = [int(seed) + i for i in range(self.num_envs)]
+        else:
+            seeds = [int(s) for s in seed]
+        obs_info = list(
+            self._pool.map(
+                lambda es: es[0].reset(seed=es[1]),
+                zip(self.envs, seeds),
+            )
+        )
         return np.stack([np.asarray(o) for o, _ in obs_info]), [
             i for _, i in obs_info
         ]
@@ -56,7 +75,12 @@ class BatchedRemoteEnv:
             env, a = env_action
             obs, reward, done, info = env.step(np.asarray(a).tolist())
             if done:
-                obs, _ = env.reset()  # auto-reset, obs is the fresh episode
+                # auto-reset: park the TERMINAL observation in the info
+                # dict (the vector-env contract) before obs becomes the
+                # fresh episode's first — bootstrapped targets need it
+                info = dict(info)
+                info["final_observation"] = obs
+                obs, _ = env.reset()
             return obs, reward, done, info
 
         results = list(self._pool.map(one, zip(self.envs, actions)))
@@ -67,7 +91,16 @@ class BatchedRemoteEnv:
         return obs, reward, done, infos
 
     def close(self):
-        self._pool.shutdown(wait=False)
+        """Idempotent teardown. The pool shuts down with ``wait=True``
+        FIRST (bounded: queued work is cancelled and in-flight RPCs
+        are bounded by their own ``timeoutms``), so no worker thread
+        can still hold an in-flight RPC on a socket we're about to
+        close — the old ``wait=False`` ordering raced workers against
+        ``env.close()`` on the same zmq sockets."""
+        if self._closed:
+            return
+        self._closed = True
+        self._pool.shutdown(wait=True, cancel_futures=True)
         for e in self.envs:
             e.close()
         self.launcher.__exit__(None, None, None)

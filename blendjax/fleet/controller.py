@@ -82,6 +82,25 @@ class FleetPolicy:
         assert 1 <= self.min_instances <= self.max_instances
         assert self.up_after >= 1 and self.down_after >= 1
 
+    @classmethod
+    def rl(cls, **overrides) -> "FleetPolicy":
+        """The actor-learner verdict vocabulary
+        (:func:`blendjax.rl.diagnose_rl`, docs/rl.md): scale env
+        producers UP when the learner starves for transitions
+        (``env-bound`` — reservoir fill rate can't cover the sample
+        rate) and DOWN when actors outrun the learner so far that
+        fresh transitions die undrawn (``learner-bound``). Pair with
+        ``FleetController(diagnose=blendjax.rl.diagnose_rl_current,
+        policy=FleetPolicy.rl())`` — the controller machinery
+        (hysteresis, cooldown, drain grace, remote admission) is
+        verdict-vocabulary-agnostic and carries over unchanged."""
+        kwargs = {
+            "scale_up_verdicts": ("env-bound",),
+            "scale_down_verdicts": ("learner-bound",),
+            **overrides,
+        }
+        return cls(**kwargs)
+
 
 def _valid_endpoint(addr) -> bool:
     """Cheap sanity gate for network-supplied endpoints: enough to keep

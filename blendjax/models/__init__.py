@@ -17,7 +17,7 @@ JAX-native equivalents plus the models the TPU train loops need:
 from blendjax.models.cnn import CubeRegressor
 from blendjax.models.discriminator import Discriminator
 from blendjax.models.moe import MoEMLP, apply_with_aux, collect_aux_loss
-from blendjax.models.policy import PolicyValueNet
+from blendjax.models.policy import PolicyValueNet, QNetwork
 from blendjax.models.transformer import StreamFormer
 
 __all__ = [
@@ -27,5 +27,6 @@ __all__ = [
     "apply_with_aux",
     "collect_aux_loss",
     "PolicyValueNet",
+    "QNetwork",
     "StreamFormer",
 ]

@@ -12,6 +12,27 @@ import flax.linen as nn
 import jax.numpy as jnp
 
 
+class QNetwork(nn.Module):
+    """Discrete-action Q head for the DQN path (:mod:`blendjax.rl`).
+
+    A plain ``Dense`` stack (relu between, linear head) — deliberately
+    the exact layer shape :func:`blendjax.rl.actor.np_mlp_forward`
+    evaluates in numpy, so the actor pool can run the SAME policy
+    against a host-side param snapshot with zero device dispatches in
+    its step loop (the BJX115 discipline)."""
+
+    hidden: tuple = (64, 64)
+    n_actions: int = 3
+
+    @nn.compact
+    def __call__(self, obs):
+        """``obs``: (B, obs_dim) float32 -> Q-values (B, n_actions)."""
+        x = obs.astype(jnp.float32)
+        for h in self.hidden:
+            x = nn.relu(nn.Dense(h)(x))
+        return nn.Dense(self.n_actions)(x)
+
+
 class PolicyValueNet(nn.Module):
     hidden: tuple = (64, 64)
     action_dim: int = 1

@@ -132,7 +132,8 @@ def cast_floating(tree, dtype):
     return jax.tree.map(cast, tree)
 
 
-def policy_value_and_grad(scalar_loss, params, policy: PrecisionPolicy):
+def policy_value_and_grad(scalar_loss, params, policy: PrecisionPolicy,
+                          has_aux: bool = False):
     """``jax.value_and_grad`` under a policy — the one grad path all
     step builders share.
 
@@ -145,17 +146,22 @@ def policy_value_and_grad(scalar_loss, params, policy: PrecisionPolicy):
     all-reduce bytes at bf16), and the grads are cast back up to each
     master param's own dtype before the optimizer sees them (f32
     moments and updates; the accumulation discipline stays
-    ``accum_dtype``)."""
+    ``accum_dtype``).
+
+    ``has_aux`` mirrors ``jax.value_and_grad``: ``scalar_loss``
+    returns ``(loss, aux)`` and so does the value side — the RL step
+    builders use it to carry per-row TD errors out of the loss for
+    the in-jit priority write-back."""
     if policy.grad_reduce_dtype is None:
-        return jax.value_and_grad(scalar_loss)(params)
-    loss, grads = jax.value_and_grad(scalar_loss)(
+        return jax.value_and_grad(scalar_loss, has_aux=has_aux)(params)
+    value, grads = jax.value_and_grad(scalar_loss, has_aux=has_aux)(
         cast_floating(params, policy.grad_reduce_dtype)
     )
     grads = jax.tree.map(
         lambda g, p: g.astype(p.dtype) if hasattr(p, "dtype") else g,
         grads, params,
     )
-    return loss, grads
+    return value, grads
 
 
 __all__ = [

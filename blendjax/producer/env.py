@@ -88,6 +88,10 @@ class BaseEnv:
     def _pre_animation(self) -> None:
         # Episode start: reset env state + context (``btb/env.py:111-115``).
         self.ctx = {}
+        seed = getattr(self.agent, "reset_seed", None)
+        if seed is not None:
+            self.agent.reset_seed = None
+            self._env_seed(seed)
         self._env_reset()
 
     def _pre_frame(self, frame: int) -> None:
@@ -108,6 +112,16 @@ class BaseEnv:
         self.ctx.update(self._env_post_step())
 
     # -- to be implemented by scene envs ------------------------------------
+
+    def _env_seed(self, seed: int) -> None:
+        """Reseed the episode RNG before ``_env_reset`` (the remote
+        ``reset(seed=)`` landing point). Default: reseed ``self.scene``
+        when it exposes the sim-scene ``reseed`` hook; scene-less envs
+        override."""
+        scene = getattr(self, "scene", None)
+        reseed = getattr(scene, "reseed", None)
+        if reseed is not None:
+            reseed(seed)
 
     def _env_reset(self) -> None:
         raise NotImplementedError
@@ -140,6 +154,9 @@ class RemoteControlledAgent:
         self.real_time = real_time
         self.timeoutms = timeoutms
         self.state = self.STATE_INIT
+        # a reset(seed=) parks its seed here until the next episode
+        # start consumes it (BaseEnv._pre_animation)
+        self.reset_seed: int | None = None
 
     def __call__(self, env: BaseEnv, **ctx):
         if self.state == self.STATE_REP:
@@ -159,10 +176,19 @@ class RemoteControlledAgent:
 
         cmd = req.get("cmd")
         if cmd == "reset":
-            if self.state == self.STATE_INIT:
+            seed = req.get("seed")
+            if seed is not None:
+                # Parked for the next _pre_animation: the env reads and
+                # clears it before _env_reset, so the fresh episode's
+                # initial state draws from the requested seed (the
+                # Gymnasium reset(seed=) contract, producer side).
+                self.reset_seed = int(seed)
+            if self.state == self.STATE_INIT and seed is None:
                 # Episode just started and nothing was stepped: don't
                 # rewind again; step once so fresh obs exist to reply with
-                # (reset-dedup, ``btb/env.py:241-246``).
+                # (reset-dedup, ``btb/env.py:241-246``). A SEEDED reset
+                # must rewind regardless — the just-started episode drew
+                # from the launch seed, not the requested one.
                 self.state = self.STATE_REP
                 return CMD_STEP, None
             self.state = self.STATE_REP

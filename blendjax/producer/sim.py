@@ -342,6 +342,12 @@ class SimScene:
     def reset(self) -> None:  # rewind hook (AnimationController/Engine)
         pass
 
+    def reseed(self, seed: int) -> None:
+        """Replace the episode RNG — the landing point for a remote
+        ``reset(seed=)`` (:meth:`blendjax.producer.env.BaseEnv
+        ._env_seed`): two seeded resets start bit-identical episodes."""
+        self.rng = np.random.default_rng(int(seed))
+
     def step(self, frame: int) -> None:
         """Advance physics/randomization to ``frame``."""
         raise NotImplementedError

@@ -1,0 +1,53 @@
+"""blendjax.rl — device-resident trajectory replay and the mesh
+actor-learner stack that trains the gym layer.
+
+The last unopened workload from the paper's layer map (PAPER.md L4:
+``env/vector.py``, ``RemoteEnv``, the cartpole scene) wired into the
+machinery every prior PR built: transitions live in a donated sharded
+device ring generalized from the echo reservoir
+(:class:`TrajectoryReservoir`, uniform + prioritized sampling with
+in-jit TD-error priority updates), background actors drive
+fleet-admittable vector envs against a host-side policy snapshot
+(:class:`ActorPool`), and the learner samples at full step rate
+through ONE fused jit per step — gather + loss + donated update +
+priority write-back (:func:`make_dqn_step` / :func:`make_pg_step`,
+:class:`RLTrainDriver`). The fleet controller autoscales on the RL
+verdict vocabulary (:func:`diagnose_rl`: env-bound vs learner-bound),
+and the whole run checkpoints/resumes through the session store.
+
+See docs/rl.md for the end-to-end anatomy; the ``live_rl`` bench row
+trains cartpole end-to-end (local + 8-device CPU mesh + kill→resume)
+with ``dispatch_per_step == 1.0`` CI-asserted.
+"""
+
+from blendjax.rl.actor import ActorPool, HostQPolicy, np_mlp_forward
+from blendjax.rl.doctor import (
+    RL_VERDICTS,
+    diagnose_rl,
+    diagnose_rl_current,
+)
+from blendjax.rl.learner import RLTrainDriver
+from blendjax.rl.replay import TrajectoryReservoir
+from blendjax.rl.steps import (
+    RLTrainState,
+    make_dqn_step,
+    make_pg_step,
+    make_rl_train_state,
+    mesh_rl_step_kwargs,
+)
+
+__all__ = [
+    "ActorPool",
+    "HostQPolicy",
+    "RLTrainDriver",
+    "RLTrainState",
+    "RL_VERDICTS",
+    "TrajectoryReservoir",
+    "diagnose_rl",
+    "diagnose_rl_current",
+    "make_dqn_step",
+    "make_pg_step",
+    "make_rl_train_state",
+    "mesh_rl_step_kwargs",
+    "np_mlp_forward",
+]

@@ -1,0 +1,237 @@
+"""RLTrainDriver: the actor-learner loop as a MeshTrainDriver variant.
+
+The consumer side of the decoupled actor-learner stack: actors
+(:class:`~blendjax.rl.actor.ActorPool`) feed the
+:class:`~blendjax.rl.replay.TrajectoryReservoir` from their own
+thread while THIS driver samples at full step rate — every learner
+step is one token draw (host index composition) plus one fused
+dispatch (gather + loss + donated update + priority write-back, a
+:mod:`blendjax.rl.steps` builder), riding the completion-tracked
+dispatch ring, device-timeline metrics, and checkpoint plumbing the
+supervised :class:`~blendjax.train.MeshTrainDriver` already proved.
+
+What this subclass adds:
+
+- **token discipline**: :meth:`train_step` holds the reservoir lock
+  across ``compose -> draw_token -> submit`` so a concurrent actor
+  insert can never donate the ring out from under an un-dispatched
+  token (the echo pipeline gets this for free from its single-thread
+  draw loop; the actor-learner split needs the lock).
+- **policy sync**: every ``sync_every`` learner steps the actors get a
+  fresh HOST-side param snapshot (``jax.device_get`` on the learner's
+  thread, under the ``rl.policy_sync`` span — the one sanctioned
+  device fetch of the loop, at a declared cadence; the actor loop
+  itself stays device-free, the BJX115 contract).
+- **sample-wait accounting**: when the reservoir can't yet supply a
+  batch the learner blocks under the ``rl.sample_wait`` span and
+  counts ``rl.sample_waits`` — one half of the env-bound vs
+  learner-bound verdict (:func:`blendjax.rl.doctor.diagnose_rl`) the
+  fleet controller autoscales on.
+- **session state**: the default checkpoint session bundles the
+  reservoir, the actor pool, and the driver counters, so an RL run
+  checkpoints and resumes through the PR 11 session store like any
+  supervised run (``docs/rl.md`` "Checkpoint and resume").
+"""
+
+from __future__ import annotations
+
+# bjx: driver-hot-path (BJX106/BJX108 hold here exactly as in
+# driver.py; the policy-sync fetch below is the declared cadence sync)
+
+import time
+
+from blendjax.train.mesh_driver import MeshTrainDriver
+from blendjax.utils.metrics import metrics
+
+
+def _require_jax():
+    import jax
+
+    return jax
+
+
+class RLTrainDriver(MeshTrainDriver):
+    """Drive an RL learner against a reservoir + actor pool.
+
+    ``step`` is a :func:`blendjax.rl.steps.make_dqn_step` /
+    :func:`~blendjax.rl.steps.make_pg_step` product (its reservoir
+    must be THIS driver's ``reservoir``); ``state`` an
+    :class:`~blendjax.rl.steps.RLTrainState`. ``mesh`` defaults to a
+    pure-DP mesh over the available devices (size 1 single-chip), so
+    the same driver runs the laptop loop and the 8-device leg.
+
+    - ``batch_size``: transitions per learner step.
+    - ``min_fill``: reservoir transitions required before the first
+      step (defaults to ``batch_size``) — the warmup gate.
+    - ``sync_every`` doubles as BOTH the loss-sync cadence the base
+      driver keeps and the actor policy-refresh cadence.
+    - ``sample_timeout_s``: max seconds to block waiting for the
+      reservoir before raising (a dead actor pool must fail the run,
+      not hang it; :meth:`ActorPool.check` errors surface here too).
+    """
+
+    def __init__(self, step, state, reservoir, actors=None, *,
+                 mesh=None, batch_size: int = 32,
+                 min_fill: int | None = None,
+                 sample_timeout_s: float = 60.0, **driver_kwargs):
+        if mesh is None:
+            from blendjax.parallel import create_mesh
+
+            mesh = create_mesh({"data": -1})
+        self.reservoir = reservoir
+        self.actors = actors
+        self.batch_size = int(batch_size)
+        self.min_fill = int(min_fill if min_fill is not None
+                            else batch_size)
+        self.sample_timeout_s = float(sample_timeout_s)
+        self.sample_waits = 0
+        driver_kwargs.setdefault("session_state", self._session_state)
+        super().__init__(step, state, mesh, **driver_kwargs)
+
+    # -- the learner loop -----------------------------------------------------
+
+    def _wait_for_batch(self):
+        """Block (bounded) until the reservoir can compose a batch —
+        the learner's only wait, counted and spanned as the env-bound
+        evidence the RL doctor reads. A dead actor thread surfaces
+        HERE on every step (fast path included): a filled reservoir
+        keeps composing batches, and without the check the run would
+        silently train to completion on a frozen replay buffer."""
+        if self.actors is not None:
+            self.actors.check()
+        # ONE warmup gate (min_fill), checked BEFORE composing: a
+        # compose advances the sampling RNG (and can pay a priority-
+        # mirror refresh), so a below-fill call must not burn either
+        # just to discard the result
+        if self.reservoir.size >= self.min_fill:
+            composed = self.reservoir.compose(self.batch_size)
+            if composed is not None:
+                return composed
+        self.sample_waits += 1
+        metrics.count("rl.sample_waits")
+        deadline = time.monotonic() + self.sample_timeout_s
+        with metrics.span("rl.sample_wait"):
+            while True:
+                if self.actors is not None:
+                    self.actors.check()
+                if self.reservoir.size >= self.min_fill:
+                    composed = self.reservoir.compose(self.batch_size)
+                    if composed is not None:
+                        return composed
+                if time.monotonic() > deadline:
+                    raise TimeoutError(
+                        f"reservoir never reached {self.min_fill} "
+                        f"transitions within {self.sample_timeout_s}s "
+                        f"(size={self.reservoir.size}) — are the "
+                        "actors running?"
+                    )
+                time.sleep(0.002)
+
+    def train_step(self) -> None:
+        """One learner step: compose host indices, draw a token, and
+        dispatch the fused step — all under the reservoir lock, so a
+        concurrent actor insert can't donate the token's ring buffers
+        before the dispatch consumes them. The dispatch ring's
+        full-wait runs BEFORE the lock (``ensure_ring_slot``): in
+        steady state the ring IS full and submit would otherwise block
+        on device completion while holding the lock, serializing actor
+        inserts with learner device time — the locked section holds
+        only host index work + the async dispatch enqueue, so actor
+        inserts resume within microseconds."""
+        composed = self._wait_for_batch()
+        idx, weights = composed
+        self.ensure_ring_slot()
+        with self.reservoir.lock:
+            token = self.reservoir.draw_token(idx, weights)
+            self.submit(token, post=False)
+        # the cadenced step-boundary work — the blocking loss fetch
+        # and the checkpoint's session clone — runs OUTSIDE the lock:
+        # both can wait on the device, and an actor insert must not
+        # wait on them
+        self.post_dispatch()
+        if (
+            self.actors is not None and self.sync_every
+            and self.steps % self.sync_every == 0
+        ):
+            self._sync_policy()
+
+    def _sync_policy(self) -> None:
+        """Push a fresh host-side param snapshot to the actors — the
+        declared cadence fetch (every ``sync_every`` steps), blocking
+        only on the newest state's readiness like the loss sync does.
+        NOT part of the actor loop: BJX115 guards the other side.
+
+        The snapshot goes through a DEVICE-side copy first
+        (``jnp.array`` per leaf, then the host fetch reads the copy):
+        on the CPU backend a direct ``device_get``/``np.array`` of the
+        live params yields zero-copy views that alias — and therefore
+        pin — the donated param buffers, and a pinned buffer can't be
+        reused in place, so the next donated update silently
+        reallocated the whole state at exactly the sync cadence (the
+        donation audit caught this; the copy-then-fetch keeps the
+        audit's pointer-stability contract on every backend, sharded
+        params included)."""
+        jax = _require_jax()
+        import jax.numpy as jnp
+
+        with metrics.span("rl.policy_sync"):
+            # bjx: ignore[BJX106] — the sanctioned sync point, mirror
+            # of _sync_oldest: cadence-bounded by sync_every
+            snapshot = jax.device_get(
+                jax.tree.map(jnp.array, self.state.params)
+            )
+        self.actors.update_policy(snapshot)
+
+    def run_steps(self, n: int, max_seconds: float | None = None):
+        """Run ``n`` learner steps (bounded by ``max_seconds``);
+        returns the drained final loss."""
+        deadline = (
+            time.monotonic() + max_seconds if max_seconds else None
+        )
+        for _ in range(int(n)):
+            self.train_step()
+            if deadline is not None and time.monotonic() > deadline:
+                break
+        return self.drain()
+
+    # -- session snapshot (blendjax.checkpoint) -------------------------------
+
+    def _session_state(self) -> dict:
+        """Default checkpoint session for an RL run: reservoir ring +
+        priorities + draw state, actor counters + reward curve, and
+        (via the base driver) the step numbering — the PR 11 session
+        store carries all of it, so a killed run resumes mid-curve.
+
+        Both components snapshot under ONE hold of the reservoir lock:
+        taken separately, an actor insert landing between the two
+        state_dicts would leave the saved ``env_steps`` and reservoir
+        ``inserts`` permanently out of step after resume (the exact
+        accounting identity the bench asserts)."""
+        with self.reservoir.lock:
+            session = {"replay": self.reservoir.state_dict()}
+            if self.actors is not None:
+                session["actor"] = self.actors.state_dict()
+            return session
+
+    def restore_session(self, session: dict) -> list:
+        """Load the RL slices of a restored session (the inverse of
+        :meth:`_session_state`; driver counters restore through the
+        base ``load_state_dict`` under the ``driver`` key)."""
+        from blendjax.checkpoint.session import restore_session
+
+        return restore_session(
+            session, replay=self.reservoir, actor=self.actors,
+            driver=self,
+        )
+
+    @property
+    def stats(self) -> dict:
+        s = MeshTrainDriver.stats.fget(self)
+        s["sample_waits"] = self.sample_waits
+        s["reservoir"] = self.reservoir.stats
+        if self.actors is not None:
+            s["actor"] = self.actors.stats
+        return s
+
+
+__all__ = ["RLTrainDriver"]

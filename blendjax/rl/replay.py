@@ -1,0 +1,485 @@
+"""TrajectoryReservoir: device-resident replay over pytree transitions.
+
+The echo :class:`~blendjax.data.echo.SampleReservoir` is structurally a
+replay buffer — a donated sharded device ring with host-chosen indices
+and a traceable in-jit draw hook — and this class is its RL
+generalization (ROADMAP item 1): transitions are PYTREES
+(``obs``/``action``/``reward``/``done``/``next_obs`` plus any bootstrap
+metadata the actor attaches), storage is the shared ring core
+(:mod:`blendjax.data.ring`) preallocated on device and optionally
+sharded over the mesh ``data`` axis, and sampling supports uniform AND
+prioritized replay (Schaul et al., 2016) where the per-slot priority
+vector ALSO lives on device and is updated **in-jit** from TD error
+inside the learner's own dispatch — the scenario curriculum's
+loss-feedback pattern applied to replay.
+
+Invariants, inherited from echo and enforced the same ways:
+
+- ``insert`` is ONE jitted donated scatter that writes the transition
+  rows AND stamps the new slots' priorities to the running max in the
+  same dispatch — the ring and priority buffers are allocated once and
+  updated in place forever (the donation audit pins their pointers).
+- a learner step costs ONE device dispatch: :meth:`draw_token` hands
+  the builders (:mod:`blendjax.rl.steps`) the ring pytree + host index
+  vector, the gather happens inside the fused train jit, and the
+  priority write-back rides the same jit (the step commits the donated
+  priority buffer back via :meth:`commit_priorities`).
+- indices are chosen on the HOST: uniform draws from the filled-slot
+  set, prioritized draws from a host mirror of the device priorities
+  refreshed every ``priority_refresh_every`` draws (one small bounded
+  fetch at a declared cadence, under the ``rl.priority_sync`` span —
+  the standard slightly-stale distribution of distributed PER, never a
+  per-step sync). All fresh/replayed accounting runs against those
+  host indices, so the hot loop makes zero device round trips (the
+  BJX108/BJX115 discipline).
+
+Threading: the actor pool inserts from its own thread while the
+learner draws — every buffer-touching operation runs under one
+reentrant ``lock``, and the learner holds it across
+``draw_token -> fused dispatch -> commit_priorities`` (see
+:meth:`RLTrainDriver.train_step <blendjax.rl.learner.RLTrainDriver>`)
+so an insert can never donate the ring out from under an un-dispatched
+token.
+
+Metrics (the ``rl.*`` catalog, docs/observability.md): counters
+``rl.transitions`` (rows inserted) / ``rl.fresh`` / ``rl.replayed``
+(first-use vs repeat draws; ``fresh + replayed == draws * batch``
+exactly), gauges ``rl.reservoir_fill`` / ``rl.replay_ratio``,
+histogram ``rl.sample_age_s``, spans ``rl.insert`` / ``rl.sample`` /
+``rl.priority_sync``.
+"""
+
+from __future__ import annotations
+
+# bjx: driver-hot-path (BJX106/BJX108: accounting runs on host-chosen
+# indices; the one sanctioned priority-mirror fetch is cadence-bounded
+# and marked below)
+
+import threading
+import time
+
+import numpy as np
+
+from blendjax.utils.logging import get_logger
+from blendjax.utils.metrics import metrics
+
+logger = get_logger("rl")
+
+
+def _require_jax():
+    import jax  # deferred: producer processes never import jax
+
+    return jax
+
+
+class TrajectoryReservoir:
+    """Device-resident ring of the last ``capacity`` transitions.
+
+    - ``capacity``: ring size in transitions (must divide the sharded
+      axis when ``mesh``/``sharding`` is given).
+    - ``prioritized``: enable proportional prioritized sampling
+      (``p_i^alpha``); priorities start at the running max for new
+      rows (every transition is drawn at least once at full weight)
+      and are overwritten in-jit by the learner's TD magnitudes.
+    - ``alpha`` / ``beta``: the usual PER exponents — sampling
+      sharpness and importance-weight correction. Weights are
+      normalized by their batch max and ride the draw token as
+      ``_rl_weights`` (all-ones under uniform sampling, so one loss
+      implementation serves both modes).
+    - ``priority_refresh_every``: draws between host-mirror refreshes
+      of the device priority vector.
+    - ``mesh`` / ``sharding``: shard ring + priorities over the mesh
+      ``data`` axis (:func:`blendjax.parallel.ring_sharding`) —
+      capacity scales with the mesh and drawn batches leave in the
+      feeder's batch layout, exactly like the echo ring.
+    """
+
+    def __init__(
+        self,
+        capacity: int,
+        rng=0,
+        mesh=None,
+        sharding=None,
+        prioritized: bool = False,
+        alpha: float = 0.6,
+        beta: float = 0.4,
+        priority_eps: float = 1e-3,
+        priority_refresh_every: int = 16,
+    ):
+        from blendjax.data.ring import validate_ring_capacity
+
+        self.capacity = int(capacity)
+        if self.capacity < 1:
+            raise ValueError(f"capacity must be >= 1, got {capacity}")
+        if sharding is None and mesh is not None:
+            from blendjax.parallel.sharding import ring_sharding
+
+            sharding = ring_sharding(mesh)
+        validate_ring_capacity(self.capacity, sharding)
+        self.sharding = sharding
+        self.prioritized = bool(prioritized)
+        self.alpha = float(alpha)
+        self.beta = float(beta)
+        self.priority_eps = float(priority_eps)
+        self.priority_refresh_every = max(1, int(priority_refresh_every))
+        self.lock = threading.RLock()
+        seed = rng if isinstance(rng, int) else 0
+        self._np_rng = np.random.default_rng(seed)
+        self._buffers = None  # device ring pytree (dict)
+        self._priorities = None  # device (capacity,) f32
+        self._spec: dict | None = None
+        self._treedef = None
+        self._insert_fn = None
+        self._gather_fn = None
+        self._cursor = 0
+        self.size = 0
+        self.inserts = 0  # transitions inserted, lifetime
+        self._draws = 0  # draw-token/sample calls, lifetime
+        self._pmax = 1.0  # running max priority (host scalar)
+        # Host-side per-slot accounting (numpy, never device values):
+        self._use = np.zeros(self.capacity, np.int64)
+        self._t_insert = np.zeros(self.capacity, np.float64)
+        self._filled = np.zeros(self.capacity, bool)
+        # Host mirror of the device priorities, refreshed at cadence —
+        # the distribution prioritized composition samples from.
+        self._prio_host = np.ones(self.capacity, np.float32)
+        self._draws_at_refresh = 0
+        # lifetime stats (mirrored into the registry as exact counters)
+        self.fresh = 0
+        self.replayed = 0
+
+    # -- lazy jit construction ----------------------------------------------
+
+    def _build(self, fields: dict, initial=None, prio_initial=None) -> None:
+        jax = _require_jax()
+        import jax.numpy as jnp
+
+        from blendjax.data.ring import (
+            allocate_ring,
+            make_ring_gather,
+            ring_slot_update,
+            ring_spec,
+        )
+
+        self._spec = ring_spec(fields)
+        self._treedef = jax.tree.structure(fields)
+        self._buffers = allocate_ring(
+            self.capacity, fields=fields, sharding=self.sharding,
+            initial=initial,
+        )
+        if prio_initial is not None:
+            prio = jnp.asarray(np.asarray(prio_initial, np.float32))
+        else:
+            prio = jnp.ones((self.capacity,), jnp.float32)
+        if self.sharding is not None:
+            prio = jax.device_put(prio, self.sharding)
+        self._priorities = prio
+        capacity = self.capacity
+
+        # ONE donated dispatch writes the transition rows AND the new
+        # slots' priorities (stamped to the running max so fresh
+        # transitions are drawn at full weight before their first TD
+        # evidence exists). Donating both keeps ring + priority memory
+        # flat and their buffer pointers stable — the audit contract.
+        def _insert(bufs, prio, batch, cursor, pmax):
+            bufs = ring_slot_update(capacity, bufs, batch, cursor)
+            lead = jax.tree.leaves(batch)[0].shape[0]
+            idx = (cursor + jnp.arange(lead)) % capacity
+            return bufs, prio.at[idx].set(pmax)
+
+        sh = self.sharding
+        self._insert_fn = jax.jit(
+            _insert, donate_argnums=(0, 1),
+            **({"out_shardings": (sh, sh)} if sh is not None else {}),
+        )
+        self._gather_fn = make_ring_gather(sh)
+
+    # -- operations -----------------------------------------------------------
+
+    def insert(self, transitions: dict) -> np.ndarray:
+        """Write one batch of transitions (pytree of arrays sharing a
+        leading dim); returns the HOST slot-index vector for the
+        caller's accounting. Thread-safe: the actor pool calls this
+        from its own thread while the learner draws."""
+        jax = _require_jax()
+
+        leaves = jax.tree.leaves(transitions)
+        if not leaves:
+            raise ValueError("insert() needs at least one array field")
+        lead = int(leaves[0].shape[0])
+        if any(v.shape[0] != lead for v in leaves):
+            raise ValueError(
+                "transition fields must share one leading dim; got "
+                f"{[v.shape[0] for v in leaves]}"
+            )
+        if lead > self.capacity:
+            transitions = jax.tree.map(
+                lambda v: v[-self.capacity:], transitions
+            )
+            lead = self.capacity
+        with self.lock:
+            if self._buffers is None:
+                self._build(transitions)
+            else:
+                from blendjax.data.ring import ring_spec
+
+                if jax.tree.structure(transitions) != self._treedef:
+                    raise ValueError(
+                        "transition structure changed: reservoir holds "
+                        f"{self._treedef}, insert got "
+                        f"{jax.tree.structure(transitions)}"
+                    )
+                spec = ring_spec(transitions)
+                for k, (shape, dtype) in spec.items():
+                    eshape, edtype = self._spec[k]
+                    if shape != eshape or dtype != edtype:
+                        raise ValueError(
+                            f"field {k}: got {shape}/{dtype}, reservoir "
+                            f"holds {eshape}/{edtype}"
+                        )
+            with metrics.span("rl.insert"):
+                self._buffers, self._priorities = self._insert_fn(
+                    self._buffers, self._priorities, transitions,
+                    np.int32(self._cursor % self.capacity),
+                    np.float32(self._pmax),
+                )
+            slots = (self._cursor + np.arange(lead)) % self.capacity
+            self._cursor = (self._cursor + lead) % self.capacity
+            self.size = min(self.size + lead, self.capacity)
+            self.inserts += lead
+            self._use[slots] = 0
+            self._t_insert[slots] = time.monotonic()
+            self._filled[slots] = True
+            self._prio_host[slots] = self._pmax
+        metrics.count("rl.transitions", lead)
+        metrics.gauge("rl.reservoir_fill", int(self._filled.sum()))
+        return slots
+
+    # -- host-side draw composition -------------------------------------------
+
+    def _refresh_priorities(self) -> None:
+        """Cadence-bounded host mirror of the device priority vector —
+        the sanctioned fetch prioritized composition samples from. One
+        small (capacity,) transfer every ``priority_refresh_every``
+        draws, never per step."""
+        with metrics.span("rl.priority_sync"):
+            # bjx: ignore[BJX108] — the declared cadence-bounded mirror
+            # fetch, not a per-draw materialization (np.array copies:
+            # the zero-copy asarray view of a jax buffer is read-only)
+            self._prio_host = np.array(self._priorities, np.float32)
+        np.maximum(self._prio_host, self.priority_eps, out=self._prio_host)
+        if self._filled.any():
+            # the TRUE running max, even once converged |TD| falls
+            # below 1.0 — a floor here would stamp every fresh insert
+            # far above the real distribution and skew sampling toward
+            # recency (an empty ring keeps the previous pmax)
+            self._pmax = float(self._prio_host[self._filled].max())
+        self._draws_at_refresh = self._draws
+
+    def compose(self, batch_size: int):
+        """Pick ``batch_size`` slot indices (with replacement, the
+        replay-buffer convention — a batch may exceed the resident
+        count) plus their importance weights, or ``None`` while the
+        reservoir is empty (the learner's ``min_fill`` gate decides
+        how much warmup beyond non-empty to demand). Host work only."""
+        b = int(batch_size)
+        with self.lock:
+            if self.size < 1 or self._buffers is None:
+                return None
+            slots = np.flatnonzero(self._filled)
+            if self.prioritized:
+                if (
+                    self._draws - self._draws_at_refresh
+                    >= self.priority_refresh_every
+                ):
+                    self._refresh_priorities()
+                p = self._prio_host[slots].astype(np.float64) ** self.alpha
+                p /= p.sum()
+                idx = self._np_rng.choice(slots, size=b, p=p)
+                # importance correction against the stale mirror (the
+                # same distribution the draw used), max-normalized
+                chosen = p[np.searchsorted(slots, idx)]
+                w = (len(slots) * chosen) ** -self.beta
+                weights = (w / w.max()).astype(np.float32)
+            else:
+                idx = self._np_rng.choice(slots, size=b)
+                weights = np.ones(b, np.float32)
+        return np.asarray(idx, np.int32), weights
+
+    # -- draws ----------------------------------------------------------------
+
+    def _account_draw(self, idx: np.ndarray) -> None:
+        # Accounting runs on the HOST index vector (BJX108): fresh
+        # counts FIRST USES — a slot drawn twice in one batch is one
+        # fresh + one replay, so fresh can never exceed inserts and
+        # fresh + replayed == draws * batch exactly.
+        first = np.zeros(len(idx), bool)
+        first[np.unique(idx, return_index=True)[1]] = True
+        fresh_rows = first & (self._use[idx] == 0)
+        fresh_n = int(fresh_rows.sum())
+        np.add.at(self._use, idx, 1)
+        self.fresh += fresh_n
+        self.replayed += len(idx) - fresh_n
+        self._draws += 1
+        metrics.count("rl.draws")
+        metrics.count("rl.fresh", fresh_n)
+        metrics.count("rl.replayed", len(idx) - fresh_n)
+        metrics.observe_many(
+            "rl.sample_age_s", time.monotonic() - self._t_insert[idx]
+        )
+        drawn = self.fresh + self.replayed
+        metrics.gauge(
+            "rl.replay_ratio",
+            round(self.replayed / drawn, 4) if drawn else 0.0,
+        )
+
+    def draw_token(self, idx, weights=None) -> dict:
+        """Compose one fused-draw token — the dict the
+        :mod:`blendjax.rl.steps` builders consume: ring pytree +
+        device priorities (donated into the learner jit for the in-jit
+        TD write-back) + host indices + importance weights. No device
+        work happens here.
+
+        Lifetime: like the echo token, the buffers ride by reference
+        and the NEXT donated insert consumes them — hold :attr:`lock`
+        from token creation through the fused dispatch (the learner
+        driver does)."""
+        if self._buffers is None:
+            raise RuntimeError("reservoir is empty: insert() first")
+        idx = np.asarray(idx, np.int32)
+        if weights is None:
+            weights = np.ones(len(idx), np.float32)
+        with self.lock:
+            self._account_draw(idx)
+            return {
+                "_rl_buffers": self._buffers,
+                "_rl_prio": self._priorities,
+                "_rl_idx": idx,
+                "_rl_weights": np.asarray(weights, np.float32),
+            }
+
+    def commit_priorities(self, new_priorities) -> None:
+        """Accept the learner jit's updated (donated-in-place) priority
+        buffer back. Called by the step wrapper while the learner holds
+        :attr:`lock`."""
+        with self.lock:
+            self._priorities = new_priorities
+
+    def draw(self, buffers, idx):
+        """The traceable gather body — the hook the step builders call
+        INSIDE the fused learner jit (same pattern as
+        ``SampleReservoir.draw`` / ``make_echo_fused_step``)."""
+        from blendjax.data.ring import ring_gather
+
+        return ring_gather(buffers, idx)
+
+    def sample(self, idx) -> dict:
+        """Eager jitted gather of ``idx`` rows (inspection/tests; the
+        learner hot path fuses the gather via :meth:`draw_token`).
+        Advances the same accounting the fused path uses."""
+        if self._buffers is None:
+            raise RuntimeError("reservoir is empty: insert() first")
+        idx = np.asarray(idx, np.int32)
+        with self.lock:
+            self._account_draw(idx)
+            with metrics.span("rl.sample"):
+                return self._gather_fn(self._buffers, idx)
+
+    @property
+    def fields(self) -> tuple:
+        return tuple(self._spec) if self._spec else ()
+
+    @property
+    def stats(self) -> dict:
+        drawn = self.fresh + self.replayed
+        return {
+            "size": self.size,
+            "inserts": self.inserts,
+            "draws": self._draws,
+            "fresh": self.fresh,
+            "replayed": self.replayed,
+            "replay_ratio": round(self.replayed / drawn, 4) if drawn else None,
+            "prioritized": self.prioritized,
+            "pmax": round(self._pmax, 6),
+        }
+
+    # -- session snapshot (blendjax.checkpoint) -------------------------------
+
+    def state_dict(self) -> dict:
+        """Ring + priorities + host accounting + RNG state — everything
+        a resumed RL run needs to keep drawing the same distribution.
+
+        Unlike the echo reservoir (whose inserts run on the same
+        thread that snapshots), the ring here is donated-into by the
+        ACTOR thread — so the snapshot takes device-side CLONES under
+        the lock rather than riding by reference: a by-reference ring
+        would be deleted by the next actor insert before the snapshot
+        writer could materialize it. A few copy dispatches at
+        checkpoint cadence, never in the learner hot loop. Insert
+        times are stored as ages; monotonic clocks don't survive a
+        process boundary."""
+        import jax.numpy as jnp
+
+        with self.lock:
+            now = time.monotonic()
+            d = {
+                "capacity": self.capacity,
+                "cursor": self._cursor,
+                "size": self.size,
+                "inserts": self.inserts,
+                "draws": self._draws,
+                "fresh": self.fresh,
+                "replayed": self.replayed,
+                "pmax": self._pmax,
+                "prioritized": self.prioritized,
+                "use": self._use.copy(),
+                "filled": self._filled.copy(),
+                "age_s": now - self._t_insert,
+                "prio_host": self._prio_host.copy(),
+                "rng": self._np_rng.bit_generator.state,
+                "built": self._buffers is not None,
+            }
+            if self._buffers is not None:
+                jax = _require_jax()
+                d["buffers"] = jax.tree.map(jnp.array, dict(self._buffers))
+                d["priorities"] = jnp.array(self._priorities)
+            return d
+
+    def load_state_dict(self, d: dict) -> None:
+        """Rebuild under the CURRENT sharding (an 8-chip snapshot
+        restores onto a 4-chip ring by plain re-placement). Restoring
+        the draw counters + RNG state makes the resumed sampling
+        sequence continue the uninterrupted run's."""
+        if int(d["capacity"]) != self.capacity:
+            raise ValueError(
+                f"snapshot reservoir capacity {d['capacity']} != "
+                f"configured {self.capacity}"
+            )
+        with self.lock:
+            self._cursor = int(d["cursor"])
+            self.size = int(d["size"])
+            self.inserts = int(d["inserts"])
+            self._draws = int(d["draws"])
+            self.fresh = int(d.get("fresh", 0))
+            self.replayed = int(d.get("replayed", 0))
+            self._pmax = float(d.get("pmax", 1.0))
+            self._use = np.asarray(d["use"], np.int64).copy()
+            self._filled = np.asarray(d["filled"], bool).copy()
+            now = time.monotonic()
+            self._t_insert = now - np.asarray(d["age_s"], np.float64)
+            self._prio_host = np.asarray(
+                d["prio_host"], np.float32
+            ).copy()
+            self._np_rng.bit_generator.state = d["rng"]
+            self._draws_at_refresh = self._draws
+            if not d.get("built"):
+                return
+            jax = _require_jax()
+            bufs = jax.tree.map(np.asarray, d["buffers"])
+            self._build(
+                bufs, initial=bufs,
+                prio_initial=np.asarray(d["priorities"], np.float32),
+            )
+
+
+__all__ = ["TrajectoryReservoir"]

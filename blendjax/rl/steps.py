@@ -1,0 +1,311 @@
+"""RL learner steps: gather + loss + donated update + priority
+write-back in ONE jit.
+
+Built in the :mod:`blendjax.train.steps` idiom — a ``make_*_step``
+factory returns ``step(state, token) -> (state, metrics)`` that
+composes with :class:`~blendjax.train.TrainDriver` unchanged — with
+the echo-fusion trick applied to replay: the ``token`` is what
+:meth:`TrajectoryReservoir.draw_token
+<blendjax.rl.replay.TrajectoryReservoir.draw_token>` yields (ring
+pytree + device priorities + host indices + importance weights), the
+transition gather happens INSIDE the train jit via the reservoir's
+traceable ``draw`` hook, and — the new piece — the per-slot priority
+vector is DONATED into the same jit and scattered with fresh
+``|TD|`` magnitudes before it returns. Sampling, loss, update, and
+the prioritized-replay feedback loop are one device dispatch
+(``dispatch_per_step == 1.0`` on the learner path, CI-asserted in
+the bench ``live_rl`` row).
+
+Two losses:
+
+- :func:`make_dqn_step` — (double) DQN over
+  ``{obs, action, reward, done, next_obs}`` transitions with Huber TD
+  loss, importance weights, and an in-jit Polyak target network (the
+  target params live INSIDE the train state —
+  :class:`RLTrainState` — so target maintenance never costs a second
+  dispatch or a host-cadence clone).
+- :func:`make_pg_step` — REINFORCE-style policy gradient over
+  transitions carrying a precomputed ``ret`` (discounted return)
+  field, softmax over discrete actions + entropy bonus. Priorities
+  update to ``|advantage|`` so prioritized draws favor surprising
+  episodes.
+
+Mesh path: pass ``state_sharding`` (from
+:func:`blendjax.parallel.state_shardings`) and the reservoir's ring
+sharding is pinned into the jit's buffer/priority arguments
+automatically — the same pinned-layout discipline as
+``make_mesh_echo_fused_step``, so the donated update can never drift
+the (potentially multi-GB) ring's placement mid-run.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import optax
+from flax.training.train_state import TrainState
+
+from blendjax.train.precision import policy_value_and_grad, resolve_policy
+
+
+class RLTrainState(TrainState):
+    """TrainState + the DQN target network, as ONE pytree.
+
+    Keeping ``target_params`` inside the state means the Polyak update
+    rides the fused learner jit (no separate target-sync dispatch, no
+    donated-buffer cloning at a host cadence) and the pinned
+    ``state_shardings`` tree covers it for free on the mesh path."""
+
+    target_params: Any = None
+
+
+def make_rl_train_state(model, example_obs, optimizer=None,
+                        learning_rate: float = 1e-3, rng=None,
+                        mesh=None, target: bool = True) -> RLTrainState:
+    """Init an :class:`RLTrainState` (params sharded onto ``mesh`` per
+    the default rules; ``target=True`` clones them into the target
+    network — distinct buffers, both donated through the step)."""
+    from blendjax.parallel.sharding import param_sharding_rules
+
+    rng = rng if rng is not None else jax.random.key(0)
+    optimizer = optimizer or optax.adam(learning_rate)
+    params = model.init(rng, example_obs)["params"]
+    if mesh is not None:
+        params = jax.tree_util.tree_map_with_path(
+            lambda p, v: jax.device_put(
+                v, param_sharding_rules(mesh, p, v)
+            ),
+            params,
+        )
+    target_params = (
+        jax.tree.map(jnp.array, params) if target else None
+    )
+    return RLTrainState.create(
+        apply_fn=model.apply, params=params, tx=optimizer,
+        target_params=target_params,
+    )
+
+
+def _rl_jit_kwargs(state_sharding, buffer_sharding,
+                   with_prio_out: bool = True) -> dict:
+    """jit kwargs pinning the learner step's layouts: the state tree
+    explicit, the ring buffers + priority vector pinned to the ring
+    sharding (a drifted placement fails loudly at dispatch instead of
+    silently resharding the ring every step), host idx/weights left
+    for jit to infer. ``None`` everywhere keeps the plain
+    propagate-from-arrays jit."""
+    if state_sharding is None and buffer_sharding is None:
+        return {}
+    # args: (state, buffers, prio, idx, weights)
+    in_sh = [state_sharding, buffer_sharding, buffer_sharding, None, None]
+    out = [state_sharding]
+    if with_prio_out:
+        out.append(buffer_sharding)
+    out.append(None)  # metrics
+    return {"in_shardings": tuple(in_sh), "out_shardings": tuple(out)}
+
+
+def mesh_rl_step_kwargs(state, mesh, data_axis: str = "data") -> dict:
+    """The mesh hook pair for either builder, mirroring
+    :func:`blendjax.train.mesh_driver.make_mesh_echo_fused_step`:
+    ``state_sharding`` pinned from the concrete state (the donated
+    update can never drift layouts) and a ``draw_constraint`` that
+    re-shards the just-gathered transition batch over the batch axis
+    inside the jit. Usage::
+
+        step = make_dqn_step(reservoir, model.apply,
+                             **mesh_rl_step_kwargs(state, mesh))
+    """
+    from blendjax.parallel.sharding import batch_sharding, state_shardings
+
+    if data_axis not in mesh.axis_names:
+        # same build-time failure as make_mesh_fused_step: a typo'd
+        # batch axis would silently train replicated
+        raise ValueError(
+            f"data_axis {data_axis!r} is not an axis of mesh "
+            f"{dict(mesh.shape)}"
+        )
+    bs = batch_sharding(mesh, axis=data_axis)
+
+    def _pin_drawn_batch(batch):
+        return jax.tree.map(
+            lambda v: (
+                jax.lax.with_sharding_constraint(v, bs)
+                if getattr(v, "ndim", 0) >= 1 else v
+            ),
+            batch,
+        )
+
+    return {
+        "state_sharding": state_shardings(state, mesh=mesh),
+        "draw_constraint": _pin_drawn_batch,
+    }
+
+
+def make_dqn_step(
+    reservoir,
+    apply_fn,
+    gamma: float = 0.99,
+    tau: float = 0.01,
+    double: bool = True,
+    priority_eps: float = 1e-3,
+    donate: bool = True,
+    precision=None,
+    state_sharding=None,
+    draw_constraint=None,
+):
+    """Build the one-dispatch DQN learner step.
+
+    ``reservoir`` is the :class:`~blendjax.rl.replay
+    .TrajectoryReservoir` whose tokens this step consumes — its
+    traceable ``draw`` hook runs inside the jit, and its updated
+    priority buffer is committed back after each dispatch (the step
+    wrapper holds that handshake so callers never see the donated
+    buffer). ``apply_fn`` is the Q-network's ``model.apply``;
+    transitions must carry ``obs``/``action`` (int indices)/
+    ``reward``/``done``/``next_obs``.
+
+    ``tau`` is the per-step Polyak coefficient for the in-state target
+    network (``tau=1.0`` degenerates to no target, ``tau=0`` freezes
+    it); ``double=True`` selects actions with the online net and
+    evaluates them with the target (van Hasselt et al., 2016).
+    ``draw_constraint`` re-shards the just-gathered batch on the mesh
+    path (the ``make_mesh_echo_fused_step`` hook)."""
+    policy = resolve_policy(precision)
+    pin = draw_constraint or (lambda b: b)
+    draw = reservoir.draw
+    buffer_sharding = reservoir.sharding
+
+    def _fused(state, buffers, prio, idx, weights):
+        batch = pin(draw(buffers, idx))
+        obs = batch["obs"].astype(jnp.float32)
+        act = batch["action"].astype(jnp.int32).reshape(-1)
+        reward = batch["reward"].astype(jnp.float32).reshape(-1)
+        done = batch["done"].astype(jnp.float32).reshape(-1)
+        next_obs = batch["next_obs"].astype(jnp.float32)
+
+        def scalar_loss(params):
+            q = apply_fn({"params": params}, obs)
+            qa = jnp.take_along_axis(q, act[:, None], axis=1)[:, 0]
+            q_next_t = apply_fn({"params": state.target_params}, next_obs)
+            if double:
+                q_next_o = apply_fn({"params": params}, next_obs)
+                a_star = jnp.argmax(q_next_o, axis=-1)
+                next_v = jnp.take_along_axis(
+                    q_next_t, a_star[:, None], axis=1
+                )[:, 0]
+            else:
+                next_v = q_next_t.max(axis=-1)
+            target = reward + gamma * (1.0 - done) * next_v
+            td = qa - jax.lax.stop_gradient(target)
+            loss = (weights * optax.huber_loss(td)).mean()
+            return loss, td
+
+        (loss, td), grads = policy_value_and_grad(
+            scalar_loss, state.params, policy, has_aux=True
+        )
+        state = state.apply_gradients(grads=grads)
+        state = state.replace(
+            target_params=jax.tree.map(
+                lambda t, p: (1.0 - tau) * t + tau * p,
+                state.target_params, state.params,
+            )
+        )
+        # the prioritized-replay feedback: per-slot |TD| scattered into
+        # the donated priority buffer INSIDE this same dispatch — the
+        # curriculum's loss-feedback pattern applied to replay
+        new_prio = prio.at[idx].set(jnp.abs(td) + priority_eps)
+        return state, new_prio, {"loss": loss}
+
+    fused = jax.jit(
+        _fused,
+        donate_argnums=(0, 2) if donate else (),
+        **_rl_jit_kwargs(state_sharding, buffer_sharding),
+    )
+
+    def step(state, token):
+        state, new_prio, m = fused(
+            state, token["_rl_buffers"], token["_rl_prio"],
+            token["_rl_idx"], token["_rl_weights"],
+        )
+        reservoir.commit_priorities(new_prio)
+        return state, m
+
+    return step
+
+
+def make_pg_step(
+    reservoir,
+    apply_fn,
+    entropy_coef: float = 0.01,
+    priority_eps: float = 1e-3,
+    donate: bool = True,
+    precision=None,
+    state_sharding=None,
+    draw_constraint=None,
+):
+    """Build the one-dispatch policy-gradient learner step.
+
+    REINFORCE over reservoir transitions that carry a precomputed
+    discounted-return ``ret`` field (the actor's ``extra_fields`` hook
+    attaches it at episode end): softmax policy over discrete
+    ``action`` indices, loss ``-(w * logpi(a|s) * ret).mean()`` minus
+    an entropy bonus. ``apply_fn`` maps obs to action logits (a
+    :class:`~blendjax.models.QNetwork`-shaped head works). Priorities
+    update to ``|ret - baseline|`` (the batch-mean baseline), so
+    prioritized draws favor surprising episodes. Same token protocol,
+    donation, and pinned-sharding treatment as :func:`make_dqn_step` —
+    and the same single-dispatch contract."""
+    policy = resolve_policy(precision)
+    pin = draw_constraint or (lambda b: b)
+    draw = reservoir.draw
+    buffer_sharding = reservoir.sharding
+
+    def _fused(state, buffers, prio, idx, weights):
+        batch = pin(draw(buffers, idx))
+        obs = batch["obs"].astype(jnp.float32)
+        act = batch["action"].astype(jnp.int32).reshape(-1)
+        ret = batch["ret"].astype(jnp.float32).reshape(-1)
+
+        def scalar_loss(params):
+            logits = apply_fn({"params": params}, obs)
+            logp = jax.nn.log_softmax(logits, axis=-1)
+            lp_a = jnp.take_along_axis(logp, act[:, None], axis=1)[:, 0]
+            adv = ret - jax.lax.stop_gradient(ret.mean())
+            pg = -(weights * lp_a * jax.lax.stop_gradient(adv)).mean()
+            entropy = -(jnp.exp(logp) * logp).sum(-1).mean()
+            return pg - entropy_coef * entropy, adv
+
+        (loss, adv), grads = policy_value_and_grad(
+            scalar_loss, state.params, policy, has_aux=True
+        )
+        state = state.apply_gradients(grads=grads)
+        new_prio = prio.at[idx].set(jnp.abs(adv) + priority_eps)
+        return state, new_prio, {"loss": loss}
+
+    fused = jax.jit(
+        _fused,
+        donate_argnums=(0, 2) if donate else (),
+        **_rl_jit_kwargs(state_sharding, buffer_sharding),
+    )
+
+    def step(state, token):
+        state, new_prio, m = fused(
+            state, token["_rl_buffers"], token["_rl_prio"],
+            token["_rl_idx"], token["_rl_weights"],
+        )
+        reservoir.commit_priorities(new_prio)
+        return state, m
+
+    return step
+
+
+__all__ = [
+    "RLTrainState",
+    "make_dqn_step",
+    "make_pg_step",
+    "make_rl_train_state",
+    "mesh_rl_step_kwargs",
+]

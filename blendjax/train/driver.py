@@ -206,9 +206,12 @@ class TrainDriver:
         decoded (K, B, H, W, C) superbatches count K*B; plain batches
         their leading dim. Shape reads only — no device values."""
         idx = batch.get("_echo_idx")
+        if idx is None:
+            idx = batch.get("_rl_idx")
         if idx is not None:
-            # fused echo draw token: the host index vector names every
-            # image the step trains on (the gather runs inside the jit)
+            # fused draw token (echo or RL replay): the host index
+            # vector names every sample the step trains on (the gather
+            # runs inside the jit)
             return int(len(idx))
         packed = batch.get("_packed")
         if packed is not None:
@@ -234,8 +237,26 @@ class TrainDriver:
         )
         return int(lead)
 
-    def submit(self, batch) -> None:
-        """Dispatch one step without waiting on its result."""
+    def ensure_ring_slot(self) -> None:
+        """Retire finished in-flight entries (non-blocking completion
+        poll) and, when the ring is genuinely full, block on the
+        oldest until a slot frees. ``submit`` runs this before every
+        dispatch; callers that must not hold a lock across a device
+        wait (the RL learner holds the reservoir lock across its
+        dispatch) call it themselves FIRST, so the locked section
+        contains only the async dispatch enqueue."""
+        pending = self._pending
+        while pending and self._is_done(pending[0][0]):
+            self._retire(pending.popleft())  # completion tracking
+        while len(pending) >= self.inflight:
+            self._block_oldest()
+
+    def submit(self, batch, post: bool = True) -> None:
+        """Dispatch one step without waiting on its result. ``post``
+        controls whether the cadenced step-boundary work
+        (:meth:`post_dispatch`) runs before returning — callers that
+        dispatch inside a critical section pass ``post=False`` and run
+        it themselves after releasing the lock."""
         if self.preempt is not None and self.preempt.requested:
             self._preempt_flush()
         if (
@@ -265,16 +286,13 @@ class TrainDriver:
         images = self._batch_images(batch)
         if self._t_first_dispatch is None:
             self._t_first_dispatch = time.monotonic()
-        pending = self._pending
-        while pending and self._is_done(pending[0][0]):
-            self._retire(pending.popleft())  # completion tracking
-        while len(pending) >= self.inflight:
-            self._block_oldest()
+        self.ensure_ring_slot()
         with metrics.span("train.dispatch"):
             self.state, m = self.step(self.state, batch)
         metrics.count("train.dispatches")
         self.dispatches += 1
         self.steps += 1
+        pending = self._pending
         pending.append([m["loss"], time.monotonic(), images, traces])
         if len(pending) > self.inflight_hwm:
             self.inflight_hwm = len(pending)
@@ -284,6 +302,18 @@ class TrainDriver:
         # reset) silently lost the gauge forever — the instance hwm,
         # pinned during warmup, never grew again.
         metrics.gauge_max("train.inflight_hwm", len(pending))
+        if post:
+            self.post_dispatch()
+
+    def post_dispatch(self) -> None:
+        """The cadenced step-boundary work ``submit`` runs after each
+        dispatch: the periodic loss fetch (a BLOCKING d2h of the
+        oldest in-flight value) and the checkpoint hand-off (a
+        session-state collection + device clones). Factored out so
+        callers that dispatch under a lock (the RL learner holds the
+        reservoir lock across its dispatch enqueue) can run this part
+        OUTSIDE it — neither belongs in a critical section another
+        thread waits on."""
         if self.sync_every and self.steps % self.sync_every == 0:
             self._sync_oldest()
         if self.checkpoint is not None and (

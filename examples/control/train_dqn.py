@@ -1,0 +1,128 @@
+"""DQN on cartpole through the full blendjax.rl stack (docs/rl.md).
+
+Where ``train_reinforce.py`` collects synchronous rollouts by hand,
+this example runs the production actor-learner shape: background
+actors drive a fleet of remote cartpole producers against a host-side
+policy snapshot, transitions land in the device-resident
+``TrajectoryReservoir`` (prioritized by default), and the learner
+trains at full step rate with ONE fused device dispatch per step —
+gather + TD loss + donated update + in-jit priority write-back.
+``--checkpoint DIR`` arms the session store so a killed run resumes
+mid-curve (``--resume``).
+
+Run: ``python examples/control/train_dqn.py --steps 400``
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+
+import numpy as np
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--envs", type=int, default=2)
+    ap.add_argument("--steps", type=int, default=400,
+                    help="learner steps")
+    ap.add_argument("--batch", type=int, default=32)
+    ap.add_argument("--capacity", type=int, default=512)
+    ap.add_argument("--lr", type=float, default=1e-3)
+    ap.add_argument("--gamma", type=float, default=0.98)
+    ap.add_argument("--uniform", action="store_true",
+                    help="uniform instead of prioritized replay")
+    ap.add_argument("--sync-every", type=int, default=8,
+                    help="learner steps between actor policy syncs")
+    ap.add_argument("--checkpoint", default=None,
+                    help="session-store directory (docs/rl.md)")
+    ap.add_argument("--ckpt-every", type=int, default=100)
+    ap.add_argument("--resume", action="store_true")
+    args = ap.parse_args()
+
+    if args.resume and not args.checkpoint:
+        ap.error("--resume requires --checkpoint DIR")
+
+    from blendjax.env import BatchedRemoteEnv
+    from blendjax.models import QNetwork
+    from blendjax.rl import (
+        ActorPool,
+        HostQPolicy,
+        RLTrainDriver,
+        TrajectoryReservoir,
+        make_dqn_step,
+        make_rl_train_state,
+    )
+
+    script = os.path.join(os.path.dirname(__file__),
+                          "cartpole_producer.py")
+    reservoir = TrajectoryReservoir(
+        args.capacity, rng=0, prioritized=not args.uniform,
+    )
+    model = QNetwork(hidden=(32, 32), n_actions=3)
+    state = make_rl_train_state(
+        model, np.zeros((1, 4), np.float32), learning_rate=args.lr,
+    )
+    step = make_dqn_step(reservoir, model.apply, gamma=args.gamma)
+    mgr = None
+    if args.checkpoint:
+        from blendjax.checkpoint import SnapshotManager
+
+        mgr = SnapshotManager(args.checkpoint)
+    try:
+        with BatchedRemoteEnv(script=script, num_envs=args.envs,
+                              seed=0) as venv:
+            pool = ActorPool(
+                venv, reservoir,
+                HostQPolicy(3, eps_steps=1500, seed=0),
+                # discrete action index -> motor velocity
+                action_map=np.array([-2.0, 0.0, 2.0], np.float32),
+            )
+            driver = RLTrainDriver(
+                step, state, reservoir, actors=pool,
+                batch_size=args.batch, min_fill=2 * args.batch,
+                sync_every=args.sync_every, inflight=2,
+                checkpoint=mgr,
+                checkpoint_every=args.ckpt_every if mgr else 0,
+            )
+            if args.resume:
+                restored = mgr.restore(state)
+                if restored is None:
+                    # a fresh/empty checkpoint dir has nothing to
+                    # resume — start from scratch instead of crashing
+                    print(f"no committed snapshot in "
+                          f"{args.checkpoint!r} — starting fresh")
+                else:
+                    driver.state = restored.state
+                    names = driver.restore_session(restored.session)
+                    print(f"resumed at step {driver.steps} "
+                          f"(restored: {', '.join(names)})")
+            with pool:
+                report_every = max(args.steps // 8, 1)
+                while driver.steps < args.steps:
+                    driver.train_step()
+                    if driver.steps % report_every == 0:
+                        driver.drain()
+                        s = driver.stats
+                        print(
+                            f"step {s['steps']}: "
+                            f"loss={driver.losses[-1]:.4f} "
+                            f"mean_return={s['actor']['mean_return']} "
+                            f"episodes={s['actor']['episodes']} "
+                            f"replay_ratio="
+                            f"{s['reservoir']['replay_ratio']}"
+                        )
+                loss = driver.drain()
+            print(
+                f"final: loss={loss:.4f} "
+                f"mean_return={pool.stats['mean_return']} "
+                f"env_steps={pool.env_steps} "
+                f"transitions={reservoir.inserts}"
+            )
+    finally:
+        if mgr is not None:
+            mgr.close()
+
+
+if __name__ == "__main__":
+    main()

@@ -1257,7 +1257,7 @@ def test_every_rule_registered():
     assert set(all_rules()) == {
         "BJX101", "BJX102", "BJX103", "BJX104", "BJX105", "BJX106",
         "BJX107", "BJX108", "BJX109", "BJX110", "BJX111", "BJX112",
-        "BJX113", "BJX114",
+        "BJX113", "BJX114", "BJX115",
     }
 
 
@@ -1330,6 +1330,83 @@ def test_bjx114_silent_outside_hot_path_and_suppressible():
             self.checkpoint.wait()
     """
     assert rule_ids(suppressed, select=["BJX114"]) == []
+
+
+# -- BJX115 host-materialization-in-actor-loop -------------------------------
+
+
+def test_bjx115_flags_policy_and_reservoir_fetches_in_actor_module():
+    src = """
+        # bjx: actor-hot-path
+        import numpy as np
+
+        def loop(self, obs):
+            while True:
+                actions = self.policy(self._snapshot, obs)
+                a = np.asarray(actions)
+                drawn = self.reservoir.sample(idx)
+                v = float(drawn)
+    """
+    assert rule_ids(src, select=["BJX115"]) == ["BJX115", "BJX115"]
+
+
+def test_bjx115_flags_item_and_block_until_ready_anywhere_in_actor():
+    src = """
+        # bjx: actor-hot-path
+        import jax
+
+        def loop(self, q):
+            x = q.item()
+            jax.block_until_ready(q)
+    """
+    assert rule_ids(src, select=["BJX115"]) == ["BJX115", "BJX115"]
+
+
+def test_bjx115_actor_basename_always_checked_and_nesting_flagged():
+    src = """
+        import numpy as np
+
+        def loop(self, idx):
+            a = np.asarray(self.policy(snap, obs))
+    """
+    assert rule_ids(src, "rl/actor.py", select=["BJX115"]) == ["BJX115"]
+
+
+def test_bjx115_env_outputs_and_host_math_stay_clean():
+    """Env step results and plain host accounting never lived on a
+    device — the rule must not flag the sanctioned actor shape."""
+    src = """
+        # bjx: actor-hot-path
+        import numpy as np
+
+        def loop(self):
+            while True:
+                obs, reward, done, infos = self.env.step(a)
+                o = np.asarray(obs)
+                r = float(reward[0])
+                ret = float(self._ep_ret[0])
+    """
+    assert rule_ids(src, select=["BJX115"]) == []
+
+
+def test_bjx115_silent_outside_actor_modules_and_suppressible():
+    src = """
+        import numpy as np
+
+        def learner_sync(self):
+            snap = np.asarray(self.policy(s, o))
+    """
+    assert rule_ids(src, select=["BJX115"]) == []
+    suppressed = """
+        # bjx: actor-hot-path
+        import numpy as np
+
+        def probe(self):
+            # one-off debugging probe, not the loop
+            # bjx: ignore[BJX115]
+            a = np.asarray(self.policy(s, o))
+    """
+    assert rule_ids(suppressed, select=["BJX115"]) == []
 
 
 # -- self-gate ---------------------------------------------------------------

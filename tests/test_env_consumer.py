@@ -88,6 +88,122 @@ def test_batched_envs_lockstep_and_autoreset():
         assert not done2.all()
 
 
+def test_kwargs_to_cli_bool_list_round_trip():
+    """The producer side parses these back with argparse: booleans via
+    paired --flag/--no-flag actions, lists via nargs — the round trip
+    the RL launch path depends on."""
+    import argparse
+
+    argv = _kwargs_to_cli(
+        {"real_time": True, "render_every": 3, "shadows": False,
+         "shape": [240, 320]}
+    )
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--real-time", action="store_true", default=False)
+    ap.add_argument("--no-real-time", dest="real_time",
+                    action="store_false")
+    ap.add_argument("--render-every", type=int, default=0)
+    ap.add_argument("--shadows", action="store_true", default=True)
+    ap.add_argument("--no-shadows", dest="shadows", action="store_false")
+    ap.add_argument("--shape", type=int, nargs=2)
+    opts = ap.parse_args(argv)
+    assert opts.real_time is True
+    assert opts.render_every == 3
+    assert opts.shadows is False
+    assert opts.shape == [240, 320]
+
+
+def test_batched_step_parks_final_observation_in_infos():
+    """The vector-env auto-reset contract: a done row's TERMINAL
+    observation rides in infos[i]['final_observation'] while the
+    stacked obs holds the fresh episode's first observation —
+    bootstrapped TD targets depend on the distinction."""
+    with BatchedRemoteEnv(script=CARTPOLE, num_envs=2, seed=0) as venv:
+        venv.reset()
+        for _ in range(200):
+            obs, reward, done, infos = venv.step(np.full(2, 5.0))
+            if done.any():
+                break
+        assert done.any(), "no episode ended under a full push"
+        for i in range(2):
+            if done[i]:
+                fin = np.asarray(infos[i]["final_observation"],
+                                 np.float32)
+                assert fin.shape == (4,)
+                # the terminal state is past the fail bound; the fresh
+                # episode's start is near upright — they must differ
+                assert abs(fin[2]) > 0.4 or abs(fin[0]) > 3.0
+                start = np.asarray(obs[i], np.float32)
+                assert abs(start[2]) <= 0.05 and abs(start[0]) <= 0.05
+            else:
+                assert "final_observation" not in infos[i]
+
+
+def test_batched_lockstep_is_deterministic_under_thread_pool():
+    """Two fleets, same seeds, same action sequence -> identical
+    trajectories: the thread pool overlaps RPCs but preserves env[i] ->
+    result[i] ordering (lockstep), and seeded resets pin the episode
+    RNG on every producer."""
+
+    def rollout():
+        with BatchedRemoteEnv(script=CARTPOLE, num_envs=2,
+                              seed=0) as venv:
+            obs, _ = venv.reset(seed=123)
+            trace = [obs]
+            rng = np.random.default_rng(7)
+            for _ in range(20):
+                obs, reward, done, _ = venv.step(
+                    rng.uniform(-1, 1, size=2)
+                )
+                trace.append(obs)
+            return np.stack(trace)
+
+    a = rollout()
+    b = rollout()
+    np.testing.assert_array_equal(a, b)
+
+
+def test_batched_close_is_idempotent():
+    venv = BatchedRemoteEnv(script=CARTPOLE, num_envs=2, seed=0)
+    venv.reset()
+    venv.step(np.zeros(2))
+    venv.close()
+    venv.close()  # second close must be a no-op, not a crash
+
+
+def test_remote_reset_seed_determinism():
+    with launch_env(script=CARTPOLE, seed=5, proto="ipc") as env:
+        o1, _ = env.reset(seed=77)
+        env.step(1.0)  # leave STATE_INIT so the next reset rewinds
+        o2, _ = env.reset(seed=77)
+        o3, _ = env.reset(seed=78)
+        np.testing.assert_allclose(
+            np.asarray(o1), np.asarray(o2), atol=0
+        )
+        assert not np.allclose(np.asarray(o2), np.asarray(o3))
+
+
+@pytest.mark.skipif(
+    pytest.importorskip("gymnasium") is None, reason="gymnasium missing"
+)
+def test_gymnasium_reset_seed_reaches_the_producer():
+    """Gymnasium's reset(seed=) contract must cross the wire: the
+    PRODUCER's episode RNG decides the initial state, so seeding only
+    the local np_random would leave seeded resets nondeterministic."""
+    from blendjax.env import GymnasiumRemoteEnv
+
+    env = GymnasiumRemoteEnv(script=CARTPOLE, seed=9, proto="ipc")
+    try:
+        o1, _ = env.reset(seed=42)
+        env.step(np.zeros(1, np.float32))
+        o2, _ = env.reset(seed=42)
+        o3, _ = env.reset(seed=43)
+        np.testing.assert_array_equal(o1, o2)
+        assert not np.array_equal(o2, o3)
+    finally:
+        env.close()
+
+
 @pytest.mark.skipif(
     pytest.importorskip("gymnasium") is None, reason="gymnasium missing"
 )

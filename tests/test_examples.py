@@ -165,6 +165,36 @@ def test_train_reinforce(monkeypatch, capsys):
     assert "iter 0:" in out and "iter 1:" in out
 
 
+def test_train_dqn(monkeypatch, capsys):
+    run_main(
+        monkeypatch, "control/train_dqn.py",
+        "--steps", "24", "--envs", "2", "--batch", "8",
+        "--capacity", "64",
+    )
+    out = capsys.readouterr().out
+    assert "final:" in out and "mean_return=" in out
+
+
+def test_train_dqn_checkpoint_then_resume(monkeypatch, capsys, tmp_path):
+    """The RL resume path end to end at example scale: train with the
+    session store armed, then resume and CONTINUE to a larger budget
+    (docs/rl.md 'Checkpoint and resume')."""
+    ckpt = str(tmp_path / "rl-ckpt")
+    run_main(
+        monkeypatch, "control/train_dqn.py",
+        "--steps", "16", "--envs", "2", "--batch", "8",
+        "--capacity", "64", "--checkpoint", ckpt, "--ckpt-every", "4",
+    )
+    capsys.readouterr()
+    run_main(
+        monkeypatch, "control/train_dqn.py",
+        "--steps", "24", "--envs", "2", "--batch", "8",
+        "--capacity", "64", "--checkpoint", ckpt, "--resume",
+    )
+    out = capsys.readouterr().out
+    assert "resumed at step" in out and "final:" in out
+
+
 def test_densityopt(monkeypatch, capsys):
     run_main(
         monkeypatch, "densityopt/densityopt.py",
