@@ -111,6 +111,27 @@ LIVE_ECHO_FACTORS = tuple(
 LIVE_FLEET = os.environ.get("BLENDJAX_BENCH_LIVE_FLEET", "1") == "1"
 FLEET_RATE = float(os.environ.get("BLENDJAX_BENCH_FLEET_RATE", "40"))
 FLEET_MAX = int(os.environ.get("BLENDJAX_BENCH_FLEET_MAX", "4"))
+# Wire-decode A/B row (docs/performance.md "Closing the live-MFU
+# gap"): zlib "ndz" (host inflate, decode-ahead pool) vs run-length
+# "ndr" (expansion deferred INTO the fused train dispatch) on the
+# synthetic tier, both through the driver-placed one-dispatch path,
+# against a step-alone probe of the SAME fused step — so the
+# live-to-step-alone settled-rate ratio isolates wire + host decode +
+# placement overhead. CI asserts the ratio floor, dispatch_per_step ==
+# 1.0 with ZERO standalone decode dispatches on the ndr leg,
+# seq_gaps == 0, and f32 loss equality between ndr-decoded and
+# nd-decoded runs of the same recorded stream.
+LIVE_WIRE = os.environ.get("BLENDJAX_BENCH_LIVE_WIRE", "1") == "1"
+WIRE_TIME_CAP_S = float(
+    os.environ.get("BLENDJAX_BENCH_WIRE_TIME_CAP_S", "14")
+)
+WIRE_RATE = float(os.environ.get("BLENDJAX_BENCH_WIRE_RATE", "300"))
+# Conservative: on a 1-core dev box the measured ratio is ~1.0 (the
+# live path matches the fused step-alone rate); the floor guards
+# against the input-bound regime regressing, not for headroom.
+WIRE_RATIO_FLOOR = float(
+    os.environ.get("BLENDJAX_BENCH_WIRE_RATIO_FLOOR", "0.25")
+)
 # Closed-loop scenario A/B row (docs/scenarios.md): the SAME 2-producer
 # synthetic fleet rendering a 2-scenario space (one with irreducible
 # label noise — the high-loss scenario) through the fused echo path,
@@ -1735,6 +1756,251 @@ def measure_live_fleet(time_cap: float = 12.0, rate: float | None = None,
     return row
 
 
+def _wire_ab_messages(n: int, batch: int, h: int, w: int) -> list:
+    """Deterministic in-memory recorded stream for the wire A/B: n
+    prebatched cube-ish frames encoded with run-length "ndr" wire
+    frames (pinned cap, so ONE packed spec / ONE jit compile). The
+    equality leg decodes these SAME wire bytes two ways."""
+    from blendjax.transport.wire import WireCompressState, encode_message
+
+    state = WireCompressState()
+    rng = np.random.default_rng(7)
+    frames = []
+    for i in range(n):
+        img = np.zeros((batch, h, w, 4), np.uint8)
+        x0 = 4 + (i % 5) * 9
+        img[:, x0:x0 + 14, 8:40] = (i % 6) + 1
+        xy = rng.integers(0, w, (batch, 8, 2)).astype(np.float32)
+        frames.append(encode_message(
+            {"btid": 0, "_prebatched": True, "image": img, "xy": xy},
+            compress_rle=True, rle_cap=512, compress_min_bytes=1024,
+            state=state,
+        ))
+    return frames
+
+
+def measure_wire_equality(steps: int = 12, batch: int = 8,
+                          shape=(64, 64)) -> dict:
+    """The live_wire_ab equality contract, standalone: the SAME
+    recorded wire bytes decoded two ways — "ndr" deferred to the fused
+    train dispatch vs host-inflated "nd" fields — trained to the same
+    step count from the same init. The deferred device expansion must
+    train the SAME math (dev box: bit-identical; the CI bar allows f32
+    reduction-reorder noise)."""
+    import jax
+
+    from blendjax.data import StreamDataPipeline
+    from blendjax.models.cnn import CubeRegressor
+    from blendjax.train.driver import TrainDriver
+    from blendjax.train.steps import make_fused_tile_step, make_train_state
+    from blendjax.transport.wire import decode_message
+
+    h, w = shape
+    model = CubeRegressor()
+    frames = _wire_ab_messages(steps, batch, h, w)
+
+    def run(deferred: bool) -> float:
+        msgs = [decode_message(f, defer_rle=deferred) for f in frames]
+        pipe = StreamDataPipeline(
+            iter(msgs), batch_size=batch, emit_packed=True,
+            place_in_driver=True,
+        )
+        drv = TrainDriver(
+            make_fused_tile_step(),
+            make_train_state(
+                model, np.zeros((batch, h, w, 4), np.uint8),
+                rng=jax.random.key(0),
+            ),
+            inflight=2, sync_every=0, place=pipe.feeder.place,
+        )
+        with pipe:
+            for b in pipe:
+                drv.submit(b)
+        _, loss = drv.finish()
+        return float(loss)
+
+    ndr_loss = run(True)
+    nd_loss = run(False)
+    diff = abs(ndr_loss - nd_loss)
+    return {
+        "steps": steps,
+        "ndr_loss": ndr_loss,
+        "nd_loss": nd_loss,
+        "max_abs_diff": diff,
+        # the established f32 bar (reduction reorder only)
+        "identical": diff <= 2e-6,
+    }
+
+
+def measure_live_wire_ab(time_cap: float | None = None,
+                         rate: float | None = None) -> dict:
+    """Wire-decode A/B (docs/performance.md "Closing the live-MFU
+    gap"): the three levers of the live-vs-step-alone gap measured as
+    one row on the synthetic tier.
+
+    - ``step_alone``: the SAME fused one-dispatch step driven from a
+      pre-placed packed batch — the consumer's ceiling with zero wire,
+      zero host decode, zero placement (using the same step program on
+      both sides isolates the input path instead of comparing two
+      different XLA programs).
+    - ``ndz`` leg: zlib wire, host inflate (through the sharded-pool
+      decode-ahead path when engaged), feeder-free driver placement.
+    - ``ndr`` leg: run-length wire; the expansion is DEFERRED into the
+      fused train dispatch (``rle_groups`` decode plan), so the host
+      inflate cost is structurally zero and ``dispatch_per_step`` stays
+      exactly 1.0 with zero standalone decode dispatches — CI-asserted.
+    - ``equality``: the SAME recorded wire bytes decoded both ways
+      (deferred device expansion vs host inflate) trained to the same
+      step count — f32 final losses must match (bit-identical on the
+      dev box; the CI bar allows reduction-reorder noise).
+
+    ``value`` / ``live_to_alone`` is the ndr leg's settled rate over
+    the step-alone rate; CI asserts it against ``ratio_floor``
+    (BLENDJAX_BENCH_WIRE_RATIO_FLOOR). Producers are rate-capped so
+    the row measures the consumer's input path, not core contention
+    with the renderer (dev box: 1 core, ratio ~1.0)."""
+    import jax
+
+    from blendjax.data import StreamDataPipeline
+    from blendjax.fleet import synthetic_fleet
+    from blendjax.models.cnn import CubeRegressor
+    from blendjax.obs.lineage import lineage
+    from blendjax.obs.trace import tracer
+    from blendjax.train.driver import TrainDriver
+    from blendjax.train.steps import make_fused_tile_step, make_train_state
+    from blendjax.transport.wire import decode_message
+    from blendjax.utils.metrics import metrics as reg
+
+    time_cap = WIRE_TIME_CAP_S if time_cap is None else time_cap
+    rate = WIRE_RATE if rate is None else rate
+    (h, w), batch = (64, 64), 8
+    model = CubeRegressor()
+
+    def fresh_state():
+        return make_train_state(
+            model, np.zeros((batch, h, w, 4), np.uint8),
+            rng=jax.random.key(0),
+        )
+
+    def step_alone_probe(calls: int = 24) -> dict:
+        frames = _wire_ab_messages(2, batch, h, w)
+        pipe = StreamDataPipeline(
+            iter([decode_message(f, defer_rle=True) for f in frames]),
+            batch_size=batch, emit_packed=True, place_in_driver=True,
+        )
+        it = iter(pipe)
+        placed = pipe.feeder.place(next(it))
+        drv = TrainDriver(
+            make_fused_tile_step(), fresh_state(), inflight=4,
+            sync_every=0,
+        )
+        drv.submit(dict(placed))
+        drv.drain()  # compile outside the timed window
+        t0 = time.perf_counter()
+        for _ in range(calls):
+            drv.submit(dict(placed))
+        drv.drain()
+        dt = time.perf_counter() - t0
+        pipe.stop()
+        return {
+            "img_s": round(calls * batch / dt, 1),
+            "ms_per_step": round(dt / calls * 1e3, 2),
+        }
+
+    def leg(wirekind: str) -> dict:
+        reg.reset()
+        lineage.reset()
+        tracer.reset()
+        extra = ["--wire", wirekind, "--trace-every", "4"]
+        if wirekind == "ndr":
+            extra += ["--rle-cap", "512"]
+        with synthetic_fleet(
+            1, shape=(h, w), batch=batch, rate=rate, extra_args=extra,
+        ) as launcher:
+            pipe = StreamDataPipeline(
+                launcher.addresses["DATA"], batch_size=batch,
+                emit_packed=True, place_in_driver=True,
+                timeoutms=30_000,
+            )
+            drv = TrainDriver(
+                make_fused_tile_step(), fresh_state(), inflight=4,
+                sync_every=16, place=pipe.feeder.place,
+            )
+            with pipe:
+                it = iter(pipe)
+                drv.submit(next(it))  # producer up + jit compiled
+                drv.drain()
+                t0 = time.perf_counter()
+                half = (drv.images_retired, 0.0)
+                while True:
+                    drv.submit(next(it))
+                    el = time.perf_counter() - t0
+                    if el <= time_cap / 2:
+                        half = (drv.images_retired, el)
+                    if el >= time_cap:
+                        break
+                drv.drain()
+                dt = time.perf_counter() - t0
+        r = reg.report()
+        spans, counters = r["spans"], r["counters"]
+        hists = r.get("histograms", {})
+        settled = (
+            (drv.images_retired - half[0]) / max(dt - half[1], 1e-9)
+        )
+        decode_calls = int(spans.get("decode.dispatch", {}).get("count", 0))
+        train_calls = int(spans.get("train.dispatch", {}).get("count", 0))
+        trace = tracer.report()
+        wire_ms = trace.get("transitions", {}).get("trace.wire_ms", {})
+        return {
+            "img_s": round(drv.images_retired / max(dt, 1e-9), 1),
+            "settled_img_s": round(settled, 1),
+            "steps": int(drv.steps),
+            "wire_bytes": int(counters.get("wire.compressed_bytes", 0)),
+            "decoded_bytes": int(counters.get("wire.raw_bytes", 0)),
+            "wire_compression": round(
+                counters.get("wire.raw_bytes", 0)
+                / max(counters.get("wire.compressed_bytes", 1), 1), 1,
+            ),
+            # host-side wire decode cost per message: the ndz leg's
+            # zlib inflate histogram; structurally 0 on the ndr leg
+            # (the expansion runs inside the train dispatch)
+            "decode_ms_p95": round(
+                float(hists.get("wire.inflate_ms", {}).get("p95", 0.0)),
+                3,
+            ),
+            "wire_ms_p95": round(float(wire_ms.get("p95_ms", 0.0)), 3),
+            "trace_completed": int(trace.get("completed", 0)),
+            "decode_dispatch_count": decode_calls,
+            "train_dispatch_count": train_calls,
+            "dispatch_per_step": (
+                round((train_calls + decode_calls) / drv.steps, 3)
+                if drv.steps else None
+            ),
+            "host_blocks": int(drv.host_blocks),
+            "seq_gaps": int(counters.get("wire.seq_gaps", 0)),
+            "rle_counters": {
+                k: int(v) for k, v in counters.items()
+                if k.startswith("rle.")
+            },
+        }
+
+    row: dict = {
+        "step_alone": step_alone_probe(),
+        "ndz": leg("ndz"),
+        "ndr": leg("ndr"),
+        "equality": measure_wire_equality(batch=batch, shape=(h, w)),
+        "rate_cap": rate,
+        "ratio_floor": WIRE_RATIO_FLOOR,
+    }
+    row["live_to_alone"] = round(
+        row["ndr"]["settled_img_s"]
+        / max(row["step_alone"]["img_s"], 1e-9), 3,
+    )
+    row["value"] = row["live_to_alone"]
+    row["seq_gaps"] = max(row["ndz"]["seq_gaps"], row["ndr"]["seq_gaps"])
+    return row
+
+
 def measure_live_scenario(time_cap: float | None = None,
                           min_steps: int | None = None,
                           rate: float = 60.0) -> dict:
@@ -3196,6 +3462,16 @@ def _build_record(progress: dict) -> dict:
             detail["live_fleet"] = measure_live_fleet()
         except Exception as e:  # pragma: no cover - spawn flake path
             detail["live_fleet"] = {"error": repr(e)[:200]}
+    if LIVE_WIRE:
+        # Wire-decode A/B (docs/performance.md "Closing the live-MFU
+        # gap"): ndz host inflate vs ndr in-jit expansion against a
+        # step-alone probe of the SAME fused step, plus the recorded-
+        # stream loss-equality contract. Rate-capped synthetic
+        # producers + a tiny CNN — runs on CPU CI in any weather.
+        try:
+            detail["live_wire_ab"] = measure_live_wire_ab()
+        except Exception as e:  # pragma: no cover - spawn flake path
+            detail["live_wire_ab"] = {"error": repr(e)[:200]}
     if LIVE_SCENARIO:
         # Closed-loop scenario A/B (docs/scenarios.md): fixed uniform
         # mixture vs adaptive curriculum over the duplex channel, with

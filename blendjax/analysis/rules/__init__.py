@@ -10,6 +10,7 @@ from blendjax.analysis.rules import (  # noqa: F401  (registration side effects)
     driver_sync,
     fleet_affinity,
     hotpath,
+    inflate,
     mesh_placement,
     metric_names,
     purity,

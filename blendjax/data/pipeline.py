@@ -88,6 +88,9 @@ class DeviceFeeder:
     contributes its local rows to the global array).
     """
 
+    #: bounded memo of placement plans keyed by batch-shape fingerprint
+    PLAN_CACHE_LIMIT = 64
+
     def __init__(self, sharding=None, prefetch: int = 2,
                  multihost: bool | None = None,
                  throttle: int = 8, mesh=None, data_axis: str = "data"):
@@ -108,6 +111,11 @@ class DeviceFeeder:
         self.prefetch = max(1, int(prefetch))
         self.multihost = multihost
         self.throttle = int(throttle) if throttle else 0
+        # Placement plans memoized per schema fingerprint: the same
+        # stream yields the same field names/ranks every batch, so the
+        # per-field sharding resolution + grouping runs once and
+        # steady-state placement does zero per-batch re-derivation.
+        self._place_plans: dict = {}
 
     @staticmethod
     def _simplify(sharding):
@@ -133,91 +141,160 @@ class DeviceFeeder:
                     for k, s in sharding.items()}
         return None if one_device(sharding) else sharding
 
-    def _place(self, batch: dict) -> dict:
-        jax = _require_jax()
-        out = {}
-        # Same-layout tensor fields are grouped and placed with ONE
-        # device_put call on the whole sub-dict (the runtime fans the
-        # group out itself): a batch is one placement, not one RPC per
-        # field — and never a per-device host loop (bjx-lint BJX111
-        # guards that property on mesh hot paths).
-        groups: dict = {}
-        for k, v in batch.items():
-            # SCENARIO_KEY: the batch-level domain-randomization stamp
-            # (blendjax.scenario) — per-item provenance like _meta, and
-            # a plain dict device_put would reject anyway.
-            if k in ("_meta", TRACES_KEY, SCENARIO_KEY) or isinstance(
-                v, (int, float)
-            ) or getattr(v, "ndim", -1) == 0:
-                # Host-side sidecars: per-item provenance and scalars —
-                # plain ints AND rank-0 numpy values (the wire codec
-                # preserves either form of a producer's ``btid`` stamp)
-                # — stay off-device: multihost assembly would otherwise
-                # build a "replicated" global from values that DIFFER
-                # per process (each producer stamps its own id). Lists
-                # and other array-likes keep their device placement.
-                out[k] = v
-                continue
-            if isinstance(v, jax.Array) and len(v.sharding.device_set) > 1:
-                # Already an assembled multi-device global array (the
-                # multihost chunk flush builds these) — re-placing would
-                # force a reshard or a bogus re-assembly. Single-device
-                # jax arrays deliberately fall through: a user-fed
-                # device array still gets the configured batch sharding
-                # (or the multihost global assembly), same as before.
-                out[k] = v
-                continue
-            if k == "__packed__":
-                # Reserved key: a whole batch flattened to one uint8
-                # buffer (TileStreamDecoder). It must never take the
-                # batch sharding — byte-sharding a buffer whose fields
-                # aren't device-aligned would split fields mid-array (or
-                # reject ragged sizes); the unpacked fields are resharded
-                # after the decode jit instead. On a multi-device mesh
-                # the buffer replicates (ONE placement call) so the
-                # decode/fused-step jit sees a single device set; the
-                # fused mesh step re-shards the decoded fields over
-                # `data` inside the jit.
-                mesh = getattr(
-                    _representative_sharding(self.sharding), "mesh", None
-                )
-                if mesh is not None:
-                    # packed buffers only exist single-host (multihost
-                    # tile streams decode via global-array assembly)
-                    from jax.sharding import NamedSharding, PartitionSpec
+    def _field_tag(self, jax, k, v):
+        """Placement-relevant signature of one batch entry — everything
+        :meth:`_build_place_plan` branches on, and nothing else, so a
+        memoized plan is exactly as correct as re-deriving it."""
+        # SCENARIO_KEY: the batch-level domain-randomization stamp
+        # (blendjax.scenario) — per-item provenance like _meta, and a
+        # plain dict device_put would reject anyway.
+        #
+        # Host-side sidecars: per-item provenance and scalars — plain
+        # ints AND rank-0 numpy values (the wire codec preserves either
+        # form of a producer's ``btid`` stamp) — stay off-device:
+        # multihost assembly would otherwise build a "replicated"
+        # global from values that DIFFER per process (each producer
+        # stamps its own id). Lists and other array-likes keep their
+        # device placement.
+        if k in ("_meta", TRACES_KEY, SCENARIO_KEY) or isinstance(
+            v, (int, float)
+        ) or getattr(v, "ndim", -1) == 0:
+            return "pass"
+        if isinstance(v, (tuple, dict, str)) or v is None:
+            # Fused decode-plan sidecars (`_spec`/`_names`/`_geoms`/
+            # `_pal`/`_rle` tuples, the `_refs` dict of already-placed
+            # reference arrays): host metadata the fused step consumes
+            # directly. Only reachable in driver-placement mode — the
+            # feeder stage never sees post-plan batches.
+            return "pass"
+        if isinstance(v, jax.Array) and len(v.sharding.device_set) > 1:
+            # Already an assembled multi-device global array (the
+            # multihost chunk flush builds these) — re-placing would
+            # force a reshard or a bogus re-assembly. Single-device
+            # jax arrays deliberately fall through: a user-fed device
+            # array still gets the configured batch sharding (or the
+            # multihost global assembly), same as before.
+            return "pass"
+        if k in ("__packed__", "_packed"):
+            # `__packed__` is the feeder-path reserved key; `_packed` is
+            # the SAME buffer after device_stage attached its fused
+            # decode plan (driver-placement mode places post-plan
+            # batches). Both must replicate, never take the batch
+            # sharding — byte-sharding a packed buffer would split
+            # fields mid-array.
+            return "packed"
+        return getattr(v, "ndim", 0)
 
-                    out[k] = jax.device_put(
-                        v, NamedSharding(mesh, PartitionSpec())
-                    )
-                else:
-                    out[k] = jax.device_put(v)
+    def _build_place_plan(self, fingerprint) -> dict:
+        """Resolve per-field placement actions ONCE per batch shape:
+        the sharding lookups, rank-vs-spec checks, and same-layout
+        grouping that used to run per batch now run per distinct
+        fingerprint (one per stream schema in steady state)."""
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        passthrough: list = []
+        packed: list = []
+        mh: list = []
+        groups: dict = {}
+        for k, tag in fingerprint:
+            if tag == "pass":
+                passthrough.append(k)
                 continue
+            if tag == "packed":
+                packed.append(k)
+                continue
+            ndim = tag
             s = (
                 self.sharding.get(k)
                 if isinstance(self.sharding, dict)
                 else self.sharding
             )
             spec_rank = len(getattr(s, "spec", ()) or ())
-            if s is not None and getattr(v, "ndim", 0) < spec_rank:
+            if s is not None and ndim < spec_rank:
                 # Fields of lower rank than the configured spec can't
                 # take the batch sharding: replicate instead. (True
-                # scalars never reach here — they stay on host above;
-                # this covers e.g. a rank-1 field under a rank-2
-                # per-field spec.)
-                from jax.sharding import NamedSharding, PartitionSpec
-
+                # scalars never reach here — they stay on host via the
+                # "pass" tag; this covers e.g. a rank-1 field under a
+                # rank-2 per-field spec.)
                 s = NamedSharding(s.mesh, PartitionSpec())
             if self.multihost and s is not None:
-                out[k] = jax.make_array_from_process_local_data(s, v)
+                mh.append((k, s))
             else:
-                groups.setdefault(s, {})[k] = v
-        for s, fields in groups.items():
+                groups.setdefault(s, []).append(k)
+        # __packed__: a whole batch flattened to one uint8 buffer
+        # (TileStreamDecoder). It must never take the batch sharding —
+        # byte-sharding a buffer whose fields aren't device-aligned
+        # would split fields mid-array; the unpacked fields are
+        # resharded after the decode jit instead. On a multi-device
+        # mesh the buffer replicates (ONE placement call) so the
+        # decode/fused-step jit sees a single device set; packed
+        # buffers only exist single-host.
+        packed_sharding = None
+        if packed:
+            mesh = getattr(
+                _representative_sharding(self.sharding), "mesh", None
+            )
+            if mesh is not None:
+                packed_sharding = NamedSharding(mesh, PartitionSpec())
+        return {
+            "pass": tuple(passthrough),
+            "packed": tuple(packed),
+            "packed_sharding": packed_sharding,
+            "mh": tuple(mh),
+            "groups": tuple(
+                (s, tuple(keys)) for s, keys in groups.items()
+            ),
+        }
+
+    def _place(self, batch: dict) -> dict:
+        jax = _require_jax()
+        # Same-layout tensor fields are grouped and placed with ONE
+        # device_put call on the whole sub-dict (the runtime fans the
+        # group out itself): a batch is one placement, not one RPC per
+        # field — and never a per-device host loop (bjx-lint BJX111
+        # guards that property on mesh hot paths).
+        fingerprint = tuple(
+            (k, self._field_tag(jax, k, v)) for k, v in batch.items()
+        )
+        plan = self._place_plans.get(fingerprint)
+        if plan is None:
+            if len(self._place_plans) >= self.PLAN_CACHE_LIMIT:
+                self._place_plans.clear()
+            plan = self._place_plans[fingerprint] = (
+                self._build_place_plan(fingerprint)
+            )
+        out = {k: batch[k] for k in plan["pass"]}
+        ps = plan["packed_sharding"]
+        for k in plan["packed"]:
+            out[k] = (
+                jax.device_put(batch[k]) if ps is None
+                else jax.device_put(batch[k], ps)
+            )
+        for k, s in plan["mh"]:
+            out[k] = jax.make_array_from_process_local_data(s, batch[k])
+        for s, keys in plan["groups"]:
+            fields = {k: batch[k] for k in keys}
             placed = (
                 jax.device_put(fields) if s is None
                 else jax.device_put(fields, s)
             )
             out.update(placed)
         return out
+
+    def place(self, batch: dict) -> dict:
+        """One grouped, span-accounted, trace-stamped placement of a
+        host batch — the entry :class:`blendjax.train.TrainDriver`
+        calls when placement is folded into the dispatch
+        (``TrainDriver(place=feeder.place)``): the async transfer is
+        committed at submit time and overlaps the in-flight steps the
+        driver ring tracks, instead of running as a separate
+        host-blocking feeder stage."""
+        with metrics.span("feed.place"):
+            db = self._place(batch)
+        # Frame trace: the host->device transfer was dispatched for
+        # every field of this batch (fast no-op when untraced).
+        trace_stamp_batch(db, "place")
+        return db
 
     @staticmethod
     def _largest(batch):
@@ -267,11 +344,7 @@ class DeviceFeeder:
                     metrics.count("feed.throttle_blocks")
                     with metrics.span("feed.throttle_wait"):
                         jax.block_until_ready(oldest)
-            with metrics.span("feed.place"):
-                db = self._place(hb)
-            # Frame trace: the host->device transfer was dispatched for
-            # every field of this batch (fast no-op when untraced).
-            trace_stamp_batch(db, "place")
+            db = self.place(hb)
             if self.throttle:
                 window.append(self._largest(db))
             return db
@@ -461,8 +534,40 @@ class TileStreamDecoder:
                     if s is not None:
                         ref_tiles = jax.device_put(ref_tiles, s)
                 self._refs[key] = ref_tiles
+            # Deferred run-length wire frames ("ndr", docs/wire-protocol
+            # .md): the packed buffers + plans ride the batch; validate
+            # HERE (host side, the ndz bounds/truncation guards carried
+            # over) and expand inside the decode/train jit below.
+            rle_groups = T.pop_rle_batches(hb)
+            if rle_groups:
+                if self.multihost:
+                    # Correctness-first fallback, like the pal path:
+                    # expand on host so the fields ride the multihost
+                    # global-array assembly.
+                    for base, (shape, isz, cap) in rle_groups:
+                        hb[base] = T.rle_expand_packed_np(
+                            hb.pop(base + T.NDR_SUFFIX), shape, isz, cap
+                        )
+                    rle_groups = ()
+                else:
+                    decoded = 0
+                    packed_bytes = 0
+                    for base, (shape, isz, cap) in rle_groups:
+                        buf = hb[base + T.NDR_SUFFIX]
+                        T.rle_validate_packed(buf, shape, isz, cap)
+                        packed_bytes += int(buf.nbytes)
+                        n = 1
+                        for s in shape:
+                            n *= int(s)
+                        decoded += n
+                    metrics.count("rle.batches")
+                    metrics.count("rle.packed_bytes", packed_bytes)
+                    metrics.count("rle.decoded_bytes", decoded)
+            has_tiles = any(
+                k.endswith(T.TILESHAPE_SUFFIX) for k in hb
+            )
             pal_groups = T.pop_frame_palette_batches(hb)
-            if pal_groups:
+            if pal_groups or (rle_groups and not has_tiles):
                 if self.multihost:
                     # Correctness-first fallback: expand on host and let
                     # the batch ride the existing raw paths (multihost
@@ -482,8 +587,9 @@ class TileStreamDecoder:
                     rest = {k: v for k, v in hb.items() if k not in arrays}
                     with metrics.span("tiles.pack"):
                         buf, spec = T.pack_fields(arrays)
-                    metrics.count("pal.batches")
-                    metrics.count("pal.wire_bytes", int(buf.nbytes))
+                    if pal_groups:
+                        metrics.count("pal.batches")
+                        metrics.count("pal.wire_bytes", int(buf.nbytes))
                     for name, (h_, w_, c_, bits) in pal_groups:
                         lead = int(
                             arrays[
@@ -495,7 +601,8 @@ class TileStreamDecoder:
                         )
                     if self.chunk == 1 and not self.emit_packed:
                         self._plans.append(
-                            ("pal", spec, rest, tuple(pal_groups))
+                            ("pal", spec, rest, tuple(pal_groups),
+                             rle_groups)
                         )
                         yield {"__packed__": buf}
                         continue
@@ -507,7 +614,7 @@ class TileStreamDecoder:
                     # routes through this grouped form too (K'=1 groups
                     # when chunk==1): the fused step consumes the
                     # stacked (K', total) layout.
-                    gkey = (spec, tuple(pal_groups))
+                    gkey = (spec, tuple(pal_groups), rle_groups)
                     if pal_group and pal_group["key"] != gkey:
                         yield from self._flush_pal_group(pal_group)
                     if not pal_group:
@@ -612,6 +719,7 @@ class TileStreamDecoder:
                     names, spec, rest,
                     {n: self._refs[(n, btid)] for n in names},
                     tuple(self._shapes[n] for n in names),
+                    rle_groups,
                 ))
                 yield {"__packed__": buf}
                 continue
@@ -621,6 +729,7 @@ class TileStreamDecoder:
             gkey = (
                 tuple(names), spec,
                 tuple(self._ref_digest.get((n, btid)) for n in names),
+                rle_groups,
             )
             if group and group["key"] != gkey:
                 yield from self._flush_group(group)
@@ -632,6 +741,7 @@ class TileStreamDecoder:
                     key=gkey, bufs=[], rests=[],
                     refs={n: self._refs[(n, btid)] for n in names},
                     geoms=tuple(self._shapes[n] for n in names),
+                    rle=rle_groups,
                 )
             group["bufs"].append(buf)
             group["rests"].append(rest)
@@ -646,9 +756,9 @@ class TileStreamDecoder:
         ``chunk``) as one stacked packed transfer; no-op when empty."""
         if not pal_group:
             return
-        spec, pal_groups = pal_group["key"]
+        spec, pal_groups, rle_groups = pal_group["key"]
         self._plans.append(
-            ("palchunk", spec, pal_group["rests"], pal_groups)
+            ("palchunk", spec, pal_group["rests"], pal_groups, rle_groups)
         )
         stacked = np.stack(pal_group["bufs"])
         pal_group.clear()
@@ -851,10 +961,10 @@ class TileStreamDecoder:
         as one stacked packed transfer; no-op when empty."""
         if not group:
             return
-        names, spec, _digests = group["key"]
+        names, spec, _digests, rle_groups = group["key"]
         self._plans.append(
             ("chunk", names, spec, group["rests"],
-             group["refs"], group["geoms"])
+             group["refs"], group["geoms"], rle_groups)
         )
         stacked = np.stack(group["bufs"])
         group.clear()
@@ -867,8 +977,10 @@ class TileStreamDecoder:
         if self._decode is None:
             mesh, axis = self._decode_mesh()
 
-            def _decode_packed(packed, refs, spec, names, geoms):
-                fields = T.unpack_fields(packed, spec)
+            def _decode_packed(packed, refs, spec, names, geoms, rle=()):
+                fields = T.expand_rle_fields(
+                    T.unpack_fields(packed, spec), rle
+                )
                 for name, geom in zip(names, geoms):
                     idx = fields.pop(name + T.TILEIDX_SUFFIX)
                     tiles = T.pop_tile_payload(
@@ -881,7 +993,8 @@ class TileStreamDecoder:
                 return fields
 
             self._decode = jax.jit(
-                _decode_packed, static_argnames=("spec", "names", "geoms")
+                _decode_packed,
+                static_argnames=("spec", "names", "geoms", "rle"),
             )
         if self._decode_chunk is None:
             import functools
@@ -891,7 +1004,7 @@ class TileStreamDecoder:
                 functools.partial(
                     T.decode_packed_superbatch, mesh=mesh, data_axis=axis
                 ),
-                static_argnames=("spec", "names", "geoms"),
+                static_argnames=("spec", "names", "geoms", "rle_groups"),
             )
         if self._decode_mh is None:
             mesh, axis = self._decode_mesh()
@@ -918,11 +1031,11 @@ class TileStreamDecoder:
             # consumers — the two paths cannot drift.
             self._decode_pal = jax.jit(
                 T.decode_packed_pal_batch,
-                static_argnames=("spec", "pal_groups"),
+                static_argnames=("spec", "pal_groups", "rle_groups"),
             )
             self._decode_pal_chunk = jax.jit(
                 T.decode_packed_pal_superbatch,
-                static_argnames=("spec", "pal_groups"),
+                static_argnames=("spec", "pal_groups", "rle_groups"),
             )
         if self._decode_mh_chunk is None:
             mesh, axis = self._decode_mesh()
@@ -985,11 +1098,11 @@ class TileStreamDecoder:
                 yield fields
                 continue
             if plan is not None and plan[0] == "pal":
-                _, spec, rest, pal_groups = plan
+                _, spec, rest, pal_groups, rle_groups = plan
                 with metrics.span("decode.dispatch"):
                     fields = self._decode_pal(
                         db.pop("__packed__"), spec=spec,
-                        pal_groups=pal_groups,
+                        pal_groups=pal_groups, rle_groups=rle_groups,
                     )
                 # packed buffer travels unsharded: reshard decoded fields
                 # to their configured layouts (no-op on one device)
@@ -1005,24 +1118,26 @@ class TileStreamDecoder:
                 yield db
                 continue
             if plan is not None and plan[0] == "palchunk":
-                _, spec, rests, pal_groups = plan
+                _, spec, rests, pal_groups, rle_groups = plan
                 if self.emit_packed:
                     # Fused-step form: the still-encoded stacked buffer
-                    # plus its decode plan — the palette expand happens
-                    # INSIDE the train jit (make_fused_tile_step), so no
-                    # standalone decode.dispatch call exists on this
-                    # path and decoded frames never round-trip as
-                    # standalone jax.Arrays.
+                    # plus its decode plan — the palette expand (and any
+                    # deferred run-length expansion) happens INSIDE the
+                    # train jit (make_fused_tile_step), so no standalone
+                    # decode.dispatch call exists on this path and
+                    # decoded frames never round-trip as standalone
+                    # jax.Arrays.
                     db["_packed"] = db.pop("__packed__")
                     db["_spec"] = spec
                     db["_pal"] = pal_groups
+                    db["_rle"] = rle_groups
                     db["_meta"] = rests
                     yield db
                     continue
                 with metrics.span("decode.dispatch"):
                     fields = self._decode_pal_chunk(
                         db.pop("__packed__"), spec=spec,
-                        pal_groups=pal_groups,
+                        pal_groups=pal_groups, rle_groups=rle_groups,
                     )
                 self._pin_superbatch(fields)
                 db["_meta"] = rests
@@ -1041,13 +1156,14 @@ class TileStreamDecoder:
                 yield db
                 continue
             if plan is not None and plan[0] == "chunk":
-                _, names, spec, rests, refs, geoms = plan
+                _, names, spec, rests, refs, geoms, rle_groups = plan
                 if self.emit_packed:
                     db["_packed"] = db.pop("__packed__")
                     db["_refs"] = refs
                     db["_spec"] = spec
                     db["_names"] = tuple(names)
                     db["_geoms"] = geoms
+                    db["_rle"] = rle_groups
                     db["_meta"] = rests
                     yield db
                     continue
@@ -1058,6 +1174,7 @@ class TileStreamDecoder:
                         spec=spec,
                         names=tuple(names),
                         geoms=geoms,
+                        rle_groups=rle_groups,
                     )
                 self._pin_superbatch(fields)
                 db["_meta"] = rests
@@ -1066,7 +1183,7 @@ class TileStreamDecoder:
                 yield db
                 continue
             if plan is not None:
-                names, spec, rest, refs, geoms = plan
+                names, spec, rest, refs, geoms, rle_groups = plan
                 with metrics.span("decode.dispatch"):
                     fields = self._decode(
                         db.pop("__packed__"),
@@ -1074,6 +1191,7 @@ class TileStreamDecoder:
                         spec=spec,
                         names=tuple(names),
                         geoms=geoms,
+                        rle=rle_groups,
                     )
                 # The packed buffer travels unsharded, so on a multi-
                 # device mesh the unpacked fields must be moved to their
@@ -1116,6 +1234,9 @@ class StreamDataPipeline:
         ingest_workers: int = 1,
         emit_partial_final: bool = False,
         pad_partial: bool = True,
+        place_in_driver: bool = False,
+        defer_rle: bool | None = None,
+        inflate_workers: int = 2,
         **stream_kwargs,
     ):
         from blendjax.data.stream import RemoteStream
@@ -1137,6 +1258,35 @@ class StreamDataPipeline:
         # and recording-tee semantics unchanged.
         self.ingest_workers = max(1, int(ingest_workers))
         self.emit_partial_final = bool(emit_partial_final)
+        # inflate_workers: size of the sharded ingest pool's shared
+        # zlib-inflate executor (decode-ahead in each shard stream;
+        # docs/performance.md lever 2). Only engaged with
+        # ingest_workers > 1; 0 disables.
+        self.inflate_workers = max(0, int(inflate_workers))
+        # place_in_driver: skip the feeder stage entirely — the
+        # pipeline yields HOST batches (with their decode plans) and
+        # the TrainDriver commits the grouped device_put at submit
+        # time (TrainDriver(place=pipe.feeder.place)), so the transfer
+        # overlaps the in-flight steps the driver ring tracks and the
+        # one-dispatch contract covers placement too
+        # (docs/performance.md lever 3). Requires the packed fused
+        # path: every non-fused plan dispatches decode jits on what
+        # device_stage yields, which would here still be host batches.
+        self.place_in_driver = bool(place_in_driver)
+        if place_in_driver and not emit_packed:
+            raise ValueError(
+                "place_in_driver=True requires emit_packed=True: "
+                "placement folds into the fused train dispatch "
+                "(make_fused_tile_step + TrainDriver(place=...))"
+            )
+        # defer_rle: leave "ndr" wire frames of prebatched messages
+        # packed for in-jit expansion (docs/wire-protocol.md). Default:
+        # exactly when the fused path consumes them (emit_packed).
+        self.defer_rle = (
+            bool(emit_packed) if defer_rle is None else bool(defer_rle)
+        )
+        if self.defer_rle:
+            stream_kwargs.setdefault("defer_rle", True)
         # Shape-bucketed partials (on by default): a `_partial=True`
         # tail batch is zero-padded on the HOST up to a power-of-two
         # bucket with a `_mask` validity vector (pad_to_bucket), so a
@@ -1209,6 +1359,11 @@ class StreamDataPipeline:
                 "emit_packed=True is incompatible with multihost=True — "
                 "multihost tile streams decode via global-array assembly "
                 "(use the regular decode-then-step path)"
+            )
+        if self.place_in_driver and multihost:
+            raise NotImplementedError(
+                "place_in_driver=True is single-host: multihost batches "
+                "must assemble global arrays in the feeder"
             )
         # Single-device shardings are stripped ONCE here so every stage
         # below (feeder placement, tile ref placement, decoded-field
@@ -1338,6 +1493,7 @@ class StreamDataPipeline:
                 prefetch=self.prefetch,
                 emit_partial_final=self.emit_partial_final,
                 max_messages=self._stream_kwargs.get("max_items"),
+                inflate_workers=self.inflate_workers,
             )
         else:
             self.ingest = HostIngest(
@@ -1354,6 +1510,13 @@ class StreamDataPipeline:
             if self.pad_partial else self.ingest
         )
         host = self.tiles.host_stage(source)
+        if self.place_in_driver:
+            # No feeder stage: device_stage only attaches the fused
+            # decode plans here (emit_packed — enforced at
+            # construction), so the yielded batches are HOST dicts and
+            # the TrainDriver commits the one grouped placement at
+            # submit time (TrainDriver(place=pipe.feeder.place)).
+            return iter(self.tiles.device_stage(host))
         return iter(self.tiles.device_stage(self.feeder(host)))
 
     def _pad_partial_stage(self, batches):

@@ -193,10 +193,20 @@ class ShardedHostIngest:
         validate_every: int = 1,
         emit_partial_final: bool = False,
         max_messages: int | None = None,
+        inflate_workers: int = 2,
     ):
         self.streams = list(streams)
         if not self.streams:
             raise ValueError("ShardedHostIngest needs at least one stream")
+        # Shared inflate pool (docs/performance.md "Closing the live-MFU
+        # gap", lever 2): ONE small executor across every shard stream.
+        # Each shard's recv loop currently serializes zlib inflate in
+        # front of its next socket read; with the pool attached the
+        # streams pipeline decode-ahead (RemoteStream), so inflate of
+        # message N overlaps the recv of N+1 — on top of the existing
+        # cross-shard parallelism. 0 disables (inline decode as before).
+        self.inflate_workers = max(0, int(inflate_workers))
+        self._inflate_pool = None
         self.batch_size = int(batch_size)
         self.schema = schema
         self.prefetch = prefetch
@@ -452,6 +462,18 @@ class ShardedHostIngest:
                 self._active -= 1
                 last = self._active == 0
             if last:
+                # Local bind: stop() may swap the attribute to None
+                # concurrently (its join loop can time out while this
+                # teardown runs) — a check-then-attribute-reload here
+                # would AttributeError out of the finally and lose the
+                # _DONE sentinel below. Executor shutdown is idempotent,
+                # so both sides calling it is harmless.
+                pool = self._inflate_pool
+                if pool is not None:
+                    # every shard iterator has returned: no stream can
+                    # submit another decode job
+                    pool.shutdown(wait=False)
+                    self._inflate_pool = None
                 if (
                     self._error is None
                     and not self._consumer_stop
@@ -484,6 +506,22 @@ class ShardedHostIngest:
 
     def start(self) -> "ShardedHostIngest":
         assert not self._threads, "already started"
+        if self.inflate_workers and self._inflate_pool is None:
+            import concurrent.futures
+
+            hookable = [
+                s for s in self.streams
+                if hasattr(s, "set_inflate_pool")
+            ]
+            if hookable:
+                self._inflate_pool = (
+                    concurrent.futures.ThreadPoolExecutor(
+                        max_workers=self.inflate_workers,
+                        thread_name_prefix="blendjax-inflate",
+                    )
+                )
+                for s in hookable:
+                    s.set_inflate_pool(self._inflate_pool)
         for stream in self.streams:
             clear = getattr(stream, "clear_stop_request", None)
             if clear is not None:
@@ -536,6 +574,14 @@ class ShardedHostIngest:
                 break
             for t in self._threads:
                 t.join(timeout=min(0.05, max(remaining, 0.01)))
+        pool = self._inflate_pool
+        if pool is not None:
+            # workers are down (or being abandoned as daemons): no new
+            # decode jobs can arrive; don't block teardown on stragglers
+            # (local bind mirrors the worker-side teardown — the two may
+            # race; shutdown is idempotent)
+            pool.shutdown(wait=False)
+            self._inflate_pool = None
         alive = [t.name for t in self._threads if t.is_alive()]
         if alive:
             raise RuntimeError(

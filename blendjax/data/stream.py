@@ -22,8 +22,16 @@ from blendjax.obs.lineage import lineage
 from blendjax.obs.trace import TRACE_KEY, stage as trace_stage
 from blendjax.transport import DataReceiverSocket, ReceiveTimeoutError
 from blendjax.utils.logging import get_logger
+from blendjax.utils.metrics import metrics
 
 logger = get_logger("data")
+
+# Decode-ahead depth when an inflate pool is attached: one message
+# decoding off-thread while the iterating thread sits in the next
+# recv. Deeper pipelines buy nothing (the pool decodes faster than
+# zmq delivers or the queue is the bound anyway) and would hold more
+# zero-copy frame buffers alive.
+DECODE_AHEAD = 2
 
 
 def partition_addresses(addresses, num_shards: int) -> list:
@@ -71,6 +79,7 @@ class RemoteStream:
         allow_pickle: bool = True,
         on_timeout=None,
         track_gaps: bool | None = None,
+        defer_rle: bool = False,
     ):
         if isinstance(addresses, str):
             addresses = [addresses]
@@ -85,6 +94,16 @@ class RemoteStream:
         self.num_workers = num_workers
         self.copy_arrays = copy_arrays
         self.allow_pickle = allow_pickle
+        # defer_rle: leave "ndr" wire frames of prebatched messages
+        # packed (plus their expansion plan) for the pipeline's
+        # device-side decode — zero host inflate on the fused path.
+        self.defer_rle = bool(defer_rle)
+        # Shared inflate executor (ShardedHostIngest wires one across
+        # its workers): when set, iteration pipelines DECODE-AHEAD —
+        # the socket thread receives message N+1 while the pool decodes
+        # message N — so zlib inflate no longer serializes inside the
+        # recv loop. Yield order stays receive order (FIFO futures).
+        self._inflate_pool = None
         # Failure-detection hook: called on a receive timeout; return True
         # to keep waiting (e.g. after verifying/respawning producers via
         # the launcher), False/None to fail fast like the reference
@@ -160,6 +179,15 @@ class RemoteStream:
                 if op == "connect" and addr in self.addresses:
                     self.addresses.remove(addr)
 
+    def set_inflate_pool(self, pool) -> None:
+        """Attach a shared ``concurrent.futures`` executor (or ``None``
+        to detach). The pool is read ONCE when iteration starts — a
+        change takes effect at the next ``__iter__``, never mid-stream
+        (the sharded ingest pool attaches before spawning its worker
+        threads, and must not shut the executor down while a stream it
+        handed it to is still iterating)."""
+        self._inflate_pool = pool
+
     def request_stop(self) -> None:
         """Ask a blocked iteration to exit at the next poll slice
         (<=250 ms away) instead of after the full ``timeoutms``. Safe to
@@ -174,20 +202,25 @@ class RemoteStream:
     def clear_stop_request(self) -> None:
         self._stop_requested = False
 
-    def _recv_sliced(self, recv):
+    def _recv_sliced(self, recv, frames_only: bool = False):
         """One logical receive with ``timeoutms`` semantics, polled in
         <=250 ms slices so :meth:`request_stop` is honored promptly.
         Returns None when stopped; raises ``ReceiveTimeoutError`` after
-        the full timeout like a single blocking recv would."""
+        the full timeout like a single blocking recv would.
+        ``frames_only=True`` returns the RAW frame buffers (the
+        decode-ahead path decodes on the shared pool instead)."""
         deadline = time.monotonic() + self.timeoutms / 1e3
         while True:
             self._apply_membership(recv)
             if self._stop_requested:
                 return None
             remaining_ms = (deadline - time.monotonic()) * 1e3
+            slice_ms = max(0, min(250, int(remaining_ms)))
             try:
+                if frames_only:
+                    return recv.recv_frames(timeoutms=slice_ms)
                 return recv.recv(
-                    timeoutms=max(0, min(250, int(remaining_ms))),
+                    timeoutms=slice_ms,
                     copy_arrays=self.copy_arrays,
                 )
             except ReceiveTimeoutError:
@@ -216,6 +249,83 @@ class RemoteStream:
             share += self.max_items % self.num_workers
         return share
 
+    def _account(self, msg, raw, recorder):
+        """Shared per-message tail of both receive loops: recorder tee,
+        lineage + trace accounting, item transform.
+
+        Frame lineage: pop the publisher's seq/time stamps (+ any
+        piggybacked telemetry snapshot) and account them — per-producer
+        e2e staleness histograms and EXACT drop/reorder counts
+        (docs/observability.md). Runs after the recorder tee
+        (recordings keep the stamps) and before item_transform
+        (transforms see the same message shape as before PR 4). The
+        sharded ingest pool inherits this per shard stream: each
+        producer's numbering lands whole on one shard socket, so
+        round-robin partitioning can't fake a gap."""
+        if recorder is not None:
+            recorder.save(raw)
+        lineage.ingest(msg, track_gaps=self.track_gaps)
+        # Distributed frame trace: stamp the consumer-side arrival on
+        # the sampled subset (one dict lookup per message off the
+        # sampled path — no allocations).
+        tr = msg.get(TRACE_KEY)
+        if tr is not None:
+            trace_stage(tr, "recv")
+        return self.item_transform(msg)
+
+    def _iter_decode_ahead(self, recv, recorder, limit, pool):
+        """Pipelined receive loop: the iterating thread parks in recv
+        while the shared pool decodes the previous message — zlib's
+        GIL-released inflate (the whole "ndz" cost) overlaps the next
+        socket wait instead of serializing in front of it. Yield order
+        is receive order (FIFO futures), so lineage/seq accounting and
+        the recorder tee observe exactly the inline loop's sequence;
+        decode errors surface at their message's position. The decode
+        futures run with the channel's intra-message pool detached —
+        a decode job that re-submitted per-field inflates to the SAME
+        small executor could deadlock it (parents holding every worker
+        while their children queue)."""
+        n = 0
+        pending: collections.deque = collections.deque()
+        while limit is None or n < limit:
+            if self._stop_requested:
+                return  # at-most-once: in-flight decodes are dropped
+            raw = None
+            if not pending:
+                try:
+                    raw = self._recv_sliced(recv, frames_only=True)
+                except ReceiveTimeoutError:
+                    if self.on_timeout is not None and self.on_timeout():
+                        continue
+                    raise
+                if raw is None:  # request_stop(): exit through cleanup
+                    return
+            elif limit is None or n + len(pending) < limit:
+                # a decode is in flight: opportunistic non-blocking
+                # fill, else fall through and emit the oldest. Gated on
+                # the remaining budget — an over-received message would
+                # be consumed off the socket but never yielded/teed/
+                # lineage-ingested (the inline loop receives exactly
+                # `limit`).
+                self._apply_membership(recv)
+                try:
+                    raw = recv.recv_frames(timeoutms=0)
+                except ReceiveTimeoutError:
+                    raw = None
+            if raw is not None:
+                pending.append(
+                    (pool.submit(recv.decode_frames, raw,
+                                 self.copy_arrays), raw)
+                )
+                metrics.count("wire.pool_decodes")
+                if len(pending) < DECODE_AHEAD and (
+                    limit is None or n + len(pending) < limit
+                ):
+                    continue
+            fut, raw = pending.popleft()
+            yield self._account(fut.result(), raw, recorder)
+            n += 1
+
     def __iter__(self):
         # Socket construction is deferred to iteration so the stream object
         # can cross a process fork first (reference ``dataset.py:64-78``).
@@ -227,6 +337,7 @@ class RemoteStream:
             queue_size=self.queue_size,
             timeoutms=self.timeoutms,
             allow_pickle=self.allow_pickle,
+            defer_rle=self.defer_rle,
         )
         recorder = None
         try:
@@ -237,6 +348,12 @@ class RemoteStream:
                     ),
                     max_messages=self.record_max_messages,
                 ).__enter__()
+            pool = self._inflate_pool
+            if pool is not None:
+                yield from self._iter_decode_ahead(
+                    recv, recorder, limit, pool
+                )
+                return
             n = 0
             while limit is None or n < limit:
                 try:
@@ -248,26 +365,7 @@ class RemoteStream:
                 if out is None:  # request_stop(): exit through cleanup
                     return
                 msg, raw = out
-                if recorder is not None:
-                    recorder.save(raw)
-                # Frame lineage: pop the publisher's seq/time stamps (+
-                # any piggybacked telemetry snapshot) and account them —
-                # per-producer e2e staleness histograms and EXACT
-                # drop/reorder counts (docs/observability.md). Runs
-                # after the recorder tee (recordings keep the stamps)
-                # and before item_transform (transforms see the same
-                # message shape as before PR 4). The sharded ingest
-                # pool inherits this per shard stream: each producer's
-                # numbering lands whole on one shard socket, so
-                # round-robin partitioning can't fake a gap.
-                lineage.ingest(msg, track_gaps=self.track_gaps)
-                # Distributed frame trace: stamp the consumer-side
-                # arrival on the sampled subset (one dict lookup per
-                # message off the sampled path — no allocations).
-                tr = msg.get(TRACE_KEY)
-                if tr is not None:
-                    trace_stage(tr, "recv")
-                yield self.item_transform(msg)
+                yield self._account(msg, raw, recorder)
                 n += 1
         finally:
             if recorder is not None:

@@ -133,6 +133,27 @@ def _parse(argv):
     )
     parser.add_argument("--trace-every", type=int, default=0)
     parser.add_argument(
+        "--wire", choices=("raw", "ndz", "ndr"), default="raw",
+        help="wire compression: raw frames (default), zlib 'ndz' "
+        "(host inflate on the consumer), or run-length 'ndr' (near-"
+        "free host inflate; deferred into the consumer's train jit on "
+        "the fused path). Both compressed modes publish _prebatched "
+        "(opaque pass-through) so the consumer's batch shapes never "
+        "enter schema assembly — the tile-stream contract.",
+    )
+    parser.add_argument(
+        "--rle-cap", type=int, default=0, metavar="N",
+        help="pin the ndr per-row pair capacity (fleet-wide packed-"
+        "shape stability, like TileBatchPublisher capacity); 0 = "
+        "sticky per-key capacity",
+    )
+    parser.add_argument(
+        "--quantize-xy", action="store_true",
+        help="ship the xy point labels as float16 on the wire "
+        "(integer pixel coordinates are exact; dequantized in-jit by "
+        "the consumer's f32 input cast)",
+    )
+    parser.add_argument(
         "--scenario-wait", type=float, default=None, metavar="S",
         help="consume a scenario space over the CTRL duplex socket "
         "(blendjax.scenario): wait up to S seconds for the first "
@@ -188,6 +209,18 @@ def main(argv=None) -> int:
     pub = DataPublisher(
         bind_addr, btid=btid, lingerms=10_000, send_hwm=2,
         trace_every=opts.trace_every,
+        compress_level=6 if opts.wire == "ndz" else 0,
+        compress_rle=opts.wire == "ndr",
+        rle_cap=opts.rle_cap or None,
+        **({"compress_min_bytes": 1024} if opts.wire != "raw" else {}),
+        quantize_f16=("xy",) if opts.quantize_xy else (),
+    )
+    # Compressed-wire modes publish opaque prebatched messages (the
+    # tile-stream pass-through): deferred "ndr" buffers have content-
+    # dependent packed shapes that must never enter schema assembly.
+    batch_stamp = (
+        {"_prebatched": True} if opts.wire != "raw"
+        else {"_batched": True}
     )
 
     # Scenario consumer (docs/scenarios.md): the duplex channel binds
@@ -283,7 +316,7 @@ def main(argv=None) -> int:
         cursor["i"] += 1
         if cursor["i"] == b:
             trackers[slot] = pub.publish_tracked(
-                _batched=True, **stamp["fields"], **pool[slot]
+                **batch_stamp, **stamp["fields"], **pool[slot]
             )
             cursor["i"] = 0
             cursor["slot"] = (slot + 1) % len(pool)
@@ -305,7 +338,7 @@ def main(argv=None) -> int:
             # partial tail: copy the filled prefix — the pool slot is
             # reused, publish-by-reference would race the IO thread
             pub.publish(
-                _batched=True, **stamp["fields"],
+                **batch_stamp, **stamp["fields"],
                 **{k: v[:i].copy() for k, v in buf.items()},
             )
 

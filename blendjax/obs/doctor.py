@@ -217,7 +217,8 @@ def diagnose(
             f"decode.dispatch share={shares['decode.dispatch']:.0%} "
             "dominates the loop",
             "fuse the decode into the step (emit_packed + "
-            "make_fused_tile_step) or revisit tile geometry",
+            "make_fused_tile_step — run-length 'ndr' wire frames then "
+            "expand in-jit too) or revisit tile geometry",
             shares,
         )
 
@@ -251,8 +252,12 @@ def diagnose(
                 f"{shares['ingest.queue_wait']:.0%}) and frames arrive "
                 f"{staleness_p95_s * 1e3:.0f} ms old (p95): the "
                 "socket/codec path is slow, not the producers",
-                "enable wire compression (compress_level), raise "
-                "ingest_workers, or fix the link",
+                "enable wire compression (compress_level zlib, or "
+                "compress_rle for run-heavy frames — near-free "
+                "inflate, in-jit on the fused path), raise "
+                "ingest_workers (whose shared inflate pool pipelines "
+                "decode-ahead; wire.inflate_ms shows the host decode "
+                "cost), or fix the link",
                 shares,
             )
         fresh = (

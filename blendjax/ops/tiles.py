@@ -706,6 +706,221 @@ def expand_palette_tiles_np(packed, palette, bits: int, t, c: int):
     return palette[idx].reshape(*lead, th, tw, c)
 
 
+# -- run-length "ndr" tile-group codec (host encode / device expand) --------
+#
+# Palette indices (and flat-shaded uint8 frames generally) are run-heavy:
+# a background-dominated row is a handful of (value, run) pairs. The
+# "ndr" wire kind (blendjax.transport.wire) ships those pairs instead of
+# zlib streams, so the consumer either inflates with one vectorized
+# np.repeat (still ~10x cheaper than a zlib inflate) or — the fused
+# path — defers the expansion to a jitted gather INSIDE the train
+# dispatch (:func:`rle_expand_packed`), where it costs zero host time.
+#
+# Packed per-row layout (one uint8 buffer of shape (rows, cap*(isz+2))):
+#   [values: cap x isz bytes][run lo-bytes: cap][run hi-bytes: cap]
+# ``isz`` is the run item width in bytes (4 for RGBA pixel runs, 1 for
+# palette indices); runs are uint16 split into explicit lo/hi planes so
+# host and device decode share one endian-free definition. Unused tail
+# entries carry run == 0 and expand to nothing. ``cap`` is the per-row
+# pair capacity — sticky per publisher key and bucket-rounded, so the
+# packed shape (and with it the consumer's jit cache) stays stable
+# across frames, exactly like ``pack_batch``'s tile capacity.
+
+NDR_SUFFIX = "__ndr"          # deferred packed run buffer (rows, stride)
+NDRSPEC_SUFFIX = "__ndrspec"  # sidecar [shape, isz, cap] riding the batch
+
+RLE_MAX_RUN = 0xFFFF  # uint16 run length; longer runs split at encode
+RLE_BUCKET = 64       # cap rounding granularity (jit-cache stability)
+
+
+def rle_item_size(shape) -> int:
+    """Run item width in bytes for a uint8 array ``shape``: the trailing
+    channel dim when it looks like pixels ((..., C) with C <= 4), else
+    single bytes. One definition shared by encoder and decoder."""
+    if len(shape) >= 2 and 2 <= int(shape[-1]) <= 4:
+        return int(shape[-1])
+    return 1
+
+
+def rle_packed_stride(cap: int, isz: int) -> int:
+    return int(cap) * (int(isz) + 2)
+
+
+def _rle_geometry(shape, isz: int):
+    """shape -> (rows, items-per-row). Rows are the leading axis (the
+    batch of a batched field, scan lines of a single frame)."""
+    shape = tuple(int(s) for s in shape)
+    total = 1
+    for s in shape:
+        total *= s
+    rows = shape[0] if len(shape) >= 2 else 1
+    if rows <= 0 or total <= 0:
+        raise ValueError(f"ndr geometry needs a non-empty shape, got {shape}")
+    row_bytes, rem = divmod(total, rows)
+    if rem or row_bytes % isz:
+        raise ValueError(
+            f"ndr geometry {shape} does not split into rows of whole "
+            f"{isz}-byte items"
+        )
+    return rows, row_bytes // isz
+
+
+def rle_encode_rows(arr: np.ndarray, cap: int | None = None,
+                    bucket: int = RLE_BUCKET):
+    """Run-length encode a uint8 array row-wise into the packed wire
+    layout. Returns ``(buf (rows, cap*(isz+2)) uint8, cap, isz)`` or
+    ``None`` when the array is ineligible (non-uint8, empty) or does
+    not fit: a pinned ``cap`` too small for this frame's run count
+    (caller falls back to raw — the per-key skip memo in
+    ``blendjax.transport.wire`` keeps that cheap)."""
+    if not isinstance(arr, np.ndarray) or arr.dtype != np.uint8 or arr.size == 0:
+        return None
+    isz = rle_item_size(arr.shape)
+    try:
+        rows, t = _rle_geometry(arr.shape, isz)
+    except ValueError:
+        isz = 1
+        rows, t = _rle_geometry(arr.shape, isz)
+    flat = np.ascontiguousarray(arr).reshape(rows, t, isz)
+    per = []
+    kmax = 1
+    for r in range(rows):
+        row = flat[r]
+        change = np.empty(t, np.bool_)
+        change[0] = True
+        if t > 1:
+            np.any(row[1:] != row[:-1], axis=1, out=change[1:])
+        starts = np.flatnonzero(change)
+        runs = np.diff(np.append(starts, t)).astype(np.int64)
+        if len(runs) and runs.max() > RLE_MAX_RUN:
+            reps = (runs + RLE_MAX_RUN - 1) // RLE_MAX_RUN
+            vals = np.repeat(row[starts], reps, axis=0)
+            split = np.full(int(reps.sum()), RLE_MAX_RUN, np.int64)
+            split[np.cumsum(reps) - 1] = runs - (reps - 1) * RLE_MAX_RUN
+            runs = split
+        else:
+            vals = row[starts]
+        kmax = max(kmax, len(runs))
+        per.append((vals, runs))
+    if cap is not None:
+        if kmax > int(cap):
+            return None
+        cap = int(cap)
+    else:
+        bucket = max(int(bucket), 1)
+        cap = max(-(-kmax // bucket) * bucket, bucket)
+    buf = np.zeros((rows, rle_packed_stride(cap, isz)), np.uint8)
+    vals_plane = buf[:, : cap * isz].reshape(rows, cap, isz)
+    lo_plane = buf[:, cap * isz: cap * (isz + 1)]
+    hi_plane = buf[:, cap * (isz + 1):]
+    for r, (vals, runs) in enumerate(per):
+        k = len(runs)
+        vals_plane[r, :k] = vals
+        lo_plane[r, :k] = (runs & 0xFF).astype(np.uint8)
+        hi_plane[r, :k] = (runs >> 8).astype(np.uint8)
+    return buf, cap, isz
+
+
+def _rle_runs_np(buf: np.ndarray, cap: int, isz: int):
+    vals = buf[:, : cap * isz].reshape(buf.shape[0], cap, isz)
+    lo = buf[:, cap * isz: cap * (isz + 1)].astype(np.uint32)
+    hi = buf[:, cap * (isz + 1):].astype(np.uint32)
+    return vals, lo | (hi << 8)
+
+
+def rle_validate_packed(buf, shape, isz: int, cap: int) -> None:
+    """Hostile-stream guards for a packed run buffer — the ndz decode
+    bounds carried over to the DEFERRED device plan: allocation is
+    bounded by the declared shape, the buffer must carry exactly the
+    declared capacity, and each row's runs must sum to the declared
+    item count (truncated or padded streams fail loudly here instead of
+    expanding to garbage inside the train jit). Cheap: reads only the
+    2*cap run bytes per row, never the values."""
+    isz, cap = int(isz), int(cap)
+    if isz < 1 or isz > 16 or cap < 1:
+        raise ValueError(f"ndr spec out of bounds (isz={isz}, cap={cap})")
+    rows, t = _rle_geometry(shape, isz)  # raises on zero-byte shapes
+    buf = np.asarray(buf)
+    if buf.dtype != np.uint8 or buf.shape != (rows, rle_packed_stride(cap, isz)):
+        raise ValueError(
+            f"ndr buffer shape {buf.shape}/{buf.dtype} does not match "
+            f"declared rows={rows} cap={cap} isz={isz}"
+        )
+    _, runs = _rle_runs_np(buf, cap, isz)
+    sums = runs.sum(axis=1)
+    if not (sums == t).all():
+        raise ValueError(
+            f"ndr rows do not expand to the declared {t} items "
+            f"(row sums {sums.min()}..{sums.max()})"
+        )
+
+
+def rle_expand_packed_np(buf: np.ndarray, shape, isz: int, cap: int):
+    """Host (numpy) inverse of :func:`rle_encode_rows` — what the wire
+    decode uses when the consumer does not defer to device. Validates
+    first (same guards as the deferred plan)."""
+    rle_validate_packed(buf, shape, isz, cap)
+    shape = tuple(int(s) for s in shape)
+    rows, _t = _rle_geometry(shape, int(isz))
+    vals, runs = _rle_runs_np(np.asarray(buf), int(cap), int(isz))
+    out = np.concatenate(
+        [np.repeat(vals[r], runs[r], axis=0) for r in range(rows)]
+    )
+    return out.reshape(shape)
+
+
+def rle_expand_packed(buf, shape, isz: int, cap: int):
+    """Device-side (jit-safe) inverse of :func:`rle_encode_rows`: one
+    ``cumsum`` over the run planes plus one ``searchsorted`` gather per
+    row — the scan/gather that lets ``make_fused_tile_step`` decompress
+    the wire INSIDE the train dispatch with zero host inflate cost.
+    Static shapes come from the decode plan; a hostile buffer that
+    slipped past host validation can only produce wrong pixels, never
+    out-of-bounds memory (indices clamp to ``cap``)."""
+    import jax
+    import jax.numpy as jnp
+
+    shape = tuple(int(s) for s in shape)
+    isz, cap = int(isz), int(cap)
+    rows, t = _rle_geometry(shape, isz)
+    buf = buf.reshape(rows, rle_packed_stride(cap, isz))
+    vals = buf[:, : cap * isz].reshape(rows, cap, isz)
+    lo = buf[:, cap * isz: cap * (isz + 1)].astype(jnp.uint32)
+    hi = buf[:, cap * (isz + 1):].astype(jnp.uint32)
+    ends = jnp.cumsum(lo | (hi << 8), axis=1)
+    pos = jnp.arange(t, dtype=jnp.uint32)
+    idx = jax.vmap(
+        lambda e: jnp.searchsorted(e, pos, side="right")
+    )(ends)
+    out = jax.vmap(lambda v, i: v[jnp.minimum(i, cap - 1)])(vals, idx)
+    return out.reshape(shape)
+
+
+def pop_rle_batches(fields: dict):
+    """Detect+pop deferred run-length sidecars from a host batch:
+    returns the static plan ``((base, (shape, isz, cap)), ...)`` and
+    removes each ``<base>__ndrspec`` entry (the ``<base>__ndr`` buffer
+    stays for packing/transfer). The shared bookkeeping for every
+    consumer of deferred "ndr" wire frames."""
+    out = []
+    for key in [k for k in fields if k.endswith(NDRSPEC_SUFFIX)]:
+        base = key[: -len(NDRSPEC_SUFFIX)]
+        shape, isz, cap = fields.pop(key)
+        out.append((base, (tuple(int(s) for s in shape), int(isz), int(cap))))
+    return tuple(out)
+
+
+def expand_rle_fields(fields: dict, rle_groups) -> dict:
+    """Expand every deferred run buffer of an (unpacked, on-device)
+    field dict in place — jit-safe; runs FIRST in the decode entry
+    points below so palette/tile expansion sees the restored fields."""
+    for base, (shape, isz, cap) in rle_groups:
+        fields[base] = rle_expand_packed(
+            fields.pop(base + NDR_SUFFIX), shape, isz, cap
+        )
+    return fields
+
+
 # -- packed single-transfer form --------------------------------------------
 #
 # On remote/tunneled device hosts every host->device op pays a round trip,
@@ -797,7 +1012,8 @@ def unpack_fields(buf, spec):
 
 
 def decode_packed_superbatch(packed, refs, spec, names, geoms,
-                             mesh=None, data_axis: str = "data"):
+                             mesh=None, data_axis: str = "data",
+                             rle_groups=()):
     """Decode a stacked packed chunk group to full fields — jit-safe.
 
     ``packed``: (K, total) uint8, K packed batches of identical layout
@@ -814,7 +1030,9 @@ def decode_packed_superbatch(packed, refs, spec, names, geoms,
     """
     import jax
 
-    fields = jax.vmap(lambda p: unpack_fields(p, spec))(packed)
+    fields = jax.vmap(
+        lambda p: expand_rle_fields(unpack_fields(p, spec), rle_groups)
+    )(packed)
     for name, geom in zip(names, geoms):
         idx = fields.pop(name + TILEIDX_SUFFIX)
         tiles = pop_tile_payload(fields, name, geom, expand_palette_tiles)
@@ -830,17 +1048,20 @@ def decode_packed_superbatch(packed, refs, spec, names, geoms,
     return fields
 
 
-def decode_packed_pal_batch(packed, spec, pal_groups):
+def decode_packed_pal_batch(packed, spec, pal_groups, rle_groups=()):
     """Decode ONE packed full-frame-palette batch to full fields —
     jit-safe (slice/bitcast unpack + the byte-LUT palette gather).
 
     ``packed``: (total,) uint8 buffer of :func:`pack_fields` layout
     ``spec``; ``pal_groups``: ``((name, (h, w, c, bits)), ...)`` as
-    produced by :func:`pop_frame_palette_batches`. Shared by
-    :class:`blendjax.data.TileStreamDecoder` (decode-then-step) and
+    produced by :func:`pop_frame_palette_batches`; ``rle_groups``: the
+    deferred run-length plan from :func:`pop_rle_batches`, expanded
+    first (a palette index plane may itself ride the wire run-packed,
+    and a raw uint8 frame may ride with ``pal_groups`` empty). Shared
+    by :class:`blendjax.data.TileStreamDecoder` (decode-then-step) and
     :func:`blendjax.train.make_fused_tile_step` (decode fused into the
     train jit), so the two paths cannot drift."""
-    fields = unpack_fields(packed, spec)
+    fields = expand_rle_fields(unpack_fields(packed, spec), rle_groups)
     for name, (h, w, c, bits) in pal_groups:
         fields[name] = pop_frame_palette_payload(
             fields, name, bits, h, w, c, expand_palette_frames
@@ -848,7 +1069,7 @@ def decode_packed_pal_batch(packed, spec, pal_groups):
     return fields
 
 
-def decode_packed_pal_superbatch(packed, spec, pal_groups):
+def decode_packed_pal_superbatch(packed, spec, pal_groups, rle_groups=()):
     """(K', total) stacked packed pal buffers -> (K', B, ...) superbatch
     fields — each group member gathers through its OWN palette (vmap
     over the chunk axis). The full-frame-palette twin of
@@ -857,7 +1078,7 @@ def decode_packed_pal_superbatch(packed, spec, pal_groups):
     import jax
 
     return jax.vmap(
-        lambda p: decode_packed_pal_batch(p, spec, pal_groups)
+        lambda p: decode_packed_pal_batch(p, spec, pal_groups, rle_groups)
     )(packed)
 
 

@@ -24,6 +24,9 @@ class DataPublisher(DataPublisherSocket):
         copy: bool = False,
         compress_level: int = 0,
         compress_min_bytes: int = DEFAULT_COMPRESS_MIN_BYTES,
+        compress_rle: bool = False,
+        rle_cap: int | None = None,
+        quantize_f16=(),
         lineage: bool = True,
         telemetry_every: int = 64,
         trace_every: int = 64,
@@ -44,6 +47,9 @@ class DataPublisher(DataPublisherSocket):
             copy=copy,
             compress_level=compress_level,
             compress_min_bytes=compress_min_bytes,
+            compress_rle=compress_rle,
+            rle_cap=rle_cap,
+            quantize_f16=quantize_f16,
             lineage=lineage,
             telemetry_every=telemetry_every,
             trace_every=trace_every,

@@ -85,9 +85,20 @@ class TrainDriver:
                  buckets=None, flops_per_image: float | None = None,
                  peak_flops: float | None = None,
                  checkpoint=None, checkpoint_every: int = 0,
-                 session_state=None):
+                 session_state=None, place=None):
         self.step = step
         self.state = state
+        # Placement folded into the dispatch (docs/performance.md
+        # "Closing the live-MFU gap", lever 3): when `place` is set —
+        # typically ``pipeline.feeder.place`` with
+        # ``StreamDataPipeline(place_in_driver=True)`` — submit()
+        # receives HOST batches and commits the one grouped async
+        # ``device_put`` right before the step dispatch, so the
+        # transfer overlaps the in-flight steps this ring tracks
+        # instead of running as a separate host-blocking feeder stage.
+        # Retirement readiness already polls the step's global output,
+        # which transitively covers the transfer.
+        self.place = place
         self.inflight = max(1, int(inflight))
         self.sync_every = max(0, int(sync_every or 0))
         self.pad_partial = bool(pad_partial)
@@ -266,6 +277,14 @@ class TrainDriver:
             from blendjax.data.batcher import pad_to_bucket
 
             batch = pad_to_bucket(batch, buckets=self.buckets)
+        if self.place is not None:
+            # Free a ring slot FIRST so at most `inflight` transfer+step
+            # pairs are outstanding, then commit the grouped async
+            # placement — it overlaps every older in-flight dispatch.
+            # Runs before the trace pop below so the "place" stamp
+            # precedes "step_dispatch" like it does on the feeder path.
+            self.ensure_ring_slot()
+            batch = self.place(batch)
         # Frame traces must come OFF the batch before the step call:
         # a trace dict is host-side metadata no jit can consume (the
         # same contract as `_meta`, which the step builders filter).

@@ -248,7 +248,7 @@ class MeshTrainDriver(TrainDriver):
                  peak_flops_per_chip: float | None = None,
                  peak_flops: float | None = None,
                  checkpoint=None, checkpoint_every: int = 0,
-                 session_state=None):
+                 session_state=None, place=None):
         from blendjax.parallel.sharding import mesh_chip_count
 
         self.mesh = mesh
@@ -261,7 +261,7 @@ class MeshTrainDriver(TrainDriver):
             pad_partial=pad_partial, buckets=buckets,
             flops_per_image=flops_per_image, peak_flops=peak_flops,
             checkpoint=checkpoint, checkpoint_every=checkpoint_every,
-            session_state=session_state,
+            session_state=session_state, place=place,
         )
 
     @classmethod

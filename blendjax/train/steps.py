@@ -352,10 +352,12 @@ def make_fused_tile_step(
     base_rng = _resolve_augment_rng(augment, augment_rng)
     pin = superbatch_constraint or (lambda sb: sb)
 
-    def _fused(state, packed, refs, spec, names, geoms):
+    def _fused(state, packed, refs, spec, names, geoms, rle):
         from blendjax.ops.tiles import decode_packed_superbatch
 
-        superbatch = decode_packed_superbatch(packed, refs, spec, names, geoms)
+        superbatch = decode_packed_superbatch(
+            packed, refs, spec, names, geoms, rle_groups=rle
+        )
         state, losses = jax.lax.scan(
             _chunk_scan_body(loss_fn, augment, base_rng, precision), state,
             pin(superbatch),
@@ -364,15 +366,17 @@ def make_fused_tile_step(
 
     fused = jax.jit(
         _fused,
-        static_argnames=("spec", "names", "geoms"),
+        static_argnames=("spec", "names", "geoms", "rle"),
         donate_argnums=(0,) if donate else (),
         **_sharding_jit_kwargs(state_sharding, n_data_args=2),
     )
 
-    def _fused_pal(state, packed, spec, pal_groups):
+    def _fused_pal(state, packed, spec, pal_groups, rle):
         from blendjax.ops.tiles import decode_packed_pal_superbatch
 
-        superbatch = decode_packed_pal_superbatch(packed, spec, pal_groups)
+        superbatch = decode_packed_pal_superbatch(
+            packed, spec, pal_groups, rle
+        )
         state, losses = jax.lax.scan(
             _chunk_scan_body(loss_fn, augment, base_rng, precision), state,
             pin(superbatch),
@@ -381,7 +385,7 @@ def make_fused_tile_step(
 
     fused_pal = jax.jit(
         _fused_pal,
-        static_argnames=("spec", "pal_groups"),
+        static_argnames=("spec", "pal_groups", "rle"),
         donate_argnums=(0,) if donate else (),
         **_sharding_jit_kwargs(state_sharding),
     )
@@ -389,15 +393,19 @@ def make_fused_tile_step(
     def step(state, batch):
         # static decode-plan args go POSITIONALLY: jit rejects keyword
         # arguments once in_shardings is pinned (the mesh path), and
-        # the plain path resolves them identically either way
+        # the plain path resolves them identically either way. `_rle`
+        # is the deferred run-length expansion plan ("ndr" wire frames
+        # decompressed INSIDE this dispatch — docs/wire-protocol.md).
         if "_pal" in batch:
             return fused_pal(
-                state, batch["_packed"], batch["_spec"], batch["_pal"]
+                state, batch["_packed"], batch["_spec"], batch["_pal"],
+                batch.get("_rle", ()),
             )
         if "_packed" in batch:
             return fused(
                 state, batch["_packed"], batch["_refs"],
                 batch["_spec"], batch["_names"], batch["_geoms"],
+                batch.get("_rle", ()),
             )
         fields = {
             k: v for k, v in batch.items()

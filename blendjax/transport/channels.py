@@ -31,6 +31,7 @@ import zmq
 from blendjax import constants
 from blendjax.transport.wire import (
     DEFAULT_COMPRESS_MIN_BYTES,
+    WireCompressState,
     decode_message,
     encode_message,
 )
@@ -100,22 +101,43 @@ class _Channel:
         self.poller = zmq.Poller()
         self.poller.register(self.sock, zmq.POLLIN)
 
-    def _poll_recv(self, timeoutms: int, copy_arrays: bool):
-        """Receive+decode one message within ``timeoutms``; returns
-        ``(message, raw_buffers)`` or ``None`` on timeout."""
+    # Deferred run-length decode: class-level default so every channel
+    # decodes identically unless its owner (DataReceiverSocket) opts in.
+    defer_rle: bool = False
+
+    def _poll_frames(self, timeoutms: int):
+        """Receive one raw multipart message within ``timeoutms``;
+        returns the frame buffers or ``None`` on timeout. Decode is
+        separate (:meth:`decode_frames`) so callers owning an inflate
+        pool can pipeline receive against decode."""
         socks = dict(self.poller.poll(timeoutms))
         if self.sock not in socks:
             return None
         frames = _as_frames(self.sock.recv_multipart(copy=False))
-        buffers = [f.buffer for f in frames]
-        return (
-            decode_message(
-                buffers, copy_arrays=copy_arrays,
-                allow_pickle=self.allow_pickle,
-                count_metrics=self.wire_metrics,
-            ),
-            buffers,
+        return [f.buffer for f in frames]
+
+    def decode_frames(self, buffers, copy_arrays: bool = False):
+        """Decode raw frame buffers with this channel's configured
+        semantics (pickle policy, wire metrics, deferred rle) — the ONE
+        decode call both the inline and the decode-ahead receive paths
+        share. Intra-message parallel inflate stays a direct
+        ``decode_message(inflate_pool=)`` surface: the stream path's
+        whole-message decode-ahead subsumes it and must not re-enter
+        the same executor from inside a decode job."""
+        return decode_message(
+            buffers, copy_arrays=copy_arrays,
+            allow_pickle=self.allow_pickle,
+            count_metrics=self.wire_metrics,
+            defer_rle=self.defer_rle,
         )
+
+    def _poll_recv(self, timeoutms: int, copy_arrays: bool):
+        """Receive+decode one message within ``timeoutms``; returns
+        ``(message, raw_buffers)`` or ``None`` on timeout."""
+        buffers = self._poll_frames(timeoutms)
+        if buffers is None:
+            return None
+        return self.decode_frames(buffers, copy_arrays), buffers
 
     def close(self):
         # No linger override: close() keeps queued messages alive for
@@ -158,6 +180,9 @@ class DataPublisherSocket(_Channel):
         copy: bool = False,
         compress_level: int = 0,
         compress_min_bytes: int = DEFAULT_COMPRESS_MIN_BYTES,
+        compress_rle: bool = False,
+        rle_cap: int | None = None,
+        quantize_f16=(),
         lineage: bool = True,
         telemetry_every: int = 64,
         trace_every: int = 64,
@@ -171,6 +196,22 @@ class DataPublisherSocket(_Channel):
         # links, the wrong one on ipc/loopback (docs/performance.md).
         self.compress_level = int(compress_level)
         self.compress_min_bytes = int(compress_min_bytes)
+        # Run-length "ndr" wire frames (docs/wire-protocol.md): cheap
+        # host encode, near-free consumer inflate, and — on the fused
+        # tile path — expansion deferred INTO the consumer's train jit.
+        # rle_cap pins the packed per-row capacity fleet-wide (the
+        # TileBatchPublisher capacity contract); quantize_f16 names
+        # float sidecar fields to ship half-width (lossy; exact for
+        # integer pixel coordinates up to 2048).
+        self.compress_rle = bool(compress_rle)
+        self.rle_cap = int(rle_cap) if rle_cap else None
+        self.quantize_f16 = tuple(quantize_f16)
+        # Reusable per-publisher compression state: compressobj
+        # templates, the incompressible-key skip memo, sticky rle caps.
+        self._wire_state = (
+            WireCompressState()
+            if (self.compress_level > 0 or self.compress_rle) else None
+        )
         # Frame lineage (docs/observability.md): every message carries a
         # wall + monotonic publish time and a per-publisher monotonic
         # sequence number, and every `telemetry_every`-th message
@@ -265,6 +306,10 @@ class DataPublisherSocket(_Channel):
             data, codec=self.codec,
             compress_level=self.compress_level,
             compress_min_bytes=self.compress_min_bytes,
+            compress_rle=self.compress_rle,
+            rle_cap=self.rle_cap,
+            quantize_f16=self.quantize_f16,
+            state=self._wire_state,
         )
 
     def publish_tracked(self, **kwargs):
@@ -301,12 +346,17 @@ class DataReceiverSocket(_Channel):
         queue_size: int = constants.DEFAULT_QUEUE_SIZE,
         timeoutms: int = constants.DEFAULT_TIMEOUTMS,
         allow_pickle: bool = True,
+        defer_rle: bool = False,
     ):
         if isinstance(addresses, str):
             addresses = [addresses]
         self.addresses = list(addresses)
         self.timeoutms = timeoutms
         self.allow_pickle = allow_pickle
+        # defer_rle: leave "ndr" frames of prebatched messages packed
+        # for a device-side expansion plan (the fused tile path) —
+        # see blendjax.transport.wire.TensorCodec.decode.
+        self.defer_rle = bool(defer_rle)
         self.sock = zmq_context().socket(zmq.PULL)
         self.sock.setsockopt(zmq.RCVHWM, queue_size)
         self.sock.setsockopt(zmq.LINGER, 0)
@@ -322,6 +372,19 @@ class DataReceiverSocket(_Channel):
                 f"no message within {t} ms from {self.addresses}"
             )
         return out
+
+    def recv_frames(self, timeoutms: int | None = None):
+        """Receive one message's RAW frame buffers (no decode) — the
+        receive half of the decode-ahead pipeline (RemoteStream hands
+        the buffers to a shared inflate executor and yields decoded
+        messages in receive order)."""
+        t = self.timeoutms if timeoutms is None else timeoutms
+        buffers = self._poll_frames(t)
+        if buffers is None:
+            raise ReceiveTimeoutError(
+                f"no message within {t} ms from {self.addresses}"
+            )
+        return buffers
 
     # -- elastic membership (fleet controller substrate) ---------------------
     # ZMQ sockets are single-thread: both calls below must run on the

@@ -42,6 +42,7 @@ Semantics and safety notes:
 from __future__ import annotations
 
 import pickle
+import time
 import zlib
 
 import numpy as np
@@ -65,6 +66,68 @@ PICKLE_PROTOCOL = 4
 DEFAULT_COMPRESS_MIN_BYTES = 16_384
 
 
+class WireCompressState:
+    """Per-publisher compression working state (one instance per
+    :class:`~blendjax.transport.channels.DataPublisherSocket`).
+
+    Three jobs, all bounded:
+
+    - a reusable ``zlib.compressobj`` template per level: every message
+      compresses through ``template.copy()`` instead of re-building the
+      deflate state from scratch per frame;
+    - a bounded skip memo for keys/kinds that recently LOST the size
+      check (incompressible render noise, already-palettized tiles):
+      those fields skip the trial compression for ``SKIP_FRAMES``
+      encodes before re-trying, so a loser stops paying the round trip
+      every frame while a stream that turns compressible recovers;
+    - sticky per-key run-length capacities (the ``pack_batch`` capacity
+      idiom applied to the wire): the "ndr" packed shape ratchets up on
+      overflow and never shrinks, keeping a consumer's decode-plan jit
+      cache stable across frames.
+    """
+
+    SKIP_FRAMES = 64   # trials skipped after a size-check loss
+    MEMO_LIMIT = 128   # bounded: stream content can't grow the dicts
+
+    def __init__(self):
+        self._templates: dict = {}
+        self._skip: dict = {}
+        self._caps: dict = {}
+
+    def compress(self, raw, level: int) -> bytes:
+        template = self._templates.get(level)
+        if template is None:
+            template = self._templates[level] = zlib.compressobj(level)
+        c = template.copy()
+        return c.compress(raw) + c.flush()
+
+    def should_try(self, kind: str, key) -> bool:
+        left = self._skip.get((kind, key), 0)
+        if left > 0:
+            self._skip[(kind, key)] = left - 1
+            metrics.count("wire.compress_skips")
+            return False
+        return True
+
+    def lost(self, kind: str, key) -> None:
+        if len(self._skip) >= self.MEMO_LIMIT:
+            self._skip.clear()
+        self._skip[(kind, key)] = self.SKIP_FRAMES
+
+    def won(self, kind: str, key) -> None:
+        self._skip.pop((kind, key), None)
+
+    def rle_cap(self, key):
+        return self._caps.get(key)
+
+    def set_rle_cap(self, key, cap: int) -> None:
+        if len(self._caps) >= self.MEMO_LIMIT:
+            self._caps.clear()
+        prev = self._caps.get(key, 0)
+        if cap > prev:
+            self._caps[key] = int(cap)
+
+
 def _np_scalar_to_py(value):
     if isinstance(value, np.generic):
         return value.item()
@@ -78,7 +141,10 @@ class TensorCodec:
 
     @staticmethod
     def encode(message: dict, compress_level: int = 0,
-               compress_min_bytes: int = DEFAULT_COMPRESS_MIN_BYTES) -> list:
+               compress_min_bytes: int = DEFAULT_COMPRESS_MIN_BYTES,
+               compress_rle: bool = False, rle_cap: int | None = None,
+               quantize_f16=(), state: WireCompressState | None = None,
+               ) -> list:
         """Encode ``message`` into a list of frames (bytes / memoryview).
 
         ndarray values (non-object dtype) are shipped as raw frames;
@@ -90,6 +156,26 @@ class TensorCodec:
         when the compressed stream actually shrinks; incompressible data
         (already-palettized tiles, encrypted blobs) stays raw so the
         decoder never pays an inflate for nothing.
+
+        ``compress_rle=True`` tries the run-length "ndr" kind FIRST for
+        uint8 arrays at least ``compress_min_bytes`` long (the tile-group
+        codec of :mod:`blendjax.ops.tiles`): run-heavy payloads — palette
+        index planes, flat-shaded frames — keep ~the zlib wire ratio
+        while the consumer inflates with one vectorized ``np.repeat``, or
+        defers the expansion into its train jit entirely (zero host
+        inflate). ``rle_cap`` pins the per-row pair capacity (fleet-wide
+        shape stability, the ``TileBatchPublisher(capacity=...)``
+        contract); without it the capacity is sticky per key via
+        ``state``. A frame whose runs don't fit a pinned cap, or that RLE
+        fails to shrink, falls back to ndz/nd for that message — "ndr"
+        interleaves freely with both.
+
+        ``quantize_f16`` names float32/float64 fields to cast to float16
+        before encoding (lossy by design — point labels whose integer
+        pixel coordinates are exact up to 2048; consumers dequantize
+        in-jit via their existing f32 input casts). ``state`` is the
+        per-publisher :class:`WireCompressState` (compressobj reuse +
+        loss-memo + sticky caps); ``None`` keeps the stateless behavior.
         """
         if msgpack is None:  # pragma: no cover
             return PickleCodec.encode(message)
@@ -98,17 +184,62 @@ class TensorCodec:
         for key, value in message.items():
             if isinstance(value, np.ndarray) and value.dtype != object:
                 arr = np.ascontiguousarray(value)
+                if key in quantize_f16 and arr.dtype in (
+                    np.float32, np.float64
+                ):
+                    arr = arr.astype(np.float16)
                 raw = arr.data if arr.size else b""
-                if compress_level > 0 and arr.nbytes >= compress_min_bytes:
+                if (
+                    compress_rle
+                    and arr.dtype == np.uint8
+                    and arr.nbytes >= compress_min_bytes
+                    and (state is None or state.should_try("r", key))
+                ):
+                    from blendjax.ops.tiles import rle_encode_rows
+
+                    cap = rle_cap if rle_cap else (
+                        state.rle_cap(key) if state is not None else None
+                    )
+                    out = rle_encode_rows(arr, cap=cap)
+                    if out is None and cap is not None and not rle_cap:
+                        # sticky cap overflowed: re-derive (ratchets up)
+                        out = rle_encode_rows(arr)
+                    if out is not None and out[0].nbytes < arr.nbytes:
+                        buf, cap_eff, isz = out
+                        if state is not None:
+                            state.won("r", key)
+                            if not rle_cap:
+                                state.set_rle_cap(key, cap_eff)
+                        entries.append(
+                            ["ndr", key, list(arr.shape), arr.dtype.str,
+                             len(buffers), int(cap_eff), int(isz)]
+                        )
+                        buffers.append(buf)
+                        continue
+                    if state is not None:
+                        state.lost("r", key)
+                if (
+                    compress_level > 0
+                    and arr.nbytes >= compress_min_bytes
+                    and (state is None or state.should_try("z", key))
+                ):
                     # zlib takes the contiguous view directly — no copy
-                    packed = zlib.compress(raw, compress_level)
+                    packed = (
+                        state.compress(raw, compress_level)
+                        if state is not None
+                        else zlib.compress(raw, compress_level)
+                    )
                     if len(packed) < arr.nbytes:
+                        if state is not None:
+                            state.won("z", key)
                         entries.append(
                             ["ndz", key, list(arr.shape), arr.dtype.str,
                              len(buffers)]
                         )
                         buffers.append(packed)
                         continue
+                    if state is not None:
+                        state.lost("z", key)
                 entries.append(
                     ["nd", key, list(arr.shape), arr.dtype.str, len(buffers)]
                 )
@@ -126,9 +257,60 @@ class TensorCodec:
         return [header, *buffers]
 
     @staticmethod
+    def _declared_bytes(key, shape, dt: np.dtype) -> int:
+        expected = dt.itemsize
+        for dim in shape:
+            expected *= int(dim)
+        if expected <= 0:
+            raise ValueError(
+                f"compressed frame for {key!r} declares zero bytes "
+                "(empty arrays never ship compressed)"
+            )
+        return expected
+
+    @staticmethod
+    def _inflate_bounded(key, wire_buf, expected: int) -> bytes:
+        """Bounded inflate: allocation is capped at the DECLARED array
+        size — no more than an honest raw "nd" frame of the same header
+        could make us hold — so a small malicious stream can't balloon
+        memory (decompression bomb; this path is advertised safe for
+        untrusted networks under allow_pickle=False). The ONE sanctioned
+        host-inflate site (bjx-lint BJX116 flags zlib inflates added to
+        hot-path modules outside this codec/pool)."""
+        dec = zlib.decompressobj()
+        buf = dec.decompress(wire_buf, expected)
+        if not dec.eof or dec.unconsumed_tail:
+            raise ValueError(
+                f"ndz frame for {key!r} does not decompress to "
+                f"the declared {expected} bytes"
+            )
+        return buf
+
+    @staticmethod
     def decode(frames: list, copy_arrays: bool = False,
                allow_pickle: bool = True,
-               count_metrics: bool = False) -> dict:
+               count_metrics: bool = False,
+               defer_rle: bool = False,
+               inflate_pool=None) -> dict:
+        """Decode one multipart message.
+
+        ``defer_rle=True`` leaves "ndr" entries of PREBATCHED messages
+        (``_prebatched=True`` riding the header — the opaque tile-stream
+        pass-through, whose batch shapes never enter schema assembly)
+        still run-packed: the decoded dict carries ``<key>__ndr`` (the
+        packed buffer) + ``<key>__ndrspec`` (shape/item/cap plan) instead
+        of the expanded array, for a downstream device plan to expand
+        inside its decode/train jit. Non-prebatched messages always
+        expand on host so schema-assembled streams keep stable shapes.
+
+        ``inflate_pool`` (a ``concurrent.futures`` executor) inflates a
+        message's "ndz" entries in parallel — zlib releases the GIL, so
+        a multi-field frame's inflates overlap on real cores. A DIRECT-
+        consumer surface: the stream path instead pipelines whole-
+        message decode-ahead (``RemoteStream.set_inflate_pool``), whose
+        decode jobs deliberately run with this parameter unset —
+        re-submitting into the same small executor from inside a decode
+        job could deadlock it."""
         header = bytes(frames[0][: len(WIRE_MAGIC)])
         if header != WIRE_MAGIC:
             raise ValueError("not a tensor-codec message")
@@ -145,7 +327,43 @@ class TensorCodec:
         # Accumulated locally, ONE locked pair of counts per message:
         # sidecar arrays dominate frame count and this is the hot path.
         raw_bytes = wire_bytes = 0
-        for entry in entries:
+        inflate_ms = 0.0
+        if defer_rle:
+            # Deferral is per MESSAGE, decided before any array entry is
+            # touched: only opaque prebatched messages may change shape
+            # under the consumer's feet (their batches bypass schema
+            # assembly like tile batches do).
+            defer_rle = any(
+                e[0] == "obj" and e[1] == "_prebatched"
+                and bool(msgpack.unpackb(e[2], raw=False))
+                for e in entries
+            )
+        inflated: dict = {}
+        if inflate_pool is not None:
+            jobs = []
+            for i, entry in enumerate(entries):
+                if entry[0] != "ndz":
+                    continue
+                _, key, shape, dtype, idx = entry
+                expected = TensorCodec._declared_bytes(
+                    key, shape, np.dtype(dtype)
+                )
+                jobs.append((i, inflate_pool.submit(
+                    TensorCodec._inflate_bounded, key, frames[1 + idx],
+                    expected,
+                )))
+            if len(jobs) >= 2:
+                t0 = time.perf_counter()
+                for i, fut in jobs:
+                    inflated[i] = fut.result()
+                inflate_ms += (time.perf_counter() - t0) * 1e3
+            elif jobs:
+                # one job gains nothing from the pool hop's latency —
+                # but it was already submitted; harvest it inline
+                t0 = time.perf_counter()
+                inflated[jobs[0][0]] = jobs[0][1].result()
+                inflate_ms += (time.perf_counter() - t0) * 1e3
+        for i, entry in enumerate(entries):
             kind, key = entry[0], entry[1]
             if kind == "nd":
                 _, _, shape, dtype, idx = entry
@@ -158,27 +376,14 @@ class TensorCodec:
                 _, _, shape, dtype, idx = entry
                 wire_buf = frames[1 + idx]
                 dt = np.dtype(dtype)
-                expected = dt.itemsize
-                for dim in shape:
-                    expected *= int(dim)
-                if expected <= 0:
-                    raise ValueError(
-                        f"ndz frame for {key!r} declares zero bytes "
-                        "(empty arrays never ship compressed)"
+                expected = TensorCodec._declared_bytes(key, shape, dt)
+                buf = inflated.get(i)
+                if buf is None:
+                    t0 = time.perf_counter()
+                    buf = TensorCodec._inflate_bounded(
+                        key, wire_buf, expected
                     )
-                # Bounded inflate: allocation is capped at the DECLARED
-                # array size — no more than an honest raw "nd" frame of
-                # the same header could make us hold — so a small
-                # malicious stream can't balloon memory (decompression
-                # bomb; this path is advertised safe for untrusted
-                # networks under allow_pickle=False).
-                dec = zlib.decompressobj()
-                buf = dec.decompress(wire_buf, expected)
-                if not dec.eof or dec.unconsumed_tail:
-                    raise ValueError(
-                        f"ndz frame for {key!r} does not decompress to "
-                        f"the declared {expected} bytes"
-                    )
+                    inflate_ms += (time.perf_counter() - t0) * 1e3
                 arr = np.frombuffer(buf, dtype=dt).reshape(shape)
                 raw_bytes += arr.nbytes
                 wire_bytes += (
@@ -188,6 +393,59 @@ class TensorCodec:
                 # frombuffer over bytes is read-only; honor the nd-path
                 # contract (torch consumers need writable arrays)
                 out[key] = arr.copy() if copy_arrays else arr
+            elif kind == "ndr":
+                _, _, shape, dtype, idx, cap, isz = entry
+                from blendjax.ops.tiles import (
+                    NDR_SUFFIX,
+                    NDRSPEC_SUFFIX,
+                    rle_expand_packed_np,
+                    rle_packed_stride,
+                )
+
+                wire_buf = frames[1 + idx]
+                dt = np.dtype(dtype)
+                if dt != np.uint8:
+                    raise ValueError(
+                        f"ndr frame for {key!r} declares dtype {dtype!r} "
+                        "(run-length frames are uint8-only)"
+                    )
+                expected = TensorCodec._declared_bytes(key, shape, dt)
+                rows = int(shape[0]) if len(shape) >= 2 else 1
+                # the frame may be a memoryview (socket), bytes, or the
+                # publisher's 2-D staging array (in-process replay) —
+                # nbytes is the wire size for all buffer-protocol forms
+                nb = getattr(wire_buf, "nbytes", None)
+                if nb is None:
+                    nb = len(wire_buf)
+                stride = rle_packed_stride(int(cap), int(isz))
+                if rows <= 0 or nb != rows * stride:
+                    raise ValueError(
+                        f"ndr frame for {key!r} carries {nb} bytes, "
+                        f"declared {rows} rows x {stride} (truncated or "
+                        "padded stream)"
+                    )
+                buf = np.frombuffer(wire_buf, np.uint8).reshape(rows, stride)
+                raw_bytes += expected
+                wire_bytes += nb
+                if defer_rle:
+                    # Deferred device expansion: the packed buffer +
+                    # its plan ride the batch; the consumer's decode
+                    # plan re-validates (rle_validate_packed) before
+                    # any jit sees the buffer.
+                    out[key + NDR_SUFFIX] = (
+                        buf.copy() if copy_arrays else buf
+                    )
+                    out[key + NDRSPEC_SUFFIX] = [
+                        [int(s) for s in shape], int(isz), int(cap),
+                    ]
+                else:
+                    # validates (declared-size + run-sum guards) then
+                    # expands via one vectorized repeat per row; the
+                    # expansion allocates fresh, so the result is
+                    # always writable (copy_arrays moot)
+                    out[key] = rle_expand_packed_np(
+                        buf, shape, int(isz), int(cap)
+                    )
             elif kind == "obj":
                 out[key] = msgpack.unpackb(entry[2], raw=False, strict_map_key=False)
             elif kind == "pkl":
@@ -205,6 +463,10 @@ class TensorCodec:
             # pollute the compression-ratio pair the bench publishes.
             metrics.count("wire.raw_bytes", raw_bytes)
             metrics.count("wire.compressed_bytes", wire_bytes)
+            if inflate_ms:
+                # per-message host inflate cost — the histogram the
+                # ndz-vs-ndr bench legs compare (ndr legs observe ~0)
+                metrics.observe("wire.inflate_ms", inflate_ms)
         return out
 
 
@@ -231,29 +493,38 @@ CODECS = {TensorCodec.name: TensorCodec, PickleCodec.name: PickleCodec}
 
 def encode_message(message: dict, codec: str = "tensor",
                    compress_level: int = 0,
-                   compress_min_bytes: int = DEFAULT_COMPRESS_MIN_BYTES) -> list:
+                   compress_min_bytes: int = DEFAULT_COMPRESS_MIN_BYTES,
+                   compress_rle: bool = False, rle_cap: int | None = None,
+                   quantize_f16=(),
+                   state: WireCompressState | None = None) -> list:
     if codec == TensorCodec.name:
         return TensorCodec.encode(
             message, compress_level=compress_level,
             compress_min_bytes=compress_min_bytes,
+            compress_rle=compress_rle, rle_cap=rle_cap,
+            quantize_f16=quantize_f16, state=state,
         )
     return CODECS[codec].encode(message)
 
 
 def decode_message(frames: list, copy_arrays: bool = False,
                    allow_pickle: bool = True,
-                   count_metrics: bool = False) -> dict:
+                   count_metrics: bool = False,
+                   defer_rle: bool = False,
+                   inflate_pool=None) -> dict:
     """Decode frames from either codec (autodetected by leading bytes).
 
     ``count_metrics=True`` accounts the array frames into the
     ``wire.raw_bytes``/``wire.compressed_bytes`` pair — set only by
     data-stream receivers so control/RPC traffic stays out of the
-    published compression ratio."""
+    published compression ratio. ``defer_rle``/``inflate_pool`` apply to
+    tensor-codec messages only (see :meth:`TensorCodec.decode`)."""
     head = bytes(frames[0][: len(WIRE_MAGIC)])
     if head == WIRE_MAGIC:
         return TensorCodec.decode(
             frames, copy_arrays=copy_arrays, allow_pickle=allow_pickle,
-            count_metrics=count_metrics,
+            count_metrics=count_metrics, defer_rle=defer_rle,
+            inflate_pool=inflate_pool,
         )
     return PickleCodec.decode(
         frames, copy_arrays=copy_arrays, allow_pickle=allow_pickle

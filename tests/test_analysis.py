@@ -1257,7 +1257,7 @@ def test_every_rule_registered():
     assert set(all_rules()) == {
         "BJX101", "BJX102", "BJX103", "BJX104", "BJX105", "BJX106",
         "BJX107", "BJX108", "BJX109", "BJX110", "BJX111", "BJX112",
-        "BJX113", "BJX114", "BJX115",
+        "BJX113", "BJX114", "BJX115", "BJX116",
     }
 
 
@@ -1420,3 +1420,74 @@ def test_repo_is_clean_under_baseline():
     )
     left = apply_baseline(got, baseline, REPO_ROOT)
     assert left == [], "\n".join(f.render() for f in left)
+
+
+# -- BJX116 host-inflate-in-hot-path -----------------------------------------
+
+
+def test_bjx116_flags_zlib_inflate_in_hot_path_module():
+    src = """
+        # bjx: hot-path
+        import zlib
+
+        def consume(self, frames):
+            for buf in frames:
+                data = zlib.decompress(buf)
+                dec = zlib.decompressobj()
+    """
+    assert rule_ids(src, select=["BJX116"]) == ["BJX116", "BJX116"]
+
+
+def test_bjx116_flags_aliased_import_and_driver_hot_path():
+    src = """
+        # bjx: driver-hot-path
+        from zlib import decompress
+
+        def submit(self, batch):
+            raw = decompress(batch["z"])
+    """
+    assert rule_ids(src, select=["BJX116"]) == ["BJX116"]
+
+
+def test_bjx116_streaming_basenames_always_checked():
+    src = """
+        import zlib
+
+        def pump(self):
+            return zlib.decompress(self._buf)
+    """
+    assert rule_ids(
+        src, "blendjax/data/pipeline.py", select=["BJX116"]
+    ) == ["BJX116"]
+
+
+def test_bjx116_silent_outside_hot_modules_and_for_compress():
+    """The codec implementation (wire.py, unmarked) and compress-side
+    calls stay clean — only hot-path inflate is the hazard."""
+    src = """
+        import zlib
+
+        def decode(buf):
+            return zlib.decompress(buf)
+    """
+    assert rule_ids(src, select=["BJX116"]) == []
+    hot_compress = """
+        # bjx: hot-path
+        import zlib
+
+        def encode(self, raw):
+            return zlib.compress(raw, 6)
+    """
+    assert rule_ids(hot_compress, select=["BJX116"]) == []
+
+
+def test_bjx116_suppressible_inline():
+    src = """
+        # bjx: hot-path
+        import zlib
+
+        def consume(self, buf):
+            # bjx: ignore[BJX116]
+            return zlib.decompress(buf)
+    """
+    assert rule_ids(src, select=["BJX116"]) == []

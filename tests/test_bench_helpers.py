@@ -648,3 +648,42 @@ def test_live_resume_row_shape(tmp_path, monkeypatch):
     assert row["dispatch_per_step"] == 1.0
     assert row["ckpt"]["saves"] >= 1
     assert row["value"] == 1.0
+
+
+def test_wire_equality_contract():
+    """live_wire_ab's equality leg, standalone: the SAME recorded wire
+    bytes decoded as deferred "ndr" (run-length expansion inside the
+    fused train dispatch) vs host-inflated "nd" fields train to
+    IDENTICAL f32 loss — the device decompression changes where the
+    bytes expand, never what the step computes."""
+    import bench
+
+    row = bench.measure_wire_equality(steps=6)
+    assert row["identical"] is True
+    assert row["max_abs_diff"] == 0.0
+    assert row["ndr_loss"] == row["nd_loss"]
+
+
+@pytest.mark.slow
+def test_live_wire_ab_row_shape(monkeypatch):
+    """The full wire-decode A/B row against real rate-capped synthetic
+    producers: both legs report wire bytes + host decode-cost p95 +
+    settled rates, the ndr leg holds the one-dispatch contract with
+    ZERO standalone decode dispatches, no wire gaps, and the
+    live-to-step-alone ratio is computed against the SAME fused step."""
+    import bench
+
+    row = bench.measure_live_wire_ab(time_cap=6.0)
+    for name in ("ndz", "ndr"):
+        leg = row[name]
+        assert leg["steps"] > 0, (name, leg)
+        assert leg["wire_bytes"] > 0, (name, leg)
+        assert "decode_ms_p95" in leg and "settled_img_s" in leg
+    assert row["ndr"]["dispatch_per_step"] == 1.0, row["ndr"]
+    assert row["ndr"]["decode_dispatch_count"] == 0, row["ndr"]
+    assert row["ndr"]["decode_ms_p95"] == 0.0, row["ndr"]
+    assert row["ndr"]["rle_counters"].get("rle.batches", 0) > 0
+    assert row["seq_gaps"] == 0, row
+    assert row["equality"]["identical"] is True, row["equality"]
+    assert row["step_alone"]["img_s"] > 0
+    assert row["value"] == row["live_to_alone"] > 0

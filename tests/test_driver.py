@@ -423,3 +423,60 @@ def test_driver_device_timeline_and_mfu_land_in_report():
     report = reg.report()
     assert "train.mfu" not in report["gauges"]
     assert report["histograms"]["train.step_device_ms"]["count"] == 1
+
+
+def test_driver_place_mode_matches_feeder_path():
+    """Lever 3 (placement folded into the dispatch): a pipeline in
+    place_in_driver mode yields HOST batches, the driver commits the
+    grouped device_put at submit, and the trained result is identical
+    to the feeder-staged path — with the `feed.place` span now counted
+    per submit and zero standalone decode dispatches."""
+    import jax
+
+    from blendjax.data import StreamDataPipeline
+    from blendjax.models.cnn import CubeRegressor
+    from blendjax.train.steps import make_fused_tile_step, make_train_state
+    from blendjax.transport.wire import decode_message, encode_message
+
+    B, H, W = 4, 32, 32
+    frames = []
+    for i in range(6):
+        img = np.zeros((B, H, W, 4), np.uint8)
+        img[:, 4 + i:14 + i, 6:22] = (i % 3) + 1
+        xy = np.full((B, 8, 2), float(i % 9), np.float32)
+        frames.append(encode_message(
+            {"btid": 0, "_prebatched": True, "image": img, "xy": xy},
+            compress_rle=True, rle_cap=128, compress_min_bytes=512,
+        ))
+
+    def run(place_in_driver):
+        msgs = [
+            decode_message(f, defer_rle=place_in_driver) for f in frames
+        ]
+        pipe = StreamDataPipeline(
+            iter(msgs), batch_size=B, emit_packed=True,
+            place_in_driver=place_in_driver,
+        )
+        model = CubeRegressor()
+        state = make_train_state(
+            model, np.zeros((B, H, W, 4), np.uint8),
+            rng=jax.random.key(0),
+        )
+        drv = TrainDriver(
+            make_fused_tile_step(), state, inflight=2, sync_every=0,
+            place=pipe.feeder.place if place_in_driver else None,
+        )
+        with pipe:
+            for b in pipe:
+                drv.submit(b)
+        _, loss = drv.finish()
+        return drv, float(loss)
+
+    reg.reset()
+    drv_a, loss_a = run(True)
+    report = reg.report()
+    assert report["spans"]["feed.place"]["count"] == drv_a.steps
+    assert "decode.dispatch" not in report["spans"]
+    drv_b, loss_b = run(False)
+    assert drv_a.steps == drv_b.steps == 6
+    assert loss_a == loss_b

@@ -125,3 +125,136 @@ def test_device_feeder_multihost_assembles_global_batch():
     (out,) = list(feeder(batches))
     assert out["image"].sharding.is_equivalent_to(sharding, 4)
     np.testing.assert_array_equal(np.asarray(out["image"]), batches[0]["image"])
+
+
+# -- deferred run-length decode + placement levers ---------------------------
+
+
+def _ndr_messages(n, batch=4, h=32, w=32, deferred=True):
+    from blendjax.transport.wire import (
+        WireCompressState,
+        decode_message,
+        encode_message,
+    )
+
+    state = WireCompressState()
+    out = []
+    for i in range(n):
+        img = np.zeros((batch, h, w, 4), np.uint8)
+        img[:, 4 + i % 8: 16 + i % 8, 6:26] = (i % 5) + 1
+        xy = np.full((batch, 8, 2), float(i), np.float32)
+        frames = encode_message(
+            {"btid": 0, "_prebatched": True, "image": img, "xy": xy},
+            compress_rle=True, rle_cap=256, compress_min_bytes=512,
+            state=state,
+        )
+        out.append(decode_message(frames, defer_rle=deferred))
+    return out
+
+
+def test_pipeline_decodes_deferred_rle_on_device():
+    """A deferred 'ndr' stream through the NON-fused pipeline: the
+    standalone device decode expands the run buffers in its jit and the
+    consumer sees exact full frames (no host inflate anywhere)."""
+    msgs = _ndr_messages(5)
+    expect = _ndr_messages(5, deferred=False)
+    assert "image__ndr" in msgs[0]
+    with StreamDataPipeline(iter(msgs), batch_size=4) as pipe:
+        got = list(pipe)
+    assert len(got) == 5
+    for g, e in zip(got, expect):
+        np.testing.assert_array_equal(np.asarray(g["image"]), e["image"])
+        np.testing.assert_array_equal(np.asarray(g["xy"]), e["xy"])
+
+
+def test_place_in_driver_requires_emit_packed():
+    with pytest.raises(ValueError, match="emit_packed"):
+        StreamDataPipeline(
+            iter([]), batch_size=4, place_in_driver=True
+        )
+
+
+def test_place_in_driver_yields_host_batches_with_plans():
+    msgs = _ndr_messages(3)
+    pipe = StreamDataPipeline(
+        iter(msgs), batch_size=4, emit_packed=True, place_in_driver=True
+    )
+    with pipe:
+        got = list(pipe)
+    assert len(got) == 3
+    for b in got:
+        assert isinstance(b["_packed"], np.ndarray)  # still host-side
+        assert b["_rle"] and b["_rle"][0][0] == "image"
+        assert "_spec" in b and "_pal" in b
+    # the feeder's public place() commits ONE grouped transfer
+    placed = pipe.feeder.place(dict(got[0]))
+    assert isinstance(placed["_packed"], jax.Array)
+    assert placed["_rle"] == got[0]["_rle"]  # plan sidecars untouched
+
+
+def test_place_plan_memoized_per_schema_fingerprint():
+    """Satellite: steady-state placement resolves the field grouping
+    once per batch shape — one plan entry, one grouped device_put call
+    per batch, identical placement semantics."""
+    feeder = DeviceFeeder()
+    batches = [
+        {
+            "image": np.full((8, 4, 4, 4), i, np.uint8),
+            "xy": np.zeros((8, 2), np.float32),
+            "btid": 7,
+            "_meta": [{}] * 8,
+        }
+        for i in range(6)
+    ]
+    calls = []
+    real_put = jax.device_put
+
+    def counting_put(x, *a, **k):
+        calls.append(1)
+        return real_put(x, *a, **k)
+
+    import blendjax.data.pipeline as pl
+
+    orig = pl._require_jax
+
+    class _J:
+        def __getattr__(self, name):
+            if name == "device_put":
+                return counting_put
+            return getattr(jax, name)
+
+    pl._require_jax = lambda: _J()
+    try:
+        out = [feeder._place(b) for b in batches]
+    finally:
+        pl._require_jax = orig
+    assert len(calls) == len(batches)  # ONE grouped call per batch
+    assert len(feeder._place_plans) == 1  # one fingerprint, one plan
+    for i, o in enumerate(out):
+        np.testing.assert_array_equal(np.asarray(o["image"]), batches[i]["image"])
+        assert o["btid"] == 7 and o["_meta"] == batches[i]["_meta"]
+    # a different batch shape builds a second plan, not a wrong reuse
+    feeder._place({"image": np.zeros((8, 4, 4, 4), np.uint8)})
+    assert len(feeder._place_plans) == 2
+
+
+def test_driver_place_replicates_packed_buffer_on_mesh():
+    """`_packed` (the post-plan rename of `__packed__` in driver-
+    placement mode) must replicate on a mesh, never take the batch
+    sharding — byte-sharding a packed buffer splits fields mid-array."""
+    mesh, sharding = _data_sharding()
+    feeder = DeviceFeeder(sharding=sharding)
+    batch = {
+        "_packed": np.zeros((3, 100), np.uint8),  # 3 % 8 != 0 on purpose
+        "_spec": (("image", "|u1", (3, 4, 4, 4), 0, 192),),
+        "_pal": (),
+        "_rle": (),
+        "_meta": [{}],
+    }
+    placed = feeder.place(batch)
+    assert isinstance(placed["_packed"], jax.Array)
+    assert len(placed["_packed"].sharding.device_set) == len(mesh.devices.flat)
+    # replicated: every device holds the WHOLE buffer
+    shard = next(iter(placed["_packed"].addressable_shards))
+    assert shard.data.shape == (3, 100)
+    assert placed["_spec"] == batch["_spec"]  # sidecars pass through

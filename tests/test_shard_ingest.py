@@ -359,3 +359,116 @@ def test_sharded_ingest_outpaces_single_worker():
         f"worker ({single:.1f} items/s) with {n_shards} shards of "
         "GIL-releasing decode work"
     )
+
+
+# -- shared inflate pool (decode-ahead) --------------------------------------
+
+
+def test_shared_inflate_pool_wires_streams_and_preserves_content():
+    """The pool attaches one shared executor to every shard stream
+    (RemoteStream.set_inflate_pool), each stream pipelines decode-ahead
+    over real sockets with per-producer ordering intact, and stop()
+    shuts the executor down."""
+    from blendjax.data.stream import RemoteStream
+    from blendjax.utils.metrics import metrics as reg
+
+    reg.reset()
+    pubs = [
+        DataPublisherSocket(
+            "tcp://127.0.0.1:*", btid=i, compress_level=6,
+            compress_min_bytes=1024,
+        )
+        for i in range(2)
+    ]
+    ramp = np.tile(np.arange(64, dtype=np.uint8), 1024).reshape(256, 256)
+    n_per = 8
+
+    def feed():
+        for i in range(n_per):
+            for p in pubs:
+                p.publish(image=ramp + (i % 4), frameid=i)
+
+    streams = [
+        RemoteStream([p.addr], timeoutms=8000, max_items=n_per)
+        for p in pubs
+    ]
+    ingest = ShardedHostIngest(streams, batch_size=4, inflate_workers=2)
+    t = threading.Thread(target=feed)
+    t.start()
+    got = list(ingest)
+    t.join()
+    assert ingest._inflate_pool is None  # shut down with the workers
+    assert sum(len(b["frameid"]) for b in got) == 2 * n_per
+    for b in got:
+        for row, fid in zip(b["image"], b["frameid"]):
+            np.testing.assert_array_equal(row, ramp + (int(fid) % 4))
+    counters = reg.report()["counters"]
+    assert counters.get("wire.pool_decodes", 0) == 2 * n_per
+    # per-producer arrival order == publish order (FIFO futures): the
+    # lineage seq tracker saw no reorders/gaps
+    assert counters.get("wire.seq_gaps", 0) == 0
+    assert counters.get("wire.seq_reorders", 0) == 0
+    for p in pubs:
+        p.close()
+
+
+def test_inflate_workers_zero_keeps_inline_decode():
+    from blendjax.data.stream import RemoteStream
+    from blendjax.utils.metrics import metrics as reg
+
+    reg.reset()
+    pub = DataPublisherSocket(
+        "tcp://127.0.0.1:*", btid=0, compress_level=6,
+        compress_min_bytes=1024,
+    )
+    ramp = np.tile(np.arange(64, dtype=np.uint8), 1024)
+    stream = RemoteStream([pub.addr], timeoutms=8000, max_items=3)
+    ingest = ShardedHostIngest(
+        [stream], batch_size=3, inflate_workers=0
+    )
+    t = threading.Thread(
+        target=lambda: [pub.publish(image=ramp, frameid=i) for i in range(3)]
+    )
+    t.start()
+    got = list(ingest)
+    t.join()
+    assert sum(len(b["frameid"]) for b in got) == 3
+    assert ingest._inflate_pool is None
+    assert reg.report()["counters"].get("wire.pool_decodes", 0) == 0
+    pub.close()
+
+
+def test_decode_ahead_never_over_receives_past_max_items():
+    """The opportunistic non-blocking fill is gated on the remaining
+    budget: with more messages parked on the socket than max_items,
+    the stream submits EXACTLY max_items decodes — an over-received
+    message would be consumed off the socket but never yielded, teed,
+    or lineage-ingested."""
+    from concurrent.futures import ThreadPoolExecutor
+
+    from blendjax.data.stream import RemoteStream
+    from blendjax.utils.metrics import metrics as reg
+
+    reg.reset()
+    pub = DataPublisherSocket(
+        "tcp://127.0.0.1:*", btid=0, send_hwm=64, compress_level=6,
+        compress_min_bytes=1024,
+    )
+    ramp = np.tile(np.arange(64, dtype=np.uint8), 1024)
+    n = 5
+    stream = RemoteStream([pub.addr], timeoutms=8000, max_items=n)
+    pool = ThreadPoolExecutor(2)
+    stream.set_inflate_pool(pool)
+    t = threading.Thread(
+        target=lambda: [
+            pub.publish(image=ramp, frameid=i) for i in range(n + 3)
+        ]
+    )
+    t.start()
+    got = list(stream)
+    t.join()
+    assert [int(m["frameid"]) for m in got] == list(range(n))
+    counters = reg.report()["counters"]
+    assert counters.get("wire.pool_decodes", 0) == n, counters
+    pool.shutdown()
+    pub.close()

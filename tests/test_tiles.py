@@ -1763,3 +1763,80 @@ def test_pal_stream_multihost_host_expand_fallback():
         (b,) = list(pipe)
     assert b["image"].shape == (n, 16, 24, 4)
     np.testing.assert_array_equal(np.asarray(b["image"]), frames)
+
+
+# -- run-length ("ndr") tile-group codec -------------------------------------
+
+
+def test_rle_encode_expand_roundtrip_device_equals_host():
+    """rle_expand_packed (the in-jit scan/gather) and the numpy twin
+    reconstruct bit-exactly, for pixel runs (isz=4) and byte runs
+    (isz=1) including runs past the uint16 split point."""
+    import jax
+
+    from blendjax.ops.tiles import (
+        rle_encode_rows,
+        rle_expand_packed,
+        rle_expand_packed_np,
+    )
+
+    rng = np.random.default_rng(0)
+    img = np.zeros((4, 48, 48, 4), np.uint8)
+    img[:, 8:20, 4:40] = rng.integers(0, 5, (4, 12, 36, 4), dtype=np.uint8)
+    flat = np.zeros((2, 70_000), np.uint8)
+    flat[1, 500:700] = 9  # one >65535 background run split at encode
+    for arr in (img, flat):
+        buf, cap, isz = rle_encode_rows(arr)
+        host = rle_expand_packed_np(buf, arr.shape, isz, cap)
+        np.testing.assert_array_equal(host, arr)
+        dev = jax.jit(
+            rle_expand_packed, static_argnums=(1, 2, 3)
+        )(buf, arr.shape, isz, cap)
+        np.testing.assert_array_equal(np.asarray(dev), arr)
+
+
+def test_rle_validation_guards_device_plan():
+    from blendjax.ops.tiles import (
+        rle_encode_rows,
+        rle_validate_packed,
+    )
+
+    img = np.zeros((4, 32, 32, 4), np.uint8)
+    img[:, 4:12, 4:12] = 3
+    buf, cap, isz = rle_encode_rows(img)
+    rle_validate_packed(buf, img.shape, isz, cap)  # honest buffer passes
+    with pytest.raises(ValueError, match="does not match"):
+        rle_validate_packed(buf[:, :-4], img.shape, isz, cap)
+    bad = buf.copy()
+    bad[:, cap * isz:] = 0  # wipe the run planes: rows under-declare
+    with pytest.raises(ValueError, match="declared"):
+        rle_validate_packed(bad, img.shape, isz, cap)
+    with pytest.raises(ValueError, match="out of bounds"):
+        rle_validate_packed(buf, img.shape, isz, 0)
+
+
+def test_decode_packed_pal_batch_expands_rle_groups():
+    """The shared decode entry point expands deferred run buffers
+    FIRST, so a run-packed raw frame (empty pal_groups) and a
+    run-packed palette plane both restore inside one jit."""
+    import jax
+
+    from blendjax.ops.tiles import (
+        NDR_SUFFIX,
+        decode_packed_pal_batch,
+        pack_fields,
+        rle_encode_rows,
+    )
+
+    img = np.zeros((4, 32, 32, 4), np.uint8)
+    img[:, 10:20, 10:20] = 6
+    xy = np.arange(4 * 8 * 2, dtype=np.float32).reshape(4, 8, 2)
+    buf, cap, isz = rle_encode_rows(img)
+    packed, spec = pack_fields({"image" + NDR_SUFFIX: buf, "xy": xy})
+    rle_groups = (("image", (img.shape, isz, cap)),)
+    out = jax.jit(
+        decode_packed_pal_batch,
+        static_argnames=("spec", "pal_groups", "rle_groups"),
+    )(packed, spec=spec, pal_groups=(), rle_groups=rle_groups)
+    np.testing.assert_array_equal(np.asarray(out["image"]), img)
+    np.testing.assert_array_equal(np.asarray(out["xy"]), xy)

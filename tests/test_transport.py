@@ -347,3 +347,271 @@ def test_sizeof_frames_counts_all_frame_types():
         array.array("B", [1, 2, 3]),  # other buffer: the bytes() fallback
     ]
     assert sizeof_frames(frames) == 4 + 6 + 12 + 12 + 12 + 3
+
+
+# -- run-length wire frames ("ndr") ------------------------------------------
+
+
+def _runny(shape, value=7, box=((10, 30), (10, 30))):
+    """Background-dominated uint8 frames — the ndr-winning content."""
+    img = np.zeros(shape, np.uint8)
+    (y0, y1), (x0, x1) = box
+    img[..., y0:y1, x0:x1, :] = value
+    return img
+
+
+def test_ndr_roundtrip_and_three_kind_interleave():
+    """'ndr' interleaves with 'ndz' and 'nd' inside ONE message: the
+    run-heavy frame ships run-packed, the compressible-but-not-runny
+    field ships zlib, incompressible noise stays raw — and everything
+    decodes bit-exact."""
+    from blendjax.transport.wire import sizeof_frames
+
+    img = _runny((8, 64, 64, 4))
+    ramp = np.tile(np.arange(64, dtype=np.uint8), 2048).reshape(512, 256)
+    rng = np.random.default_rng(3)
+    noise = rng.integers(0, 256, (256, 256), dtype=np.uint8)
+    msg = {"image": img, "ramp": ramp, "noise": noise, "frameid": 9}
+    frames = encode_message(
+        msg, compress_rle=True, compress_level=6, compress_min_bytes=1024
+    )
+    # compressed total ~= the raw noise frame plus small packed frames
+    assert sizeof_frames(frames) < noise.nbytes + (
+        img.nbytes + ramp.nbytes
+    ) // 16
+    # the noise frame crossed raw
+    assert any(bytes(f) == noise.tobytes() for f in frames[1:])
+    out = decode_message(frames, allow_pickle=False)  # pickle-free path
+    np.testing.assert_array_equal(out["image"], img)
+    np.testing.assert_array_equal(out["ramp"], ramp)
+    np.testing.assert_array_equal(out["noise"], noise)
+    assert out["frameid"] == 9
+
+
+def test_ndr_rejects_zero_byte_truncated_and_padded_frames():
+    """The ndz hostile-stream guards carried over: declared-zero-byte
+    refusal, a wire buffer that doesn't match the declared capacity,
+    and run planes that under-declare the row item count all fail
+    loudly — allocation stays bounded by the declared shape."""
+    import msgpack
+
+    from blendjax.constants import WIRE_MAGIC
+
+    def hdr(entry):
+        return WIRE_MAGIC + msgpack.packb([1, [entry]], use_bin_type=True)
+
+    # zero-byte declaration
+    with pytest.raises(ValueError, match="zero bytes"):
+        decode_message(
+            [hdr(["ndr", "x", [0, 4], "|u1", 0, 4, 1]), b""]
+        )
+    # truncated buffer (wrong size for rows x stride)
+    good = encode_message(
+        {"x": _runny((4, 64, 64, 4))}, compress_rle=True,
+        compress_min_bytes=1024,
+    )
+    with pytest.raises(ValueError, match="truncated or padded"):
+        decode_message([good[0], bytes(good[1])[:-8]])
+    # run planes under-declaring the row: runs sum != items
+    frames = encode_message(
+        {"x": _runny((4, 64, 64, 4))}, compress_rle=True,
+        compress_min_bytes=1024,
+    )
+    buf = np.frombuffer(bytes(frames[1]), np.uint8).copy()
+    buf[-1] = 0
+    buf[-2] = 0  # zero a run's hi/lo bytes
+    stride = buf.size // 4
+    lo = stride - 2 * (stride // 6)  # cap*(isz+2): isz=4 -> lo plane at 2/3
+    buf2 = buf.reshape(4, stride).copy()
+    buf2[:, lo:] = 0  # wipe every run plane entirely
+    with pytest.raises(ValueError, match="declared"):
+        decode_message([frames[0], buf2.tobytes()])
+    # non-uint8 declaration refused outright
+    with pytest.raises(ValueError, match="uint8-only"):
+        decode_message(
+            [hdr(["ndr", "x", [2, 8], "<f4", 0, 4, 1]), b"\x00" * 24]
+        )
+
+
+def test_ndr_incompressible_and_small_frames_stay_raw():
+    rng = np.random.default_rng(5)
+    noise = rng.integers(0, 256, (64, 1024), dtype=np.uint8)
+    frames = encode_message(
+        {"noise": noise}, compress_rle=True, compress_min_bytes=1024
+    )
+    assert bytes(frames[1]) == noise.tobytes()
+    tiny = np.zeros((64,), np.uint8)
+    frames = encode_message(
+        {"tiny": tiny}, compress_rle=True, compress_min_bytes=1024
+    )
+    assert bytes(frames[1]) == tiny.tobytes()
+
+
+def test_ndr_pinned_cap_overflow_falls_back_and_sticky_cap_ratchets():
+    from blendjax.transport.wire import WireCompressState
+
+    rng = np.random.default_rng(0)
+    busy = rng.integers(0, 4, (4, 4096), dtype=np.uint8)  # many short runs
+    # pinned cap too small: the frame ships raw for THIS message
+    frames = encode_message(
+        {"x": busy}, compress_rle=True, rle_cap=8, compress_min_bytes=1024
+    )
+    assert bytes(frames[1]) == busy.tobytes()
+    # sticky state: a quiet frame sets a small cap, a busier one
+    # ratchets it up instead of failing
+    state = WireCompressState()
+    quiet = _runny((4, 64, 64, 4), box=((8, 12), (8, 12)))
+    encode_message(
+        {"x": quiet}, compress_rle=True, compress_min_bytes=1024,
+        state=state,
+    )
+    cap_quiet = state.rle_cap("x")
+    busier = _runny((4, 64, 64, 4), box=((4, 60), (4, 60)), value=1)
+    busier[:, ::2, ::2, :] = 2  # checkerboard inside the box
+    frames = encode_message(
+        {"x": busier}, compress_rle=True, compress_min_bytes=1024,
+        state=state,
+    )
+    out = decode_message(frames)
+    np.testing.assert_array_equal(out["x"], busier)
+    assert state.rle_cap("x") >= cap_quiet
+
+
+def test_ndr_defers_only_for_prebatched_messages():
+    from blendjax.ops.tiles import rle_expand_packed_np
+
+    img = _runny((8, 64, 64, 4))
+    stamped = encode_message(
+        {"_prebatched": True, "image": img}, compress_rle=True,
+        compress_min_bytes=1024,
+    )
+    out = decode_message(stamped, defer_rle=True)
+    assert "image" not in out
+    shape, isz, cap = out["image__ndrspec"]
+    np.testing.assert_array_equal(
+        rle_expand_packed_np(out["image__ndr"], shape, isz, cap), img
+    )
+    plain = encode_message(
+        {"image": img}, compress_rle=True, compress_min_bytes=1024
+    )
+    out = decode_message(plain, defer_rle=True)
+    np.testing.assert_array_equal(out["image"], img)
+    assert "image__ndr" not in out
+
+
+def test_ndr_over_socket_with_rle_publisher():
+    pub = DataPublisherSocket(
+        WILD, btid=0, compress_rle=True, compress_min_bytes=1024
+    )
+    recv = DataReceiverSocket([pub.addr], timeoutms=5000)
+    img = _runny((4, 64, 64, 4))
+    pub.publish(image=img, frameid=5)
+    msg, raw = recv.recv(copy_arrays=True)
+    np.testing.assert_array_equal(msg["image"], img)
+    assert msg["frameid"] == 5
+    from blendjax.transport import sizeof_frames
+
+    assert sizeof_frames(raw) < img.nbytes // 4
+    recv.close(); pub.close()
+
+
+def test_ndr_replay_round_trip(tmp_path):
+    """Recorded raw wire frames with 'ndr' entries replay bit-exact
+    through ReplayStream (which always host-inflates)."""
+    from blendjax.data.replay import FileRecorder, ReplayStream
+
+    img = _runny((4, 64, 64, 4))
+    path = str(tmp_path / "wire.bjr")
+    with FileRecorder(path) as rec:
+        for i in range(3):
+            rec.save(encode_message(
+                {"_prebatched": True, "image": img + i, "frameid": i},
+                compress_rle=True, compress_min_bytes=1024,
+            ))
+    got = list(ReplayStream(path))
+    assert len(got) == 3
+    for i, msg in enumerate(got):
+        np.testing.assert_array_equal(msg["image"], img + i)
+        assert msg["frameid"] == i
+
+
+def test_quantize_f16_exact_for_pixel_coords_and_bounded_otherwise():
+    """Wire f16 quantization of float sidecars: integer pixel
+    coordinates (the point-label payload) survive EXACTLY up to 2048;
+    arbitrary floats stay within f16's relative error bound."""
+    coords = np.arange(0, 2048, dtype=np.float32).reshape(-1, 2)
+    frames = encode_message({"xy": coords}, quantize_f16=("xy",))
+    out = decode_message(frames)
+    assert out["xy"].dtype == np.float16
+    np.testing.assert_array_equal(out["xy"].astype(np.float32), coords)
+    rng = np.random.default_rng(1)
+    vals = (rng.random(1024, dtype=np.float32) * 100.0).reshape(-1, 2)
+    out = decode_message(
+        encode_message({"xy": vals}, quantize_f16=("xy",))
+    )
+    rel = np.abs(out["xy"].astype(np.float32) - vals) / np.abs(vals)
+    assert float(np.nanmax(rel)) <= 2 ** -10  # half-precision ulp bound
+    # non-float and unnamed fields are untouched
+    ids = np.arange(8, dtype=np.int64)
+    out = decode_message(
+        encode_message({"xy": vals, "ids": ids}, quantize_f16=("ids",))
+    )
+    assert out["xy"].dtype == np.float32
+    assert out["ids"].dtype == np.int64
+
+
+def test_compress_state_skip_memo_and_recovery():
+    """Satellite: a field that LOSES the size check stops paying the
+    trial compression for SKIP_FRAMES encodes, then re-tries — so an
+    incompressible stream stops burning CPU while one that turns
+    compressible recovers."""
+    from blendjax.transport.wire import WireCompressState
+
+    state = WireCompressState()
+    rng = np.random.default_rng(2)
+    noise = rng.integers(0, 256, (64, 1024), dtype=np.uint8)
+    encode_message(
+        {"x": noise}, compress_level=6, compress_min_bytes=1024,
+        state=state,
+    )
+    assert state._skip[("z", "x")] == state.SKIP_FRAMES
+    before = state._skip[("z", "x")]
+    encode_message(
+        {"x": noise}, compress_level=6, compress_min_bytes=1024,
+        state=state,
+    )
+    assert state._skip[("z", "x")] == before - 1  # trial skipped
+    # drain the window with compressible content: the first re-trial
+    # WINS and clears the memo
+    ramp = np.tile(np.arange(64, dtype=np.uint8), 1024)
+    for _ in range(state.SKIP_FRAMES):
+        encode_message(
+            {"x": ramp}, compress_level=6, compress_min_bytes=1024,
+            state=state,
+        )
+    frames = encode_message(
+        {"x": ramp}, compress_level=6, compress_min_bytes=1024,
+        state=state,
+    )
+    assert ("z", "x") not in state._skip
+    out = decode_message(frames)
+    np.testing.assert_array_equal(out["x"], ramp)
+
+
+def test_parallel_inflate_pool_decodes_multi_ndz_messages():
+    from concurrent.futures import ThreadPoolExecutor
+
+    a = np.tile(np.arange(64, dtype=np.uint8), 8192)
+    b = np.tile(np.arange(32, dtype=np.uint8), 16384).reshape(64, -1)
+    frames = encode_message(
+        {"a": a, "b": b}, compress_level=6, compress_min_bytes=1024
+    )
+    with ThreadPoolExecutor(2) as pool:
+        out = decode_message(frames, inflate_pool=pool)
+    np.testing.assert_array_equal(out["a"], a)
+    np.testing.assert_array_equal(out["b"], b)
+    # hostile content still refused through the pool path
+    bad = [frames[0], bytes(frames[1])[:-4], frames[2]]
+    with ThreadPoolExecutor(2) as pool:
+        with pytest.raises(ValueError, match="declared"):
+            decode_message(bad, inflate_pool=pool)
