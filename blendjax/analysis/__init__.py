@@ -21,10 +21,20 @@ Rules (see ``docs/static-analysis.md``):
 - ``BJX105`` socket-leak: socket/context creation with no ``close``/
   ``term`` on some path.
 
+Per-file rules run up through ``BJX116``; the default run ALSO builds
+one whole-program :class:`~blendjax.analysis.project.ProjectContext`
+(shared AST cache, thread-spawn graph, locksets) for the concurrency
+rules — ``BJX117`` unlocked-shared-mutation (the Eraser lockset
+intersection), ``BJX118`` lock-order-inversion, and ``BJX119``
+blocking-call-under-lock. ``--no-project`` skips that pass (the
+producer-side quick path). The runtime complement is
+:mod:`blendjax.testing.threadguard` (``BLENDJAX_THREADGUARD=1``).
+
 Suppress one finding with an inline ``# bjx: ignore[BJX101]`` (or a
 bare ``# bjx: ignore`` for all rules); grandfather existing findings
 with the committed ``.bjx-baseline.json`` (regenerate via
-``--write-baseline``).
+``--write-baseline`` — project findings fingerprint by identity, not
+line content).
 """
 
 from __future__ import annotations
@@ -32,11 +42,15 @@ from __future__ import annotations
 from blendjax.analysis.core import (
     Finding,
     ModuleContext,
+    ProjectRule,
     Rule,
     all_rules,
+    analyze_modules,
     analyze_paths,
+    analyze_project_modules,
     analyze_source,
     load_baseline,
+    parse_paths,
     register,
     write_baseline,
 )
@@ -44,11 +58,15 @@ from blendjax.analysis.core import (
 __all__ = [
     "Finding",
     "ModuleContext",
+    "ProjectRule",
     "Rule",
     "all_rules",
+    "analyze_modules",
     "analyze_paths",
+    "analyze_project_modules",
     "analyze_source",
     "load_baseline",
+    "parse_paths",
     "register",
     "write_baseline",
 ]

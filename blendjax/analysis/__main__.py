@@ -1,8 +1,12 @@
 """CLI: ``python -m blendjax.analysis [paths...]``.
 
 Exit status: 0 when every finding is inline-suppressed or baselined,
-1 when unsuppressed findings remain, 2 on usage errors. Runs with no
-third-party imports so it works offline and inside Blender's Python.
+1 when unsuppressed findings remain, 2 on usage errors, 3 when
+``--project`` (the default) is requested but a module failed to parse
+(the whole-program pass needs every module — fix the syntax error or
+rerun with ``--no-project``), 4 when ``--max-seconds`` is set and the
+run overshot it (the CI wall-time budget). Runs with no third-party
+imports so it works offline and inside Blender's Python.
 """
 
 from __future__ import annotations
@@ -11,13 +15,16 @@ import argparse
 import json
 import os
 import sys
+import time
 
 from blendjax.analysis.core import (
     BASELINE_DEFAULT,
     all_rules,
-    analyze_paths,
+    analyze_modules,
+    analyze_project_modules,
     apply_baseline,
     load_baseline,
+    parse_paths,
     write_baseline,
 )
 
@@ -36,6 +43,12 @@ def build_parser() -> argparse.ArgumentParser:
         help="comma-separated rule ids to run (default: all)",
     )
     parser.add_argument(
+        "--project", action=argparse.BooleanOptionalAction, default=True,
+        help="run the whole-program pass (BJX117+) over one shared "
+        "parse (default on; --no-project is the producer-side quick "
+        "path — per-file rules only)",
+    )
+    parser.add_argument(
         "--baseline", default=BASELINE_DEFAULT,
         help=f"baseline file (default: {BASELINE_DEFAULT})",
     )
@@ -46,6 +59,11 @@ def build_parser() -> argparse.ArgumentParser:
     parser.add_argument(
         "--write-baseline", action="store_true",
         help="grandfather all current findings into the baseline file",
+    )
+    parser.add_argument(
+        "--max-seconds", type=float, default=None,
+        help="fail (exit 4) if the analysis takes longer than this "
+        "wall-time budget (the CI lint-latency gate)",
     )
     parser.add_argument(
         "--format", choices=("text", "json"), default="text",
@@ -61,7 +79,8 @@ def main(argv: list[str] | None = None) -> int:
     rules = all_rules()
     if args.list_rules:
         for rule_id, rule in sorted(rules.items()):
-            print(f"{rule_id} {rule.name}: {rule.description}")
+            scope = "project" if rule.project else "file"
+            print(f"{rule_id} {rule.name} [{scope}]: {rule.description}")
         return 0
     select = None
     if args.select:
@@ -75,8 +94,27 @@ def main(argv: list[str] | None = None) -> int:
         print(f"no such path: {missing}", file=sys.stderr)
         return 2
 
+    t0 = time.perf_counter()
     root = os.getcwd()
-    findings = analyze_paths(args.paths, select=select, root=root)
+    modules, errors = parse_paths(args.paths, root=root)
+    findings = errors + analyze_modules(modules, select=select)
+    if args.project:
+        if errors:
+            # Never silently fall back to per-file-only results: a
+            # parse failure means the spawn graph (and every BJX117+
+            # verdict) would be built from a partial project.
+            for f in errors:
+                print(f.render(), file=sys.stderr)
+            print(
+                f"--project needs every module parsed; {len(errors)} "
+                "file(s) failed (see above) — fix the syntax error or "
+                "rerun with --no-project for per-file results only.",
+                file=sys.stderr,
+            )
+            return 3
+        findings.extend(analyze_project_modules(modules, select=select))
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+
     if args.write_baseline:
         n = write_baseline(args.baseline, findings, root)
         print(f"wrote {n} finding(s) to {args.baseline}")
@@ -97,6 +135,14 @@ def main(argv: list[str] | None = None) -> int:
                 "'# bjx: ignore[RULE]' or grandfather all with "
                 "--write-baseline (see docs/static-analysis.md)."
             )
+    elapsed = time.perf_counter() - t0
+    if args.max_seconds is not None and elapsed > args.max_seconds:
+        print(
+            f"bjx-lint took {elapsed:.2f}s, over the --max-seconds "
+            f"budget of {args.max_seconds:.2f}s",
+            file=sys.stderr,
+        )
+        return 4
     return 1 if findings else 0
 
 

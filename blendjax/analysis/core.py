@@ -19,7 +19,10 @@ import json
 import os
 import re
 from collections import defaultdict
-from typing import Callable, Iterable, Iterator
+from typing import TYPE_CHECKING, Callable, Iterable, Iterator
+
+if TYPE_CHECKING:  # runtime import would be circular (project -> core)
+    from blendjax.analysis.project import ProjectContext
 
 BASELINE_DEFAULT = ".bjx-baseline.json"
 
@@ -32,13 +35,21 @@ _SUPPRESS_RE = re.compile(
 
 @dataclasses.dataclass(frozen=True)
 class Finding:
-    """One diagnostic: ``path:line:col RULE message``."""
+    """One diagnostic: ``path:line:col RULE message``.
+
+    ``identity`` is the project-level fingerprint key: whole-program
+    rules (BJX117+) identify a finding by what it is ABOUT (an
+    attribute, a lock pair) rather than by the source line it happens
+    to anchor to, so a baselined project finding survives edits that
+    move or reword the anchor line. ``None`` = per-file fingerprinting
+    (rule, path, message, line text, occurrence)."""
 
     rule: str
     path: str
     line: int
     col: int
     message: str
+    identity: str | None = None
 
     def render(self) -> str:
         return f"{self.path}:{self.line}:{self.col + 1}: {self.rule} {self.message}"
@@ -51,18 +62,45 @@ class Rule:
     id: str = ""
     name: str = ""
     description: str = ""
+    #: True for whole-program rules (run once over a ProjectContext,
+    #: not per module) — see :class:`ProjectRule`.
+    project: bool = False
 
     def check(self, module: "ModuleContext") -> Iterable[Finding]:
         raise NotImplementedError
 
-    def finding(self, module: "ModuleContext", node: ast.AST, message: str) -> Finding:
+    def finding(
+        self,
+        module: "ModuleContext",
+        node: ast.AST,
+        message: str,
+        identity: str | None = None,
+    ) -> Finding:
         return Finding(
             rule=self.id,
             path=module.relpath,
             line=getattr(node, "lineno", 1),
             col=getattr(node, "col_offset", 0),
             message=message,
+            identity=identity,
         )
+
+
+class ProjectRule(Rule):
+    """Whole-program rule: runs once over a :class:`~blendjax.analysis.
+    project.ProjectContext` built from EVERY module in the run (shared
+    AST cache — the same parsed ``ModuleContext`` objects the per-file
+    rules used). Subclasses implement ``check_project``; ``check`` is
+    deliberately unused (a project rule has no meaningful per-module
+    answer)."""
+
+    project = True
+
+    def check(self, module: "ModuleContext") -> Iterable[Finding]:
+        return ()
+
+    def check_project(self, project: "ProjectContext") -> Iterable[Finding]:
+        raise NotImplementedError
 
 
 _REGISTRY: dict[str, Rule] = {}
@@ -120,17 +158,44 @@ class ModuleContext:
         self.lines = source.splitlines()
         self.tree = ast.parse(source, filename=relpath)
         self.parents: dict[ast.AST, ast.AST] = {}
+        # One walk builds BOTH the parent table and the by-type node
+        # index every rule shares (``nodes()``) — rules and the project
+        # pass stop re-walking the tree per rule.
+        self._by_type: dict[type, list[ast.AST]] = defaultdict(list)
         for parent in ast.walk(self.tree):
             for child in ast.iter_child_nodes(parent):
                 self.parents[child] = parent
+                self._by_type[type(child)].append(child)
         self.imports = self._import_table()
         self.suppressions = self._suppression_table()
+        self._functions: (
+            list[tuple[str, FunctionNode, ast.ClassDef | None]] | None
+        ) = None
+
+    @property
+    def modname(self) -> str:
+        """Dotted module name derived from the relpath
+        (``blendjax/fleet/controller.py`` -> ``blendjax.fleet.
+        controller``; package ``__init__`` collapses to the package)."""
+        name = self.relpath[:-3] if self.relpath.endswith(".py") else self.relpath
+        parts = [p for p in name.split("/") if p]
+        if parts and parts[-1] == "__init__":
+            parts = parts[:-1]
+        return ".".join(parts)
+
+    def nodes(self, *types: type) -> list[ast.AST]:
+        """All nodes of the given AST types, from the shared one-walk
+        index (use instead of a per-rule ``ast.walk(module.tree)``)."""
+        out: list[ast.AST] = []
+        for t in types:
+            out.extend(self._by_type.get(t, ()))
+        return out
 
     # -- imports ------------------------------------------------------------
 
     def _import_table(self) -> dict[str, str]:
         table: dict[str, str] = {}
-        for node in ast.walk(self.tree):
+        for node in self.nodes(ast.Import, ast.ImportFrom):
             if isinstance(node, ast.Import):
                 for alias in node.names:
                     table[alias.asname or alias.name.split(".")[0]] = (
@@ -197,7 +262,11 @@ class ModuleContext:
         self,
     ) -> Iterator[tuple[str, FunctionNode, ast.ClassDef | None]]:
         """Yield ``(qualname, def-node, enclosing class or None)`` for every
-        function/method (nested functions get dotted qualnames)."""
+        function/method (nested functions get dotted qualnames). The
+        table is computed once per module and shared by every rule."""
+        if self._functions is not None:
+            yield from self._functions
+            return
 
         def walk(
             node: ast.AST, prefix: str, cls: ast.ClassDef | None
@@ -212,10 +281,72 @@ class ModuleContext:
                 else:
                     yield from walk(child, prefix, cls)
 
-        yield from walk(self.tree, "", None)
+        self._functions = list(walk(self.tree, "", None))
+        yield from self._functions
 
 
 # -- running ----------------------------------------------------------------
+
+
+def _syntax_finding(e: SyntaxError, relpath: str) -> Finding:
+    return Finding(
+        rule="BJX000",
+        path=relpath.replace(os.sep, "/"),
+        line=e.lineno or 1,
+        col=(e.offset or 1) - 1,
+        message=f"syntax error: {e.msg}",
+    )
+
+
+def analyze_modules(
+    modules: Iterable["ModuleContext"],
+    select: set[str] | None = None,
+) -> list[Finding]:
+    """Per-file findings over already-parsed modules (the shared AST
+    cache: one ``ModuleContext`` per file serves every rule AND the
+    project pass)."""
+    rules = [
+        rule
+        for rule_id, rule in sorted(all_rules().items())
+        if not rule.project and (not select or rule_id in select)
+    ]
+    findings: list[Finding] = []
+    for module in modules:
+        for rule in rules:
+            for f in rule.check(module):
+                if not module.suppressed(f):
+                    findings.append(f)
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return findings
+
+
+def analyze_project_modules(
+    modules: list["ModuleContext"],
+    select: set[str] | None = None,
+) -> list[Finding]:
+    """Whole-program findings over already-parsed modules: build ONE
+    ProjectContext (spawn graph, locksets) and run every registered
+    :class:`ProjectRule` over it. Inline suppressions apply at the
+    finding's anchor line, same as per-file rules."""
+    rules = [
+        rule
+        for rule_id, rule in sorted(all_rules().items())
+        if rule.project and (not select or rule_id in select)
+    ]
+    if not rules:
+        return []
+    from blendjax.analysis.project import ProjectContext
+
+    project = ProjectContext(modules)
+    by_path = {m.relpath: m for m in modules}
+    findings: list[Finding] = []
+    for rule in rules:
+        for f in rule.check_project(project):
+            module = by_path.get(f.path)
+            if module is None or not module.suppressed(f):
+                findings.append(f)
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return findings
 
 
 def analyze_source(
@@ -223,28 +354,12 @@ def analyze_source(
     relpath: str,
     select: set[str] | None = None,
 ) -> list[Finding]:
-    """All non-inline-suppressed findings for one module's source."""
+    """All non-inline-suppressed per-file findings for one module."""
     try:
         module = ModuleContext(source, relpath)
     except SyntaxError as e:
-        return [
-            Finding(
-                rule="BJX000",
-                path=relpath.replace(os.sep, "/"),
-                line=e.lineno or 1,
-                col=(e.offset or 1) - 1,
-                message=f"syntax error: {e.msg}",
-            )
-        ]
-    findings: list[Finding] = []
-    for rule_id, rule in sorted(all_rules().items()):
-        if select and rule_id not in select:
-            continue
-        for f in rule.check(module):
-            if not module.suppressed(f):
-                findings.append(f)
-    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
-    return findings
+        return [_syntax_finding(e, relpath)]
+    return analyze_modules([module], select=select)
 
 
 def iter_py_files(paths: Iterable[str]) -> Iterator[str]:
@@ -262,15 +377,16 @@ def iter_py_files(paths: Iterable[str]) -> Iterator[str]:
             yield path
 
 
-def analyze_paths(
+def parse_paths(
     paths: Iterable[str],
-    select: set[str] | None = None,
     root: str | None = None,
-) -> list[Finding]:
-    """Findings over files/directories, paths reported relative to ``root``
-    (default: cwd) so baselines are machine-independent."""
+) -> tuple[list["ModuleContext"], list[Finding]]:
+    """Parse every file ONCE into the shared AST cache: returns
+    ``(modules, syntax_error_findings)``. Both the per-file rules and
+    the project pass consume the same ``ModuleContext`` objects."""
     root = os.path.abspath(root or os.getcwd())
-    findings: list[Finding] = []
+    modules: list[ModuleContext] = []
+    errors: list[Finding] = []
     seen: set[str] = set()
     for path in iter_py_files(paths):
         abspath = os.path.abspath(path)
@@ -280,7 +396,28 @@ def analyze_paths(
         rel = os.path.relpath(abspath, root)
         with open(path, "r", encoding="utf-8") as f:
             source = f.read()
-        findings.extend(analyze_source(source, rel, select=select))
+        try:
+            modules.append(ModuleContext(source, rel))
+        except SyntaxError as e:
+            errors.append(_syntax_finding(e, rel))
+    return modules, errors
+
+
+def analyze_paths(
+    paths: Iterable[str],
+    select: set[str] | None = None,
+    root: str | None = None,
+    project: bool = False,
+) -> list[Finding]:
+    """Findings over files/directories, paths reported relative to ``root``
+    (default: cwd) so baselines are machine-independent. With
+    ``project=True`` the whole-program pass (BJX117+) runs over the
+    same parse."""
+    modules, errors = parse_paths(paths, root=root)
+    findings = errors + analyze_modules(modules, select=select)
+    if project:
+        findings.extend(analyze_project_modules(modules, select=select))
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
     return findings
 
 
@@ -291,15 +428,27 @@ def _fingerprints(
     findings: Iterable[Finding],
     line_text: Callable[[Finding], str],
 ) -> list[tuple[Finding, str]]:
-    """Stable per-finding fingerprints: hash of (rule, path, message,
-    normalized line text, occurrence index) — immune to pure
-    line-number shifts. The message embeds the enclosing function's
-    qualname for most rules, so an identical violation added in a
-    DIFFERENT function cannot alias a grandfathered fingerprint."""
-    by_key: dict[tuple[str, str, str, str], int] = defaultdict(int)
+    """Stable per-finding fingerprints.
+
+    Per-file findings hash (rule, path, message, normalized line text,
+    occurrence index) — immune to pure line-number shifts; the message
+    embeds the enclosing function's qualname for most rules, so an
+    identical violation added in a DIFFERENT function cannot alias a
+    grandfathered fingerprint.
+
+    Project findings (``identity`` set) hash (rule, identity) instead:
+    a whole-program finding is ABOUT an attribute or a lock pair, whose
+    anchor line and message wording legitimately move as code is
+    edited — the identity string (e.g. ``pkg.mod.Class.attr``) is the
+    stable name of the defect."""
+    by_key: dict[tuple[str, ...], int] = defaultdict(int)
     out: list[tuple[Finding, str]] = []
     for f in sorted(findings, key=lambda f: (f.path, f.line, f.col, f.rule)):
-        key = (f.rule, f.path, f.message, line_text(f))
+        key: tuple[str, ...]
+        if f.identity is not None:
+            key = (f.rule, f.identity)
+        else:
+            key = (f.rule, f.path, f.message, line_text(f))
         k = by_key[key]
         by_key[key] += 1
         digest = hashlib.sha1(
@@ -328,30 +477,37 @@ def _default_line_text(root: str) -> Callable[[Finding], str]:
 
 
 def load_baseline(path: str) -> set[str]:
-    """Fingerprints grandfathered by a committed baseline file."""
+    """Fingerprints grandfathered by a committed baseline file.
+
+    Versions 1 (per-file entries only) and 2 (entries may carry a
+    project ``identity``) are both accepted: per-file fingerprints are
+    computed identically under both, so a v1 baseline stays valid
+    unchanged — the version bump only ADDS the identity scheme."""
     if not os.path.exists(path):
         return set()
     with open(path, "r", encoding="utf-8") as f:
         data = json.load(f)
-    if data.get("version") != 1:
+    if data.get("version") not in (1, 2):
         raise ValueError(f"{path}: unsupported baseline version")
     return {e["fingerprint"] for e in data.get("entries", [])}
 
 
 def write_baseline(path: str, findings: Iterable[Finding], root: str) -> int:
     """Write all current findings as the new baseline; returns count."""
-    entries = [
-        {
+    entries = []
+    for f, fp in _fingerprints(findings, _default_line_text(root)):
+        entry = {
             "fingerprint": fp,
             "rule": f.rule,
             "path": f.path,
             "line": f.line,
             "message": f.message,
         }
-        for f, fp in _fingerprints(findings, _default_line_text(root))
-    ]
+        if f.identity is not None:
+            entry["identity"] = f.identity
+        entries.append(entry)
     with open(path, "w", encoding="utf-8") as fh:
-        json.dump({"version": 1, "entries": entries}, fh, indent=2, sort_keys=True)
+        json.dump({"version": 2, "entries": entries}, fh, indent=2, sort_keys=True)
         fh.write("\n")
     return len(entries)
 

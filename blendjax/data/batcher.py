@@ -211,7 +211,13 @@ class BatchAssembler:
         i = self._cursor
         for k in self.schema.fields:
             buf[k][i] = item[k]
+        # Thread-confined: an assembler is owned and driven solely by
+        # the one ingest thread iterating its stream (the sharded pool
+        # builds per-slot PendingBatch state with its own lock instead
+        # of sharing an assembler).
+        # bjx: ignore[BJX117] — thread-confined, single ingest thread
         self._meta.append({k: item[k] for k in self.schema.meta_keys if k in item})
+        # bjx: ignore[BJX117] — thread-confined, single ingest thread
         self._cursor += 1
         if self._cursor < self.batch_size:
             return None
@@ -219,6 +225,7 @@ class BatchAssembler:
         batch["_meta"] = self._meta
         self._meta = []
         self._cursor = 0
+        # bjx: ignore[BJX117] — thread-confined, single ingest thread
         self._active = (self._active + 1) % len(self._pool)
         return batch
 
@@ -402,6 +409,10 @@ class HostIngest:
                 if tail is not None:
                     self._emit(tail)
         except BaseException as e:  # propagate into the consumer thread
+            # Publication sequenced by the _DONE sentinel: written
+            # before the undroppable put below, read by the consumer
+            # only after get() returns _DONE.
+            # bjx: ignore[BJX117] — sequenced by the _DONE sentinel
             self._error = e
         finally:
             # Undroppable sentinel: a fixed timeout could expire while

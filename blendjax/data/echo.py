@@ -64,6 +64,7 @@ from blendjax.scenario.accounting import (
 )
 from blendjax.utils.logging import get_logger
 from blendjax.utils.metrics import metrics
+from blendjax.utils.tg import guard
 
 logger = get_logger("data")
 
@@ -481,8 +482,17 @@ class EchoingPipeline:
                 )
         self.mesh = mesh
         self.emit_draws = bool(emit_draws)
-        self.reservoir = SampleReservoir(
-            self.capacity, augment=augment, rng=rng, sharding=sharding
+        # first-use affinity: the reservoir (ring + draw counter) is
+        # single-thread by contract — born on whichever thread first
+        # draws/inserts (the iterating thread; the drain thread only
+        # feeds the queue) and snapshot via state_dict on that SAME
+        # thread (the PR 11 snapshot-vs-draw race class). threadguard
+        # enforces this at runtime when BLENDJAX_THREADGUARD=1.
+        self.reservoir = guard(
+            SampleReservoir(
+                self.capacity, augment=augment, rng=rng, sharding=sharding
+            ),
+            name="echo.reservoir", affinity="first-use",
         )
         self.warm_start = warm_start
         self.warm_start_allow_pickle = bool(warm_start_allow_pickle)
@@ -507,6 +517,10 @@ class EchoingPipeline:
         self._queue: queue.Queue = queue.Queue(maxsize=2)
         self._thread: threading.Thread | None = None
         self._stop = threading.Event()
+        # _err_lock orders the drain thread's error publish against the
+        # draw loop's per-iteration check (BJX117): unlike _DONE, an
+        # error must surface PROMPTLY, so it can't ride the queue.
+        self._err_lock = threading.Lock()
         self._inner_error: BaseException | None = None
         self._inner_done = False
         self._warned_sidecars = False
@@ -533,7 +547,8 @@ class EchoingPipeline:
                 if self._stop.is_set():
                     return
         except BaseException as e:  # propagate into the draw loop
-            self._inner_error = e
+            with self._err_lock:
+                self._inner_error = e
         finally:
             while not self._stop.is_set():
                 try:
@@ -722,13 +737,15 @@ class EchoingPipeline:
                 # here would spin on Empty polls forever.
                 return
             self._poll_fresh(block=False)
-            if self._inner_error is not None:
+            with self._err_lock:
+                err = self._inner_error
+            if err is not None:
                 # A crashed stream is NOT a clean end of stream: raise
                 # promptly instead of riding the EOS drain path — which
                 # would emit up to capacity * max_echo_factor purely-
                 # echoed samples (with the fresh floor silently
                 # relaxed) from a dead pipeline before surfacing it.
-                raise self._inner_error
+                raise err
             idx = self._compose_draw()
             if idx is None:
                 if self._inner_done and self._queue.empty():

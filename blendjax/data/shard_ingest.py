@@ -305,6 +305,10 @@ class ShardedHostIngest:
         while not self._consumer_stop:
             try:
                 self._queue.put(batch, timeout=0.25)
+                # Per-slot single-writer: each worker writes only
+                # its own idx; aggregate reads (batches_out) are
+                # monotonic observability sums.
+                # bjx: ignore[BJX117] — per-slot single-writer
                 self._shard_batches[idx] += 1
                 metrics.count("ingest.batches")
                 break
@@ -358,6 +362,7 @@ class ShardedHostIngest:
                     "(match the producer's --batch to avoid jit "
                     "recompiles)", lead, self.batch_size,
                 )
+            # bjx: ignore[BJX117] — per-slot single-writer (own idx)
             self._shard_items[idx] += lead
             metrics.count("ingest.items", lead)
             if tr is not None:
@@ -433,6 +438,10 @@ class ShardedHostIngest:
                     return
             if not self._take_budget():
                 return
+            # Advisory racy read: worst case one extra item is
+            # consumed; the authoritative error read in __iter__ is
+            # sequenced by the _DONE sentinel.
+            # bjx: ignore[BJX117] — advisory read; _DONE sequences it
             if self._consumer_stop or self._error is not None:
                 # consumer stop / peer error: drop the in-hand item and
                 # wind down. (NOT a bare _stop check: the worker that
@@ -462,18 +471,20 @@ class ShardedHostIngest:
                 self._active -= 1
                 last = self._active == 0
             if last:
-                # Local bind: stop() may swap the attribute to None
-                # concurrently (its join loop can time out while this
-                # teardown runs) — a check-then-attribute-reload here
-                # would AttributeError out of the finally and lose the
-                # _DONE sentinel below. Executor shutdown is idempotent,
-                # so both sides calling it is harmless.
-                pool = self._inflate_pool
+                # The pool swap runs under _active_lock on BOTH racing
+                # sides (here and in stop()): the PR 13 fix bound the
+                # attribute to a local so the last worker couldn't
+                # AttributeError out of this finally, but the two sides
+                # still raced the None swap — BJX117 now pins the
+                # remaining window shut. Executor shutdown stays
+                # idempotent either way.
+                with self._active_lock:
+                    pool = self._inflate_pool
+                    self._inflate_pool = None
                 if pool is not None:
                     # every shard iterator has returned: no stream can
                     # submit another decode job
                     pool.shutdown(wait=False)
-                    self._inflate_pool = None
                 if (
                     self._error is None
                     and not self._consumer_stop
@@ -506,27 +517,33 @@ class ShardedHostIngest:
 
     def start(self) -> "ShardedHostIngest":
         assert not self._threads, "already started"
-        if self.inflate_workers and self._inflate_pool is None:
-            import concurrent.futures
+        # Pool construction/installation under the same lock the two
+        # teardown sides use: a stop() racing a slow start() must see
+        # either no pool or the installed one, never a half-hooked
+        # executor (BJX117).
+        with self._active_lock:
+            if self.inflate_workers and self._inflate_pool is None:
+                import concurrent.futures
 
-            hookable = [
-                s for s in self.streams
-                if hasattr(s, "set_inflate_pool")
-            ]
-            if hookable:
-                self._inflate_pool = (
-                    concurrent.futures.ThreadPoolExecutor(
-                        max_workers=self.inflate_workers,
-                        thread_name_prefix="blendjax-inflate",
+                hookable = [
+                    s for s in self.streams
+                    if hasattr(s, "set_inflate_pool")
+                ]
+                if hookable:
+                    self._inflate_pool = (
+                        concurrent.futures.ThreadPoolExecutor(
+                            max_workers=self.inflate_workers,
+                            thread_name_prefix="blendjax-inflate",
+                        )
                     )
-                )
-                for s in hookable:
-                    s.set_inflate_pool(self._inflate_pool)
+                    for s in hookable:
+                        s.set_inflate_pool(self._inflate_pool)
         for stream in self.streams:
             clear = getattr(stream, "clear_stop_request", None)
             if clear is not None:
                 clear()
-        self._active = len(self.streams)
+        with self._active_lock:
+            self._active = len(self.streams)
         for i in range(len(self.streams)):
             t = threading.Thread(
                 target=self._worker, args=(i,),
@@ -551,6 +568,9 @@ class ShardedHostIngest:
             yield batch
 
     def stop(self, timeout: float = 10.0):
+        # Monotonic bool flag, single writer (the consumer);
+        # GIL-atomic reads bound staleness to one queue item.
+        # bjx: ignore[BJX117] — monotonic single-writer flag
         self._consumer_stop = True
         self._stop.set()
         for stream in self.streams:
@@ -574,14 +594,13 @@ class ShardedHostIngest:
                 break
             for t in self._threads:
                 t.join(timeout=min(0.05, max(remaining, 0.01)))
-        pool = self._inflate_pool
+        with self._active_lock:  # same cut as the last worker's teardown
+            pool = self._inflate_pool
+            self._inflate_pool = None
         if pool is not None:
             # workers are down (or being abandoned as daemons): no new
             # decode jobs can arrive; don't block teardown on stragglers
-            # (local bind mirrors the worker-side teardown — the two may
-            # race; shutdown is idempotent)
             pool.shutdown(wait=False)
-            self._inflate_pool = None
         alive = [t.name for t in self._threads if t.is_alive()]
         if alive:
             raise RuntimeError(

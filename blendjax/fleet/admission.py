@@ -89,9 +89,13 @@ class AdmissionServer:
         try:
             server = RpcServer(self.bind_addr, allow_pickle=False)
         except BaseException as e:  # bad bind addr: surface in start()
+            # Publication sequenced by the _ready Event: written
+            # before set(), read in start() only after wait().
+            # bjx: ignore[BJX117] — sequenced by the _ready Event
             self._startup_error = e
             self._ready.set()
             raise
+        # bjx: ignore[BJX117] — sequenced by the _ready Event
         self.addr = server.addr
         self._ready.set()
         try:

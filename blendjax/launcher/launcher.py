@@ -31,6 +31,15 @@ from blendjax.launcher.arguments import format_launch_args
 from blendjax.launcher.launch_info import LaunchInfo
 from blendjax.utils.ipaddr import get_primary_ip
 from blendjax.utils.logging import get_logger
+from blendjax.utils.tg import guard
+
+# Read-only container surface left unguarded on the membership tables:
+# tests and observers read a quiesced fleet from any thread; every
+# MUTATION (append, setitem, add, clear) still demands `_lock`.
+_MEMBER_READS = (
+    "__getitem__", "__iter__", "__len__", "__contains__",
+    "index", "count", "copy",
+)
 
 logger = get_logger("launcher")
 
@@ -96,6 +105,8 @@ def _free_port(host: str) -> int:
         return s.getsockname()[1]
 
 
+# bjx: thread-shared (the fleet controller's control thread scales the
+# membership while the owner polls/retires: `_lock` guards it — BJX117)
 class ProcessLauncher:
     """Launch ``num_instances`` producer processes speaking the handshake.
 
@@ -155,12 +166,24 @@ class ProcessLauncher:
         )
         self.start_port = start_port
         self.bind_grace_s = float(bind_grace_s)
-        self.processes: list = []
+        self._lock = threading.RLock()
+        # threadguard wiring: the membership tables may only be touched
+        # under `_lock` (the contract the fleet controller's control
+        # thread relies on — BJX117); guard() is identity unless
+        # BLENDJAX_THREADGUARD=1.
+        # read-only list surface exempt: tests and callers index a
+        # quiesced fleet from the main thread; mutation stays locked
+        self.processes: list = guard(
+            [], name="launcher.processes", lock=self._lock,
+            exempt=_MEMBER_READS,
+        )
         self.launch_info: LaunchInfo | None = None
         self._argvs: list = []
         self._ipc_dir: str | None = None
-        self._lock = threading.RLock()
-        self._retired: set = set()
+        self._retired: set = guard(
+            set(), name="launcher.retired", lock=self._lock,
+            exempt=_MEMBER_READS,
+        )
         self._next_port: int | None = None
 
     # -- address plan -------------------------------------------------------
@@ -228,28 +251,33 @@ class ProcessLauncher:
         return self.command(i, handshake)
 
     def __enter__(self) -> "ProcessLauncher":
-        addresses = self._allocate_addresses()
-        self._argvs = []
-        try:
-            for i in range(self.num_instances):
-                sockets = {n: addresses[n][i] for n in self.named_sockets}
-                argv = self._instance_argv(i, sockets)
-                self._argvs.append(argv)
-                self.processes.append(self._spawn(argv))
-                logger.info(
-                    "launched instance %d: %s", i, " ".join(map(str, argv))
-                )
-        except BaseException:
-            # __exit__ never runs when __enter__ raises; reap what we
-            # already spawned before propagating.
-            self.__exit__(None, None, None)
-            raise
-        self.launch_info = LaunchInfo(
-            addresses=addresses,
-            commands=[" ".join(map(str, a)) for a in self._argvs],
-            processes=[p.pid for p in self.processes],
-        )
-        return self
+        # Under the membership lock like every other membership writer:
+        # a fleet controller attached early must observe either the
+        # pre-launch or the fully-launched fleet, never a half-built
+        # processes/launch_info pair (BJX117).
+        with self._lock:
+            addresses = self._allocate_addresses()
+            self._argvs = []
+            try:
+                for i in range(self.num_instances):
+                    sockets = {n: addresses[n][i] for n in self.named_sockets}
+                    argv = self._instance_argv(i, sockets)
+                    self._argvs.append(argv)
+                    self.processes.append(self._spawn(argv))
+                    logger.info(
+                        "launched instance %d: %s", i, " ".join(map(str, argv))
+                    )
+            except BaseException:
+                # __exit__ never runs when __enter__ raises; reap what we
+                # already spawned before propagating.
+                self.__exit__(None, None, None)
+                raise
+            self.launch_info = LaunchInfo(
+                addresses=addresses,
+                commands=[" ".join(map(str, a)) for a in self._argvs],
+                processes=[p.pid for p in self.processes],
+            )
+            return self
 
     def _spawn(self, argv):
         # Own session/process group so the whole producer tree can be
@@ -309,8 +337,9 @@ class ProcessLauncher:
 
     @property
     def addresses(self) -> dict:
-        assert self.launch_info is not None, "not launched"
-        return self.launch_info.addresses
+        with self._lock:
+            assert self.launch_info is not None, "not launched"
+            return self.launch_info.addresses
 
     def poll(self) -> list:
         """Return per-instance exit codes (None = running); with
@@ -352,14 +381,21 @@ class ProcessLauncher:
 
     def wait(self) -> list:
         """Block until all instances exit; returns exit codes
-        (reference ``launcher.py:173-175``)."""
-        return [p.wait() for p in self.processes]
+        (reference ``launcher.py:173-175``). The membership snapshot is
+        taken under the lock but the waits run OUTSIDE it — holding
+        ``_lock`` across an unbounded ``p.wait()`` would block every
+        fleet-controller poll/scale call until the fleet exits
+        (BJX117/BJX119)."""
+        with self._lock:
+            procs = list(self.processes)
+        return [p.wait() for p in procs]
 
     # -- elastic membership --------------------------------------------------
 
     @property
     def retired(self) -> frozenset:
-        return frozenset(self._retired)
+        with self._lock:
+            return frozenset(self._retired)
 
     def active_indices(self) -> list:
         """Instance indices currently part of the fleet (not retired);
@@ -376,10 +412,11 @@ class ProcessLauncher:
 
     def instance_sockets(self, i: int) -> dict:
         """``{socket_name: addr}`` of one instance."""
-        assert self.launch_info is not None, "not launched"
-        return {
-            n: self.launch_info.addresses[n][i] for n in self.named_sockets
-        }
+        with self._lock:
+            assert self.launch_info is not None, "not launched"
+            return {
+                n: self.launch_info.addresses[n][i] for n in self.named_sockets
+            }
 
     def _watch_bind(self, proc, grace_s: float):
         """Poll a fresh spawn through the bind window; returns its exit
@@ -537,6 +574,14 @@ class ProcessLauncher:
         return added, removed
 
     def __exit__(self, exc_type=None, exc=None, tb=None) -> bool:
+        # Teardown owns the membership for its (bounded) duration: a
+        # controller tick racing the final reap must see either the
+        # live fleet or the emptied one (BJX117). Every wait below is
+        # timeout-bounded, so the hold is finite.
+        with self._lock:
+            return self._exit_locked(exc_type)
+
+    def _exit_locked(self, exc_type) -> bool:
         for p in self.processes:
             if p.poll() is None:
                 try:
@@ -559,8 +604,15 @@ class ProcessLauncher:
                     pass
         # All children must be gone (reference asserts, ``launcher.py:181``).
         still = [p.pid for p in self.processes if p.poll() is None]
-        self.processes = []
-        self._retired = set()
+        # re-guard on rebind: the emptied tables keep the lock contract
+        self.processes = guard(
+            [], name="launcher.processes", lock=self._lock,
+            exempt=_MEMBER_READS,
+        )
+        self._retired = guard(
+            set(), name="launcher.retired", lock=self._lock,
+            exempt=_MEMBER_READS,
+        )
         if self._ipc_dir is not None:
             # SIGTERM'd producers never unlink their unix sockets; stale
             # files would also break rebinding after a respawn.

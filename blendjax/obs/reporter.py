@@ -129,6 +129,9 @@ class StatsReporter:
             report, driver=driver,
             staleness_p95_s=self.lineage.staleness_p95_s(),
         )
+        # Lock-free observability publish: one atomic reference
+        # swap per tick; /healthz reads whole verdict objects.
+        # bjx: ignore[BJX117] — atomic reference publish
         self.last_verdict = verdict
         self.log.info("%s", verdict.render())
         self.history.append({

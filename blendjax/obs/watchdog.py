@@ -139,6 +139,12 @@ class SloWatchdog:
         self.slos = [
             Slo.parse(s) if isinstance(s, str) else s for s in slos
         ]
+        # One RLock over all breach state: evaluate() runs on the
+        # reporter thread while /healthz serves state() from the HTTP
+        # exporter's thread — an unlocked sorted(self._breached) there
+        # can throw "set changed size during iteration" mid-breach
+        # (BJX117; reentrant because evaluate reads `healthy` itself).
+        self._lock = threading.RLock()
         self._prev: tuple | None = None  # (t_mono, counters snapshot)
         self._breach_start: dict = {}
         self._breached: set = set()
@@ -147,7 +153,8 @@ class SloWatchdog:
 
     @property
     def healthy(self) -> bool:
-        return not self._breached
+        with self._lock:
+            return not self._breached
 
     def _value(self, slo: Slo, report: dict, verdict, now: float):
         if slo.kind == "doctor":
@@ -188,6 +195,10 @@ class SloWatchdog:
         "newly_breached", "newly_recovered"}``; ``states`` carries one
         entry per rule with the observed value and its breach state."""
         now = time.monotonic() if now is None else now
+        with self._lock:
+            return self._evaluate_locked(report, verdict, now)
+
+    def _evaluate_locked(self, report: dict, verdict, now: float) -> dict:
         was_breached = set(self._breached)
         states: list = []
         newly_recovered: list = []
@@ -234,12 +245,13 @@ class SloWatchdog:
         }
 
     def state(self) -> dict:
-        return {
-            "healthy": self.healthy,
-            "breached": sorted(self._breached),
-            "breach_events": self.breach_events,
-            "states": self.last_states,
-        }
+        with self._lock:
+            return {
+                "healthy": self.healthy,
+                "breached": sorted(self._breached),
+                "breach_events": self.breach_events,
+                "states": self.last_states,
+            }
 
 
 class FlightRecorder:

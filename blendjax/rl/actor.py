@@ -46,6 +46,7 @@ import numpy as np
 
 from blendjax.utils.logging import get_logger
 from blendjax.utils.metrics import metrics
+from blendjax.utils.tg import guard
 
 logger = get_logger("rl")
 
@@ -154,7 +155,14 @@ class ActorPool:
     def __init__(self, env, reservoir, policy, action_map=None,
                  extra_fields=None, return_tail: int = 256):
         self.env = env
-        self.reservoir = reservoir
+        # lock discipline, enforced at runtime under threadguard: every
+        # reservoir touch from this pool happens inside `with
+        # self.reservoir.lock:` (the insert+accounting cut); the lock
+        # handle itself is exempt — it must be fetchable to acquire.
+        self.reservoir = guard(
+            reservoir, name="rl.reservoir", lock=reservoir.lock,
+            exempt=("lock",),
+        )
         self.policy = policy
         if action_map is not None and not callable(action_map):
             table = np.asarray(action_map)
@@ -181,8 +189,14 @@ class ActorPool:
         the learner calls ``jax.device_get`` on ITS thread at the
         ``sync_every`` cadence and hands the result here; reference
         swap only, no locks needed for the reader)."""
+        # Deliberate lock-free publish: a single atomic reference
+        # swap; the actor reads whole snapshots only.
+        # bjx: ignore[BJX117] — atomic reference publish
         self._snapshot = snapshot
-        self.policy_version += 1
+        # ...but the version counter is read-modify-write: share the
+        # accounting cut's lock so stats() reads a consistent pair
+        with self.reservoir.lock:
+            self.policy_version += 1
         metrics.count("rl.policy_syncs")
 
     # -- the actor loop -------------------------------------------------------
@@ -257,6 +271,9 @@ class ActorPool:
                 self._obs = np.asarray(nobs, np.float32)
         except BaseException as e:  # surfaced by the learner's check()
             if not self._stop.is_set():
+                # Single-writer atomic reference publish; check()
+                # only ever reads None -> exception.
+                # bjx: ignore[BJX117] — atomic reference publish
                 self._error = e
                 logger.exception("actor loop died")
 
@@ -297,15 +314,19 @@ class ActorPool:
 
     @property
     def stats(self) -> dict:
-        recent = [r for _, r in self.episode_returns[-32:]]
-        return {
-            "env_steps": self.env_steps,
-            "episodes": self.episodes,
-            "policy_version": self.policy_version,
-            "mean_return": (
-                round(float(np.mean(recent)), 3) if recent else None
-            ),
-        }
+        # Same critical section as the actor's insert+accounting cut:
+        # an unlocked read here could pair a post-episode `episodes`
+        # with a pre-episode `episode_returns` (BJX117).
+        with self.reservoir.lock:
+            recent = [r for _, r in self.episode_returns[-32:]]
+            return {
+                "env_steps": self.env_steps,
+                "episodes": self.episodes,
+                "policy_version": self.policy_version,
+                "mean_return": (
+                    round(float(np.mean(recent)), 3) if recent else None
+                ),
+            }
 
     def state_dict(self) -> dict:
         """Host counters + the reward-curve tail + the policy's
@@ -338,12 +359,16 @@ class ActorPool:
             raise RuntimeError(
                 "load_state_dict must run before the actor starts"
             )
-        self.env_steps = int(d["env_steps"])
-        self.episodes = int(d["episodes"])
-        self.policy_version = int(d.get("policy_version", 0))
-        self.episode_returns = [
-            (int(s), float(r)) for s, r in d.get("episode_returns", [])
-        ]
+        # The actor thread can't be running (checked above), but the
+        # restore still takes the accounting cut's lock so a concurrent
+        # stats()/state_dict() reader sees old-or-new, never a mix.
+        with self.reservoir.lock:
+            self.env_steps = int(d["env_steps"])
+            self.episodes = int(d["episodes"])
+            self.policy_version = int(d.get("policy_version", 0))
+            self.episode_returns = [
+                (int(s), float(r)) for s, r in d.get("episode_returns", [])
+            ]
         pol = d.get("policy")
         if pol is not None and hasattr(self.policy, "load_state_dict"):
             self.policy.load_state_dict(pol)

@@ -72,6 +72,8 @@ def _require_jax():
     return jax
 
 
+# bjx: thread-shared (actor pool inserts from its thread while the
+# learner draws: every public entry point must hold `lock` — BJX117)
 class TrajectoryReservoir:
     """Device-resident ring of the last ``capacity`` transitions.
 
@@ -251,8 +253,9 @@ class TrajectoryReservoir:
             self._t_insert[slots] = time.monotonic()
             self._filled[slots] = True
             self._prio_host[slots] = self._pmax
+            fill = int(self._filled.sum())
         metrics.count("rl.transitions", lead)
-        metrics.gauge("rl.reservoir_fill", int(self._filled.sum()))
+        metrics.gauge("rl.reservoir_fill", fill)
         return slots
 
     # -- host-side draw composition -------------------------------------------
@@ -344,12 +347,12 @@ class TrajectoryReservoir:
         and the NEXT donated insert consumes them — hold :attr:`lock`
         from token creation through the fused dispatch (the learner
         driver does)."""
-        if self._buffers is None:
-            raise RuntimeError("reservoir is empty: insert() first")
         idx = np.asarray(idx, np.int32)
         if weights is None:
             weights = np.ones(len(idx), np.float32)
         with self.lock:
+            if self._buffers is None:
+                raise RuntimeError("reservoir is empty: insert() first")
             self._account_draw(idx)
             return {
                 "_rl_buffers": self._buffers,
@@ -377,31 +380,39 @@ class TrajectoryReservoir:
         """Eager jitted gather of ``idx`` rows (inspection/tests; the
         learner hot path fuses the gather via :meth:`draw_token`).
         Advances the same accounting the fused path uses."""
-        if self._buffers is None:
-            raise RuntimeError("reservoir is empty: insert() first")
         idx = np.asarray(idx, np.int32)
         with self.lock:
+            if self._buffers is None:
+                raise RuntimeError("reservoir is empty: insert() first")
             self._account_draw(idx)
             with metrics.span("rl.sample"):
                 return self._gather_fn(self._buffers, idx)
 
     @property
     def fields(self) -> tuple:
-        return tuple(self._spec) if self._spec else ()
+        with self.lock:
+            return tuple(self._spec) if self._spec else ()
 
     @property
     def stats(self) -> dict:
-        drawn = self.fresh + self.replayed
-        return {
-            "size": self.size,
-            "inserts": self.inserts,
-            "draws": self._draws,
-            "fresh": self.fresh,
-            "replayed": self.replayed,
-            "replay_ratio": round(self.replayed / drawn, 4) if drawn else None,
-            "prioritized": self.prioritized,
-            "pmax": round(self._pmax, 6),
-        }
+        # Under the lock like every other entry point: an actor-thread
+        # insert racing an unlocked read here handed out torn
+        # fresh/replayed/size cuts (the state_dict-vs-draw race shape
+        # BJX117 now flags).
+        with self.lock:
+            drawn = self.fresh + self.replayed
+            return {
+                "size": self.size,
+                "inserts": self.inserts,
+                "draws": self._draws,
+                "fresh": self.fresh,
+                "replayed": self.replayed,
+                "replay_ratio": (
+                    round(self.replayed / drawn, 4) if drawn else None
+                ),
+                "prioritized": self.prioritized,
+                "pmax": round(self._pmax, 6),
+            }
 
     # -- session snapshot (blendjax.checkpoint) -------------------------------
 

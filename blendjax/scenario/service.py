@@ -37,6 +37,7 @@ from blendjax.scenario.accounting import accounting
 from blendjax.scenario.space import ScenarioSpace
 from blendjax.utils.logging import get_logger
 from blendjax.utils.metrics import metrics
+from blendjax.utils.tg import guard
 
 logger = get_logger("scenario")
 
@@ -61,8 +62,14 @@ class ScenarioService:
         self._space_wire: dict | None = None
         self._version = 0
         self.space: ScenarioSpace | None = None
-        self._members: dict = {}  # btid -> addr (bookkeeping view)
-        self._acked: dict = {}  # btid -> highest acked version
+        # threadguard wiring: membership/ack bookkeeping only under
+        # `_lock` (guard() is identity unless BLENDJAX_THREADGUARD=1)
+        self._members: dict = guard(  # btid -> addr (bookkeeping view)
+            {}, name="scenario.members", lock=self._lock
+        )
+        self._acked: dict = guard(  # btid -> highest acked version
+            {}, name="scenario.acked", lock=self._lock
+        )
         self._cmds: queue.Queue = queue.Queue()
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
@@ -198,9 +205,17 @@ class ScenarioService:
                         if old is not None:
                             old.close()
                         try:
-                            chan = PairChannel(
-                                addr, bind=False, allow_pickle=False,
-                                default_timeoutms=0,
+                            # creator affinity: the duplex socket is
+                            # born, used, and closed ONLY on this
+                            # service thread (BJX104; threadguard
+                            # enforces it at runtime when enabled)
+                            chan = guard(
+                                PairChannel(
+                                    addr, bind=False, allow_pickle=False,
+                                    default_timeoutms=0,
+                                ),
+                                name=f"scenario.chan[{btid}]",
+                                affinity="creator",
                             )
                             # bounded sends: a PAIR socket whose peer
                             # died (no 'leave') or whose pipe filled

@@ -20,6 +20,8 @@ import threading
 import time
 from collections import defaultdict, deque
 
+from blendjax.utils.tg import guard
+
 # 8 buckets per octave: bucket bounds grow by 2**(1/8) ≈ 9.05%, so a
 # quantile read from the bucket midpoint is within ~4.4% of the true
 # value — tight enough to tell a 2x tail regression apart, cheap enough
@@ -158,6 +160,8 @@ class Histogram:
         return out
 
 
+# bjx: thread-shared (every thread in the process reports here; one
+# `_lock` makes each snapshot/update consistent — BJX117)
 class Metrics:
     """Process-local registry. Thread-safe AND snapshot-exact: every
     mutation — counters, gauges, spans, histograms — runs under one
@@ -171,15 +175,36 @@ class Metrics:
     """
 
     def __init__(self):
-        self.counters: dict = defaultdict(int)
-        self.gauges: dict = {}
-        self._spans: dict = defaultdict(lambda: [0, 0.0])  # count, total_s
-        self._hists: dict = defaultdict(Histogram)
+        self._lock = threading.Lock()
+        # threadguard wiring (blendjax.utils.tg): under
+        # BLENDJAX_THREADGUARD=1 any MUTATION of these tables without
+        # `_lock` held raises at the access site; disabled, guard() is
+        # identity and the registry is exactly as before. The read-only
+        # dict surface of the two public tables stays exempt: tests and
+        # debug code read counters after quiescing, and the consistent-
+        # snapshot path is report(), not the raw dict.
+        reads = (
+            "get", "keys", "items", "values", "copy",
+            "__getitem__", "__iter__", "__len__", "__contains__",
+        )
+        self.counters: dict = guard(
+            defaultdict(int), name="metrics.counters", lock=self._lock,
+            exempt=reads,
+        )
+        self.gauges: dict = guard(
+            {}, name="metrics.gauges", lock=self._lock, exempt=reads,
+        )
+        self._spans: dict = guard(  # count, total_s
+            defaultdict(lambda: [0, 0.0]), name="metrics.spans",
+            lock=self._lock,
+        )
+        self._hists: dict = guard(
+            defaultdict(Histogram), name="metrics.hists", lock=self._lock
+        )
         # Optional per-span event ring for Chrome-trace export
         # (blendjax.obs.exporters.write_chrome_trace): disabled by
         # default — aggregates are always on, events are opt-in.
         self._events: deque | None = None
-        self._lock = threading.Lock()
 
     def count(self, name: str, n: int = 1) -> None:
         # `dict[k] += n` is load/add/store bytecode — two workers

@@ -1,0 +1,38 @@
+"""Zero-overhead indirection to the threadguard sanitizer.
+
+Production modules declare their concurrency contracts by wrapping the
+objects the contracts are about::
+
+    from blendjax.utils.tg import guard
+    ...
+    self._counters = guard({}, name="metrics.counters", lock=self._lock)
+
+With ``BLENDJAX_THREADGUARD`` unset (the default, and every hot path's
+contract) ``guard`` is the identity function: no proxy, no per-access
+cost, and :mod:`blendjax.testing.threadguard` is never even imported.
+With ``BLENDJAX_THREADGUARD=1`` (the threadguard CI job, soak runs)
+the real sanitizer wraps the object and raises
+:class:`~blendjax.testing.threadguard.ThreadGuardError` on any
+affinity or lock-discipline violation.
+
+The switch is read ONCE at import (process start): the sanitizer
+changes what attribute access *means* on wired objects, which is not
+something to toggle mid-run. Tests that need the real ``guard``
+regardless of the environment import it from
+``blendjax.testing.threadguard`` directly.
+"""
+
+from __future__ import annotations
+
+import os
+
+if os.environ.get("BLENDJAX_THREADGUARD", "0") not in ("", "0", "false"):
+    from blendjax.testing.threadguard import guard
+else:
+
+    def guard(obj, **kwargs):  # noqa: ARG001 - mirror the real signature
+        """Disabled sanitizer: identity (see module docstring)."""
+        return obj
+
+
+__all__ = ["guard"]

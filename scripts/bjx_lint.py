@@ -10,7 +10,7 @@ import sys
 
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
-VALUE_OPTS = {"--select", "--baseline", "--format"}
+VALUE_OPTS = {"--select", "--baseline", "--format", "--max-seconds"}
 
 if __name__ == "__main__":
     # Pin positional path args to the invoker's cwd before we chdir to

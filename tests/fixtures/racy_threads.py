@@ -1,0 +1,53 @@
+"""Deliberately-racy fixture for the whole-program pass (BJX117/118/119).
+
+NOT production code and NOT importable by tests as logic — this module
+exists so ``tests/test_analysis.py`` can assert the project pass flags
+a known-bad file end-to-end through ``analyze_paths(project=True)``.
+It lives under ``tests/fixtures/`` precisely so the repo self-run
+(which scans ``blendjax/``) never sees it.
+
+Expected findings:
+
+- BJX117 on ``Racy.counter`` — written from the spawned drain thread
+  and read from the public API with no common lock.
+- BJX118 on ``(Racy.lock_a, Racy.lock_b)`` — acquired a->b in
+  ``both_ab`` but b->a in ``both_ba``.
+- BJX119 on ``Racy.wedge`` — an untimed queue get while holding
+  ``lock_a``.
+"""
+
+import queue
+import threading
+
+
+class Racy:
+    def __init__(self):
+        self.lock_a = threading.Lock()
+        self.lock_b = threading.Lock()
+        self.counter = 0
+        self._q = queue.Queue()
+
+    def start(self):
+        threading.Thread(target=self._drain, daemon=True).start()
+
+    def _drain(self):
+        while True:
+            self._q.get(timeout=0.25)
+            self.counter += 1  # raced write: no lock, two contexts
+
+    def snapshot(self) -> int:
+        return self.counter  # raced read from the public API
+
+    def both_ab(self):
+        with self.lock_a:
+            with self.lock_b:
+                pass
+
+    def both_ba(self):
+        with self.lock_b:
+            with self.lock_a:
+                pass
+
+    def wedge(self):
+        with self.lock_a:
+            return self._q.get()  # blocking, untimed, under a lock

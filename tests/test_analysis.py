@@ -7,6 +7,7 @@ import os
 import subprocess
 import sys
 import textwrap
+import time
 
 from blendjax.analysis import (
     analyze_paths,
@@ -1257,8 +1258,19 @@ def test_every_rule_registered():
     assert set(all_rules()) == {
         "BJX101", "BJX102", "BJX103", "BJX104", "BJX105", "BJX106",
         "BJX107", "BJX108", "BJX109", "BJX110", "BJX111", "BJX112",
-        "BJX113", "BJX114", "BJX115", "BJX116",
+        "BJX113", "BJX114", "BJX115", "BJX116", "BJX117", "BJX118",
+        "BJX119",
     }
+
+
+def test_project_rules_marked_and_skipped_by_per_file_pass():
+    rules = all_rules()
+    assert all(rules[r].project for r in ("BJX117", "BJX118", "BJX119"))
+    assert all(
+        not rules[r].project for r in set(rules) - {"BJX117", "BJX118", "BJX119"}
+    )
+    # per-file analysis never runs a project rule (check() is a no-op)
+    assert rules["BJX117"].check(None) == ()
 
 
 # -- BJX114 checkpoint-in-hot-path -------------------------------------------
@@ -1413,10 +1425,11 @@ def test_bjx115_silent_outside_actor_modules_and_suppressible():
 
 
 def test_repo_is_clean_under_baseline():
-    """The CI contract: ``python -m blendjax.analysis blendjax/`` exits 0."""
+    """The CI contract: ``python -m blendjax.analysis blendjax/`` exits 0
+    — per-file rules AND the whole-program pass."""
     baseline = load_baseline(os.path.join(REPO_ROOT, ".bjx-baseline.json"))
     got = analyze_paths(
-        [os.path.join(REPO_ROOT, "blendjax")], root=REPO_ROOT
+        [os.path.join(REPO_ROOT, "blendjax")], root=REPO_ROOT, project=True
     )
     left = apply_baseline(got, baseline, REPO_ROOT)
     assert left == [], "\n".join(f.render() for f in left)
@@ -1491,3 +1504,621 @@ def test_bjx116_suppressible_inline():
             return zlib.decompress(buf)
     """
     assert rule_ids(src, select=["BJX116"]) == []
+
+
+# -- whole-program pass (ProjectContext + BJX117/118/119) ---------------------
+
+from blendjax.analysis.core import (  # noqa: E402
+    ModuleContext,
+    analyze_project_modules,
+    parse_paths,
+)
+
+
+def project_findings(*sources, select=None):
+    """Project-pass findings over one or more dedented module sources
+    (named ``pkg/m0.py``, ``pkg/m1.py``, ...)."""
+    modules = [
+        ModuleContext(textwrap.dedent(src), f"pkg/m{i}.py")
+        for i, src in enumerate(sources)
+    ]
+    return analyze_project_modules(
+        modules, select=set(select) if select else None
+    )
+
+
+RACY_WORKER = """
+    import threading
+
+    class Worker:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self.count = 0
+
+        def start(self):
+            threading.Thread(target=self._run, daemon=True).start()
+
+        def _run(self):
+            while True:
+                self.count += 1
+
+        def snapshot(self):
+            return self.count
+"""
+
+
+def test_bjx117_flags_unlocked_write_across_thread_contexts():
+    got = project_findings(RACY_WORKER, select=["BJX117"])
+    assert [f.rule for f in got] == ["BJX117"]
+    assert got[0].identity == "pkg.m0.Worker.count"
+    assert "self.count" in got[0].message
+    assert "Worker._run" in got[0].message  # the spawned context is named
+
+
+def test_bjx117_negative_common_lock_over_all_accesses():
+    src = """
+        import threading
+
+        class Worker:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.count = 0
+
+            def start(self):
+                threading.Thread(target=self._run, daemon=True).start()
+
+            def _run(self):
+                while True:
+                    with self._lock:
+                        self.count += 1
+
+            def snapshot(self):
+                with self._lock:
+                    return self.count
+    """
+    assert project_findings(src, select=["BJX117"]) == []
+
+
+def test_bjx117_negative_init_only_config_and_safe_types():
+    src = """
+        import queue
+        import threading
+
+        class Worker:
+            def __init__(self):
+                self.size = 4            # config: written only here
+                self._q = queue.Queue()  # thread-safe value type
+                self._stop = threading.Event()
+
+            def start(self):
+                threading.Thread(target=self._run, daemon=True).start()
+
+            def _run(self):
+                while not self._stop.is_set():
+                    self._q.put(self.size)
+
+            def snapshot(self):
+                return self._q.qsize() + self.size
+    """
+    assert project_findings(src, select=["BJX117"]) == []
+
+
+def test_bjx117_entry_lockset_covers_locked_helpers():
+    """A private helper called ONLY under the lock inherits it (the
+    ``tick`` -> ``_tick_locked`` shape): no finding."""
+    src = """
+        import threading
+
+        class Controller:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.streak = 0
+
+            def start(self):
+                threading.Thread(target=self._run, daemon=True).start()
+
+            def _run(self):
+                while True:
+                    self.tick()
+
+            def tick(self):
+                with self._lock:
+                    self._tick_locked()
+
+            def _tick_locked(self):
+                self.streak += 1
+
+            def state(self):
+                with self._lock:
+                    return self.streak
+    """
+    assert project_findings(src, select=["BJX117"]) == []
+
+
+def test_bjx117_thread_shared_marker_demands_locks_without_spawns():
+    marked = """
+        import threading
+
+        # bjx: thread-shared
+        class Reservoir:
+            def __init__(self):
+                self.lock = threading.RLock()
+                self.draws = 0
+
+            def draw(self):
+                with self.lock:
+                    self.draws += 1
+
+            def stats(self):
+                return self.draws
+    """
+    got = project_findings(marked, select=["BJX117"])
+    assert [f.rule for f in got] == ["BJX117"]
+    assert got[0].identity == "pkg.m0.Reservoir.draws"
+    # same class, no marker: no spawns anywhere -> single context, clean
+    unmarked = marked.replace("# bjx: thread-shared", "# (unmarked)")
+    assert project_findings(unmarked, select=["BJX117"]) == []
+
+
+def test_bjx117_cross_module_spawn_graph():
+    """A thread spawned in module 0 reaches a class in module 1 through
+    a resolvable constructor attribute — the whole-program part."""
+    spawner = """
+        import threading
+
+        from pkg.m1 import Sink
+
+        class Pump:
+            def __init__(self):
+                self.sink = Sink()
+
+            def start(self):
+                threading.Thread(target=self._run, daemon=True).start()
+
+            def _run(self):
+                while True:
+                    self.sink.push(1)
+    """
+    sink = """
+        class Sink:
+            def __init__(self):
+                self.total = 0
+
+            def push(self, n):
+                self.total += n
+
+            def read(self):
+                return self.total
+    """
+    got = project_findings(spawner, sink, select=["BJX117"])
+    assert [f.identity for f in got] == ["pkg.m1.Sink.total"]
+    assert "Pump._run" in got[0].message
+
+
+def test_bjx117_suppressible_inline():
+    src = RACY_WORKER.replace(
+        "                self.count += 1",
+        "                # bjx: ignore[BJX117]\n"
+        "                self.count += 1",
+    )
+    assert project_findings(src, select=["BJX117"]) == []
+
+
+def test_bjx117_executor_submit_is_a_spawn_site():
+    src = """
+        from concurrent.futures import ThreadPoolExecutor
+
+        class Pool:
+            def __init__(self):
+                self.done = 0
+                self._pool = ThreadPoolExecutor(2)
+
+            def kick(self):
+                self._pool.submit(self._work)
+
+            def _work(self):
+                self.done += 1
+
+            def read(self):
+                return self.done
+    """
+    got = project_findings(src, select=["BJX117"])
+    assert [f.identity for f in got] == ["pkg.m0.Pool.done"]
+
+
+LOCK_ORDER = """
+    import threading
+
+    class Orders:
+        def __init__(self):
+            self.a = threading.Lock()
+            self.b = threading.Lock()
+
+        def one(self):
+            with self.a:
+                with self.b:
+                    pass
+
+        def two(self):
+            with self.b:
+                with self.a:
+                    pass
+"""
+
+
+def test_bjx118_flags_inconsistent_nesting_once_per_pair():
+    got = project_findings(LOCK_ORDER, select=["BJX118"])
+    assert [f.rule for f in got] == ["BJX118"]
+    assert got[0].identity == "pkg.m0.Orders.a<>pkg.m0.Orders.b"
+    assert "Orders.two" in got[0].message or "Orders.one" in got[0].message
+
+
+def test_bjx118_negative_consistent_order_and_same_lock():
+    src = """
+        import threading
+
+        class Orders:
+            def __init__(self):
+                self.a = threading.RLock()
+                self.b = threading.Lock()
+
+            def one(self):
+                with self.a:
+                    with self.b:
+                        pass
+
+            def two(self):
+                with self.a:
+                    with self.a:  # reentrant re-acquire, not a pair
+                        with self.b:
+                            pass
+    """
+    assert project_findings(src, select=["BJX118"]) == []
+
+
+def test_bjx118_transitive_through_the_call_graph():
+    src = """
+        import threading
+
+        class Orders:
+            def __init__(self):
+                self.a = threading.Lock()
+                self.b = threading.Lock()
+
+            def outer_ab(self):
+                with self.a:
+                    self._take_b()
+
+            def _take_b(self):
+                with self.b:
+                    pass
+
+            def outer_ba(self):
+                with self.b:
+                    with self.a:
+                        pass
+    """
+    got = project_findings(src, select=["BJX118"])
+    assert [f.identity for f in got] == ["pkg.m0.Orders.a<>pkg.m0.Orders.b"]
+
+
+BLOCKED = """
+    import queue
+    import threading
+
+    class Service:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._cmds = queue.Queue()
+
+        def start(self):
+            threading.Thread(target=self._serve, daemon=True).start()
+
+        def _serve(self):
+            while True:
+                pass
+
+        def wedge(self):
+            with self._lock:
+                return self._cmds.get()
+"""
+
+
+def test_bjx119_flags_untimed_queue_get_under_contended_lock():
+    got = project_findings(BLOCKED, select=["BJX119"])
+    assert [f.rule for f in got] == ["BJX119"]
+    assert "queue get()" in got[0].message
+    assert "Service.wedge" in got[0].message
+
+
+def test_bjx119_negative_timeouts_nowait_and_unthreaded_classes():
+    timed = BLOCKED.replace(
+        "self._cmds.get()", "self._cmds.get(timeout=0.25)"
+    )
+    assert project_findings(timed, select=["BJX119"]) == []
+    nonblock = BLOCKED.replace(
+        "self._cmds.get()", "self._cmds.get(block=False)"
+    )
+    assert project_findings(nonblock, select=["BJX119"]) == []
+    # positional timeout slot (the documented Queue.get signature)
+    positional = BLOCKED.replace(
+        "self._cmds.get()", "self._cmds.get(True, 0.25)"
+    )
+    assert project_findings(positional, select=["BJX119"]) == []
+    # no thread ever contends the lock: the same shape is not flagged
+    unthreaded = BLOCKED.replace(
+        "            threading.Thread(target=self._serve, daemon=True).start()",
+        "            pass",
+    )
+    assert project_findings(unthreaded, select=["BJX119"]) == []
+
+
+def test_bjx119_flags_socket_send_join_and_wait_under_lock():
+    src = """
+        import threading
+
+        class Service:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def start(self):
+                threading.Thread(target=self._serve, daemon=True).start()
+
+            def _serve(self):
+                pass
+
+            def publish(self, chan, t, ev):
+                with self._lock:
+                    chan.send(b"x")
+                    t.join()
+                    ev.wait()
+    """
+    got = project_findings(src, select=["BJX119"])
+    assert sorted(f.message.split(" in ")[0] for f in got) == [
+        "blocking join()",
+        "blocking socket send()",
+        "blocking wait()",
+    ]
+
+
+def test_bjx119_condition_wait_and_bounded_calls_are_sanctioned():
+    src = """
+        import threading
+
+        class Service:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._cv = threading.Condition(self._lock)
+
+            def start(self):
+                threading.Thread(target=self._serve, daemon=True).start()
+
+            def _serve(self):
+                pass
+
+            def waiter(self, chan, t):
+                with self._lock:
+                    self._cv.wait()          # releases the lock by design
+                    t.join(timeout=2.0)
+                    chan.recv(timeoutms=0)
+    """
+    assert project_findings(src, select=["BJX119"]) == []
+
+
+def test_bjx119_suppressible_inline():
+    src = BLOCKED.replace(
+        "                return self._cmds.get()",
+        "                # bjx: ignore[BJX119]\n"
+        "                return self._cmds.get()",
+    )
+    assert project_findings(src, select=["BJX119"]) == []
+
+
+# -- project fingerprints + baseline migration --------------------------------
+
+
+def test_project_fingerprints_survive_line_shifts_and_rewording(tmp_path):
+    root = tmp_path
+    mod = tmp_path / "pkg"
+    mod.mkdir()
+    path = mod / "w.py"
+    path.write_text(textwrap.dedent(RACY_WORKER))
+    got = analyze_paths([str(mod)], root=str(root), project=True)
+    assert [f.rule for f in got] == ["BJX117"]
+    baseline = tmp_path / "bl.json"
+    write_baseline(str(baseline), got, str(root))
+    data = json.load(open(baseline))
+    assert data["version"] == 2
+    assert data["entries"][0]["identity"] == "pkg.w.Worker.count"
+    # shift every line AND change the anchor line's text: the identity
+    # fingerprint still matches, so the finding stays grandfathered
+    shifted = "# a new leading comment\nX = 1\n" + textwrap.dedent(
+        RACY_WORKER
+    ).replace("self.count += 1", "self.count = self.count + 2")
+    path.write_text(shifted)
+    again = analyze_paths([str(mod)], root=str(root), project=True)
+    left = apply_baseline(again, load_baseline(str(baseline)), str(root))
+    assert left == []
+
+
+def test_baseline_version_1_files_stay_valid(tmp_path):
+    bl = tmp_path / "old.json"
+    bl.write_text(json.dumps({
+        "version": 1,
+        "entries": [{"fingerprint": "cafe", "rule": "BJX102",
+                     "path": "x.py", "line": 1, "message": "m"}],
+    }))
+    assert load_baseline(str(bl)) == {"cafe"}
+
+
+# -- shared AST cache ----------------------------------------------------------
+
+
+def test_parse_paths_shares_one_module_context_per_file(tmp_path):
+    p = tmp_path / "m.py"
+    p.write_text("import threading\n\n\ndef f():\n    return 1\n")
+    modules, errors = parse_paths([str(p)], root=str(tmp_path))
+    assert errors == [] and len(modules) == 1
+    m = modules[0]
+    # the by-type index serves repeated queries without re-walking
+    import ast as _ast
+
+    assert m.nodes(_ast.Import) and m.nodes(_ast.FunctionDef)
+    # the function table is computed once and cached
+    assert list(m.iter_functions()) == list(m.iter_functions())
+    assert m.modname == "m"
+
+
+def test_parse_paths_reports_syntax_errors_as_findings(tmp_path):
+    p = tmp_path / "bad.py"
+    p.write_text("def broken(:\n")
+    modules, errors = parse_paths([str(p)], root=str(tmp_path))
+    assert modules == []
+    assert [f.rule for f in errors] == ["BJX000"]
+
+
+# -- the racy fixture, end to end ---------------------------------------------
+
+
+def test_project_pass_flags_the_racy_fixture():
+    fixture = os.path.join(REPO_ROOT, "tests", "fixtures", "racy_threads.py")
+    got = analyze_paths([fixture], root=REPO_ROOT, project=True)
+    rules = sorted({f.rule for f in got})
+    assert rules == ["BJX117", "BJX118", "BJX119"], [
+        f.render() for f in got
+    ]
+    by_rule = {f.rule: f for f in got}
+    assert by_rule["BJX117"].identity.endswith("Racy.counter")
+    assert "<>" in by_rule["BJX118"].identity
+    assert "queue get()" in by_rule["BJX119"].message
+
+
+# -- CLI: --project / --no-project / exit codes --------------------------------
+
+
+def run_cli(args, cwd):
+    return subprocess.run(
+        [sys.executable, "-m", "blendjax.analysis", *args],
+        capture_output=True, text=True, cwd=cwd,
+        env={**os.environ, "PYTHONPATH": REPO_ROOT},
+    )
+
+
+def test_cli_project_mode_default_on_and_opt_out(tmp_path):
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "w.py").write_text(textwrap.dedent(RACY_WORKER))
+    on = run_cli(["pkg"], cwd=str(tmp_path))
+    assert on.returncode == 1 and "BJX117" in on.stdout
+    off = run_cli(["pkg", "--no-project"], cwd=str(tmp_path))
+    assert off.returncode == 0, off.stdout + off.stderr
+
+
+def test_cli_project_mode_parse_failure_exits_3_with_hint(tmp_path):
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "ok.py").write_text("x = 1\n")
+    (pkg / "bad.py").write_text("def broken(:\n")
+    r = run_cli(["pkg"], cwd=str(tmp_path))
+    assert r.returncode == 3
+    assert "--no-project" in r.stderr and "BJX000" in r.stderr
+    # the quick path still reports the syntax error as a finding
+    r2 = run_cli(["pkg", "--no-project"], cwd=str(tmp_path))
+    assert r2.returncode == 1 and "BJX000" in r2.stdout
+
+
+def test_cli_max_seconds_budget(tmp_path):
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "ok.py").write_text("x = 1\n")
+    ok = run_cli(["pkg", "--max-seconds", "120"], cwd=str(tmp_path))
+    assert ok.returncode == 0
+    over = run_cli(["pkg", "--max-seconds", "0"], cwd=str(tmp_path))
+    assert over.returncode == 4
+    assert "budget" in over.stderr
+
+
+def test_full_repo_lint_fits_the_ci_wall_time_budget():
+    """The CI lint job runs with --max-seconds 60; keep generous local
+    headroom so slow CI runners still clear it (the shared-AST-cache
+    pass runs the full repo in ~2 s on a dev box)."""
+    t0 = time.perf_counter()
+    analyze_paths(
+        [os.path.join(REPO_ROOT, "blendjax")], root=REPO_ROOT, project=True
+    )
+    assert time.perf_counter() - t0 < 30.0
+
+
+def test_list_rules_marks_scope():
+    r = run_cli(["--list-rules"], cwd=REPO_ROOT)
+    assert r.returncode == 0
+    assert "BJX117 unlocked-shared-mutation [project]" in r.stdout
+    assert "BJX101 jit-purity [file]" in r.stdout
+
+
+def test_bjx117_lock_name_matching_is_word_boundary():
+    """'host_blocks' is a counter, not a lock: a substring match
+    silently dropped it from the race analysis (review finding)."""
+    src = """
+        import threading
+
+        class Worker:
+            def __init__(self):
+                self.host_blocks = 0
+
+            def start(self):
+                threading.Thread(target=self._run, daemon=True).start()
+
+            def _run(self):
+                while True:
+                    self.host_blocks += 1
+
+            def snapshot(self):
+                return self.host_blocks
+    """
+    got = project_findings(src, select=["BJX117"])
+    assert [f.identity for f in got] == ["pkg.m0.Worker.host_blocks"]
+    # real lock spellings still recognized as locks (exempt + with-able)
+    lockish = """
+        import threading
+
+        class Worker:
+            def __init__(self):
+                self.lock_a = threading.Lock()
+                self.state = 0
+
+            def start(self):
+                threading.Thread(target=self._run, daemon=True).start()
+
+            def _run(self):
+                with self.lock_a:
+                    self.state += 1
+
+            def snapshot(self):
+                with self.lock_a:
+                    return self.state
+    """
+    assert project_findings(lockish, select=["BJX117"]) == []
+
+
+def test_bjx117_nested_public_named_closures_stay_thread_confined():
+    """A closure with a public-looking name inside a spawn target runs
+    only in its parent's context — it must not be seeded as a 'main'
+    entry point (review finding: spurious second context)."""
+    src = """
+        import threading
+
+        class Confined:
+            def __init__(self):
+                self.n = 0
+
+            def start(self):
+                threading.Thread(target=self._drain, daemon=True).start()
+
+            def _drain(self):
+                def flush():
+                    self.n += 1
+                while True:
+                    flush()
+    """
+    assert project_findings(src, select=["BJX117"]) == []

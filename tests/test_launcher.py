@@ -224,3 +224,36 @@ def test_producers_die_with_killed_launcher(tmp_path):
     finally:
         if p.poll() is None:
             p.kill()
+
+
+def test_wait_does_not_hold_the_membership_lock():
+    """BJX117/BJX119 regression: wait() snapshots under the lock but
+    blocks OUTSIDE it, so a fleet controller can still poll/scale while
+    the owner waits for the fleet to exit."""
+    import sys as _sys
+    import threading
+
+    from blendjax.launcher import ProcessLauncher
+
+    def command(i, handshake):
+        return [_sys.executable, "-c", "import time; time.sleep(30)"] + handshake
+
+    with ProcessLauncher(command, num_instances=1,
+                         named_sockets=["DATA"]) as ln:
+        done = threading.Event()
+        codes = []
+
+        def waiter():
+            codes.append(ln.wait())
+            done.set()
+
+        t = threading.Thread(target=waiter, daemon=True)
+        t.start()
+        # while wait() blocks on the child, the membership surface must
+        # stay available (pre-fix this deadlocked until the child died)
+        for _ in range(5):
+            assert ln.poll_processes() == [None]
+            assert ln.active_indices() == [0]
+        ln.retire_instance(0, drain=False)
+        assert done.wait(10.0), "wait() never returned after the kill"
+        assert codes and codes[0][0] is not None

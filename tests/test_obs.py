@@ -1257,3 +1257,42 @@ def test_producer_kill_breach_dumps_flight_bundle_healthz_503(tmp_path):
         metrics.disable_span_events()
         metrics.reset()
         lineage.reset()
+
+
+# -- BJX117 regression: watchdog breach state is lock-consistent --------------
+
+
+def test_watchdog_state_is_safe_against_concurrent_evaluate():
+    """The /healthz reader races the reporter thread's evaluate():
+    before SloWatchdog grew its RLock, `sorted(self._breached)` could
+    throw 'set changed size during iteration' mid-breach-transition."""
+    import threading
+
+    from blendjax.obs.watchdog import SloWatchdog
+
+    wd = SloWatchdog(["gauge(x) <= 0.5"])
+    stop = threading.Event()
+    errors = []
+
+    def hammer():
+        try:
+            i = 0
+            while not stop.is_set():
+                # alternate breach on/off so the _breached set churns
+                wd.evaluate({"gauges": {"x": float(i % 2)}}, now=float(i))
+                i += 1
+        except BaseException as e:
+            errors.append(e)
+
+    t = threading.Thread(target=hammer)
+    t.start()
+    try:
+        for _ in range(3000):
+            s = wd.state()
+            assert isinstance(s["breached"], list)
+            assert s["healthy"] == (not s["breached"])
+            wd.healthy  # the property the fleet controller polls
+    finally:
+        stop.set()
+        t.join(5.0)
+    assert not errors, errors

@@ -550,3 +550,70 @@ def test_fleet_controller_scales_on_rl_verdicts():
     # rl-balanced resets streaks
     ctrl.tick("rl-balanced", now=t + 4)
     assert ctrl._up_streak == 0 and ctrl._down_streak == 0
+
+
+# -- BJX117 regression: every reservoir entry point holds `lock` -------------
+
+
+class CountingLock:
+    """Context-manager probe standing in for the reservoir RLock."""
+
+    def __init__(self):
+        self.inner = __import__("threading").RLock()
+        self.entries = 0
+
+    def __enter__(self):
+        self.entries += 1
+        return self.inner.__enter__()
+
+    def __exit__(self, *exc):
+        return self.inner.__exit__(*exc)
+
+
+def _filled_reservoir(**kw):
+    res = TrajectoryReservoir(8, **kw)
+    res.insert({
+        "obs": np.zeros((4, 3), np.float32),
+        "reward": np.ones(4, np.float32),
+    })
+    return res
+
+
+def test_reservoir_stats_and_fields_take_the_lock():
+    """PR 11's snapshot-vs-draw race class, pinned: the observability
+    reads share the insert/draw critical section (BJX117 flags any
+    regression statically; this is the runtime half)."""
+    res = _filled_reservoir()
+    probe = CountingLock()
+    res.lock = probe
+    assert res.stats["inserts"] == 4
+    assert probe.entries == 1
+    assert len(res.fields) == 2
+    assert probe.entries == 2
+
+
+def test_reservoir_empty_checks_run_under_the_lock():
+    """draw_token/sample raise the empty-reservoir error from INSIDE
+    the critical section (the pre-lock check read `_buffers` unlocked)."""
+    res = TrajectoryReservoir(4)
+    probe = CountingLock()
+    res.lock = probe
+    with pytest.raises(RuntimeError, match="insert"):
+        res.draw_token(np.zeros(2, np.int32))
+    with pytest.raises(RuntimeError, match="insert"):
+        res.sample(np.zeros(2, np.int32))
+    assert probe.entries == 2
+
+
+def test_actor_stats_and_restore_share_the_accounting_cut():
+    res = _filled_reservoir()
+    probe = CountingLock()
+    res.lock = probe
+    pool = ActorPool(FakeVecEnv(), res, HostQPolicy(2))
+    before = probe.entries
+    assert pool.stats["env_steps"] == 0
+    assert probe.entries == before + 1
+    pool.load_state_dict({"env_steps": 7, "episodes": 1,
+                          "episode_returns": [[7, 1.5]]})
+    assert probe.entries == before + 2
+    assert pool.stats["env_steps"] == 7

@@ -449,26 +449,68 @@ def test_decode_ahead_never_over_receives_past_max_items():
     from blendjax.data.stream import RemoteStream
     from blendjax.utils.metrics import metrics as reg
 
+    import zmq
+
     reg.reset()
     pub = DataPublisherSocket(
         "tcp://127.0.0.1:*", btid=0, send_hwm=64, compress_level=6,
         compress_min_bytes=1024,
     )
+    # Bounded sends: once the consumer takes its max_items and closes,
+    # the PUSH socket re-enters mute state and an untimed send of the
+    # surplus tail would wedge this feeder FOREVER (the BJX119 hazard,
+    # in a test) — t.join() then hung the whole suite on slow boxes.
+    pub.sock.setsockopt(zmq.SNDTIMEO, 2000)
     ramp = np.tile(np.arange(64, dtype=np.uint8), 1024)
     n = 5
+
+    def feed():
+        for i in range(n + 3):
+            try:
+                pub.publish(image=ramp, frameid=i)
+            except zmq.Again:
+                return  # consumer gone: the surplus tail is moot
+
     stream = RemoteStream([pub.addr], timeoutms=8000, max_items=n)
     pool = ThreadPoolExecutor(2)
     stream.set_inflate_pool(pool)
-    t = threading.Thread(
-        target=lambda: [
-            pub.publish(image=ramp, frameid=i) for i in range(n + 3)
-        ]
-    )
+    t = threading.Thread(target=feed)
     t.start()
     got = list(stream)
-    t.join()
+    t.join(timeout=10.0)
+    assert not t.is_alive(), "feeder wedged in a mute-state send"
     assert [int(m["frameid"]) for m in got] == list(range(n))
     counters = reg.report()["counters"]
     assert counters.get("wire.pool_decodes", 0) == n, counters
     pool.shutdown()
     pub.close()
+
+
+def test_inflate_pool_teardown_is_single_sided_after_stop():
+    """PR 13 follow-up, pinned by BJX117: the stop()-vs-last-worker
+    pool swap now runs under _active_lock on BOTH sides — whichever
+    side wins, exactly one shutdown happens, the handle is gone, and a
+    second stop() stays a no-op."""
+
+    class HookableEmpty:
+        """Minimal shard stream: accepts the shared pool, yields
+        nothing (so the last worker's teardown arm runs too)."""
+
+        def __init__(self):
+            self.pool = None
+
+        def set_inflate_pool(self, pool):
+            self.pool = pool
+
+        def __iter__(self):
+            return iter([])
+
+    streams = [HookableEmpty(), HookableEmpty()]
+    ingest = ShardedHostIngest(streams, batch_size=2, inflate_workers=2)
+    ingest.start()
+    assert streams[0].pool is not None  # the pool really was built
+    list(ingest)  # drain to _DONE: the last worker tears down its side
+    ingest.stop()
+    assert ingest._inflate_pool is None
+    ingest.stop()  # idempotent second teardown
+    assert ingest._inflate_pool is None

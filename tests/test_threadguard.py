@@ -1,0 +1,223 @@
+"""threadguard (blendjax.testing.threadguard) tests: affinity and
+lock-discipline violations raise at the access site, sanctioned paths
+stay silent, and the disabled production indirection
+(blendjax.utils.tg) is a true zero-overhead identity."""
+
+import os
+import subprocess
+import sys
+import threading
+
+import pytest
+
+from blendjax.testing.threadguard import (
+    LockDisciplineError,
+    ThreadAffinityError,
+    ThreadGuardError,
+    guard,
+    unguard,
+)
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+class Box:
+    def __init__(self):
+        self.value = 0
+
+    def bump(self):
+        self.value += 1
+        return self.value
+
+
+def run_in_thread(fn):
+    """Run fn on a fresh thread; return its result or raise its error."""
+    out: dict = {}
+
+    def wrapper():
+        try:
+            out["result"] = fn()
+        except BaseException as e:  # re-raised on the caller's thread
+            out["error"] = e
+
+    t = threading.Thread(target=wrapper)
+    t.start()
+    t.join(5.0)
+    assert not t.is_alive()
+    if "error" in out:
+        raise out["error"]
+    return out.get("result")
+
+
+# -- affinity ----------------------------------------------------------------
+
+
+def test_creator_affinity_allows_creator_and_rejects_others():
+    g = guard(Box(), name="box", affinity="creator")
+    assert g.bump() == 1  # creating thread: fine
+    with pytest.raises(ThreadAffinityError) as e:
+        run_in_thread(g.bump)
+    assert "box.bump" in str(e.value)
+    assert threading.current_thread().name in str(e.value)
+
+
+def test_first_use_affinity_binds_to_the_first_toucher():
+    g = guard(Box(), name="box", affinity="first-use")
+    assert run_in_thread(lambda: g.bump()) == 1  # the binder
+    with pytest.raises(ThreadAffinityError):
+        g.bump()  # main thread is now the intruder
+
+
+def test_affinity_error_is_a_threadguard_and_assertion_error():
+    g = guard(Box(), affinity="creator")
+    try:
+        run_in_thread(g.bump)
+    except ThreadGuardError as e:
+        assert isinstance(e, AssertionError)
+    else:
+        pytest.fail("expected ThreadAffinityError")
+
+
+# -- lock discipline ---------------------------------------------------------
+
+
+def test_lock_mode_requires_holding_an_rlock():
+    lock = threading.RLock()
+    g = guard(Box(), name="box", lock=lock)
+    with pytest.raises(LockDisciplineError) as e:
+        g.bump()
+    assert "box.bump" in str(e.value)
+    with lock:
+        assert g.bump() == 1
+
+
+def test_rlock_ownership_is_exact_not_merely_locked():
+    """Another thread holding the RLock must NOT satisfy the check."""
+    lock = threading.RLock()
+    g = guard(Box(), lock=lock)
+    acquired = threading.Event()
+    release = threading.Event()
+
+    def holder():
+        with lock:
+            acquired.set()
+            release.wait(5.0)
+
+    t = threading.Thread(target=holder)
+    t.start()
+    assert acquired.wait(5.0)
+    try:
+        with pytest.raises(LockDisciplineError):
+            g.bump()
+    finally:
+        release.set()
+        t.join(5.0)
+
+
+def test_plain_lock_degrades_to_locked_check():
+    lock = threading.Lock()
+    g = guard(Box(), lock=lock)
+    with pytest.raises(LockDisciplineError):
+        g.bump()
+    with lock:
+        assert g.bump() == 1
+
+
+def test_container_dunders_are_checked():
+    lock = threading.RLock()
+    g = guard({}, name="table", lock=lock)
+    with pytest.raises(LockDisciplineError):
+        g["k"] = 1
+    with lock:
+        g["k"] = 1
+        assert g["k"] == 1
+        assert "k" in g and len(g) == 1 and list(g) == ["k"]
+    with pytest.raises(LockDisciplineError):
+        len(g)
+
+
+def test_exempt_attributes_skip_the_checks():
+    lock = threading.RLock()
+    box = Box()
+    box.lock = lock
+    g = guard(box, lock=lock, exempt=("lock",))
+    assert g.lock is lock  # fetchable BEFORE holding it
+    with pytest.raises(LockDisciplineError):
+        g.bump()
+    with g.lock:
+        assert g.bump() == 1
+
+
+# -- mechanics ----------------------------------------------------------------
+
+
+def test_guard_is_idempotent_and_unguard_returns_the_raw_object():
+    box = Box()
+    g = guard(box, affinity="creator")
+    assert guard(g, affinity="creator") is g
+    assert unguard(g) is box
+    assert unguard(box) is box
+
+
+def test_guard_requires_a_discipline():
+    with pytest.raises(ValueError):
+        guard(Box())
+    with pytest.raises(ValueError):
+        guard(Box(), affinity="psychic")
+
+
+# -- the production indirection (blendjax.utils.tg) ---------------------------
+
+
+def _tg_probe(env_value):
+    """Import blendjax.utils.tg in a fresh interpreter and report
+    whether guard() is the identity."""
+    env = {k: v for k, v in os.environ.items() if k != "BLENDJAX_THREADGUARD"}
+    if env_value is not None:
+        env["BLENDJAX_THREADGUARD"] = env_value
+    env["PYTHONPATH"] = REPO_ROOT
+    code = (
+        "from blendjax.utils.tg import guard\n"
+        "import threading\n"
+        "o = object()\n"
+        "print(guard(o, name='x', lock=threading.Lock()) is o)\n"
+    )
+    r = subprocess.run(
+        [sys.executable, "-c", code], capture_output=True, text=True, env=env
+    )
+    assert r.returncode == 0, r.stderr
+    return r.stdout.strip()
+
+
+def test_tg_guard_is_identity_when_disabled():
+    """The zero-overhead contract: no proxy, no per-access cost, no
+    threadguard import on any hot path unless the env opts in."""
+    assert _tg_probe(None) == "True"
+    assert _tg_probe("0") == "True"
+
+
+def test_tg_guard_wraps_when_enabled():
+    assert _tg_probe("1") == "False"
+
+
+def test_enabled_env_turns_metrics_lock_discipline_on():
+    """End to end through the wiring: an unlocked counter-table write
+    inside a guarded registry raises; the public API stays fine."""
+    env = {**os.environ, "BLENDJAX_THREADGUARD": "1",
+           "PYTHONPATH": REPO_ROOT}
+    code = (
+        "from blendjax.utils.metrics import Metrics\n"
+        "from blendjax.testing.threadguard import LockDisciplineError\n"
+        "m = Metrics()\n"
+        "m.count('ok')                  # locked path: fine\n"
+        "assert m.counter_value('ok') == 1\n"
+        "try:\n"
+        "    m.counters['raw'] = 1      # unlocked mutation: must raise\n"
+        "except LockDisciplineError:\n"
+        "    print('raised')\n"
+    )
+    r = subprocess.run(
+        [sys.executable, "-c", code], capture_output=True, text=True, env=env
+    )
+    assert r.returncode == 0, r.stderr
+    assert r.stdout.strip() == "raised"
