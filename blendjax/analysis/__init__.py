@@ -23,12 +23,21 @@ Rules (see ``docs/static-analysis.md``):
 
 Per-file rules run up through ``BJX116``; the default run ALSO builds
 one whole-program :class:`~blendjax.analysis.project.ProjectContext`
-(shared AST cache, thread-spawn graph, locksets) for the concurrency
-rules — ``BJX117`` unlocked-shared-mutation (the Eraser lockset
-intersection), ``BJX118`` lock-order-inversion, and ``BJX119``
-blocking-call-under-lock. ``--no-project`` skips that pass (the
+(shared AST cache, thread-spawn graph, locksets, and the
+value-provenance dataflow layer) for the project rules — ``BJX117``
+unlocked-shared-mutation (the Eraser lockset intersection),
+``BJX118`` lock-order-inversion, ``BJX119``
+blocking-call-under-lock, and the jit-boundary dataflow rules:
+``BJX120`` stamp-leak-into-jit, ``BJX121`` use-after-donate, and
+``BJX122`` retrace-risk. ``--no-project`` skips that pass (the
 producer-side quick path). The runtime complement is
 :mod:`blendjax.testing.threadguard` (``BLENDJAX_THREADGUARD=1``).
+
+Two flag-gated passes ride the same parse: ``--contracts`` (the
+``BJX123`` contract-drift gate — metric names, wire stamp keys, and
+``BLENDJAX_*`` env knobs cross-checked against ``docs/``) and
+``--strict-suppressions`` (``BJX124`` — every suppression marker must
+carry its justification).
 
 Suppress one finding with an inline ``# bjx: ignore[BJX101]`` (or a
 bare ``# bjx: ignore`` for all rules); grandfather existing findings
@@ -49,11 +58,13 @@ from blendjax.analysis.core import (
     analyze_paths,
     analyze_project_modules,
     analyze_source,
+    check_suppression_hygiene,
     load_baseline,
     parse_paths,
     register,
     write_baseline,
 )
+from blendjax.analysis.contracts import check_contracts
 
 __all__ = [
     "Finding",
@@ -65,6 +76,8 @@ __all__ = [
     "analyze_paths",
     "analyze_project_modules",
     "analyze_source",
+    "check_contracts",
+    "check_suppression_hygiene",
     "load_baseline",
     "parse_paths",
     "register",

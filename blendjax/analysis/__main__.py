@@ -2,11 +2,20 @@
 
 Exit status: 0 when every finding is inline-suppressed or baselined,
 1 when unsuppressed findings remain, 2 on usage errors, 3 when
-``--project`` (the default) is requested but a module failed to parse
-(the whole-program pass needs every module — fix the syntax error or
-rerun with ``--no-project``), 4 when ``--max-seconds`` is set and the
-run overshot it (the CI wall-time budget). Runs with no third-party
+``--project`` (the default) or ``--contracts`` needs every module
+parsed but one failed (fix the syntax error or rerun with
+``--no-project``), 4 when ``--max-seconds`` is set and the run
+overshot it (the CI wall-time budget). Runs with no third-party
 imports so it works offline and inside Blender's Python.
+
+Modes beyond the lint rules:
+
+- ``--contracts`` runs the contract-drift gate (BJX123) instead of
+  the rules: metric names, wire stamp keys, and ``BLENDJAX_*`` env
+  knobs extracted from code, cross-checked against ``docs/``.
+- ``--strict-suppressions`` adds the suppression-hygiene audit
+  (BJX124): every ``# bjx: ignore[...]`` must say why. On in CI.
+- ``--format sarif`` emits SARIF 2.1.0 for code-scanning upload.
 """
 
 from __future__ import annotations
@@ -17,16 +26,83 @@ import os
 import sys
 import time
 
+from blendjax.analysis.contracts import check_contracts
 from blendjax.analysis.core import (
     BASELINE_DEFAULT,
+    Finding,
     all_rules,
     analyze_modules,
     analyze_project_modules,
     apply_baseline,
+    check_suppression_hygiene,
     load_baseline,
     parse_paths,
     write_baseline,
 )
+
+# One-line descriptions for the flag-gated passes that are not in the
+# rule registry (SARIF requires a description per reported ruleId).
+_EXTRA_RULE_DESCRIPTIONS = {
+    "BJX123": "contract drift between code catalogs and docs/",
+    "BJX124": "suppression marker without a justification",
+}
+
+
+def render_sarif(findings: list[Finding]) -> str:
+    """Minimal SARIF 2.1.0 document: one run, one result per finding,
+    with the baseline-v2 identity carried as a partial fingerprint so
+    code-scanning dedupe survives line shifts the same way the
+    baseline does."""
+    known = all_rules()
+    rules = []
+    for rule_id in sorted({f.rule for f in findings}):
+        rule = known.get(rule_id)
+        description = (
+            rule.description
+            if rule is not None
+            else _EXTRA_RULE_DESCRIPTIONS.get(rule_id, rule_id)
+        )
+        rules.append(
+            {"id": rule_id, "shortDescription": {"text": description}}
+        )
+    results = []
+    for f in findings:
+        result = {
+            "ruleId": f.rule,
+            "level": "warning",
+            "message": {"text": f.message},
+            "locations": [
+                {
+                    "physicalLocation": {
+                        "artifactLocation": {"uri": f.path},
+                        "region": {
+                            "startLine": f.line,
+                            "startColumn": f.col + 1,
+                        },
+                    }
+                }
+            ],
+        }
+        if f.identity:
+            result["partialFingerprints"] = {"bjxIdentity/v2": f.identity}
+        results.append(result)
+    doc = {
+        "$schema": "https://json.schemastore.org/sarif-2.1.0.json",
+        "version": "2.1.0",
+        "runs": [
+            {
+                "tool": {
+                    "driver": {
+                        "name": "bjx-lint",
+                        "informationUri": "docs/static-analysis.md",
+                        "rules": rules,
+                    }
+                },
+                "results": results,
+            }
+        ],
+    }
+    return json.dumps(doc, indent=2)
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -35,8 +111,9 @@ def build_parser() -> argparse.ArgumentParser:
         description="bjx-lint: JAX/ZMQ invariant checks for blendjax",
     )
     parser.add_argument(
-        "paths", nargs="*", default=["blendjax"],
-        help="files or directories to analyze (default: blendjax)",
+        "paths", nargs="*", default=None,
+        help="files or directories to analyze (default: blendjax; "
+        "with --contracts: blendjax plus bench.py)",
     )
     parser.add_argument(
         "--select", default=None,
@@ -66,7 +143,18 @@ def build_parser() -> argparse.ArgumentParser:
         "wall-time budget (the CI lint-latency gate)",
     )
     parser.add_argument(
-        "--format", choices=("text", "json"), default="text",
+        "--contracts", action="store_true",
+        help="run the contract-drift gate instead of the lint rules: "
+        "cross-check metric names, wire stamp keys, and BLENDJAX_* "
+        "env knobs against docs/ (exit 1 on drift)",
+    )
+    parser.add_argument(
+        "--strict-suppressions", action="store_true",
+        help="require a justification on every '# bjx: ignore[...]' "
+        "marker — same line or the comment line above (on in CI)",
+    )
+    parser.add_argument(
+        "--format", choices=("text", "json", "sarif"), default="text",
     )
     parser.add_argument(
         "--list-rules", action="store_true", help="print rule ids and exit",
@@ -89,14 +177,43 @@ def main(argv: list[str] | None = None) -> int:
         if unknown:
             print(f"unknown rule ids: {sorted(unknown)}", file=sys.stderr)
             return 2
-    missing = [p for p in args.paths if not os.path.exists(p)]
+    paths = args.paths
+    if not paths:
+        # The contracts gate audits bench.py's env knobs too — it is
+        # the repo's biggest knob surface and lives outside the
+        # package tree.
+        paths = ["blendjax"]
+        if args.contracts and os.path.exists("bench.py"):
+            paths.append("bench.py")
+    missing = [p for p in paths if not os.path.exists(p)]
     if missing:
         print(f"no such path: {missing}", file=sys.stderr)
         return 2
 
     t0 = time.perf_counter()
     root = os.getcwd()
-    modules, errors = parse_paths(args.paths, root=root)
+    modules, errors = parse_paths(paths, root=root)
+
+    if args.contracts:
+        if errors:
+            for f in errors:
+                print(f.render(), file=sys.stderr)
+            print(
+                f"--contracts needs every module parsed; {len(errors)} "
+                "file(s) failed (see above) — the catalogs would be "
+                "extracted from a partial project.",
+                file=sys.stderr,
+            )
+            return 3
+        findings = check_contracts(modules, root)
+        findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+        _emit(findings, args.format, footer=(
+            "contract drift: update the docs table or the code "
+            "catalog (see docs/static-analysis.md, 'Contract-drift "
+            "gate')."
+        ))
+        return _budget_exit(args, t0, bool(findings))
+
     findings = errors + analyze_modules(modules, select=select)
     if args.project:
         if errors:
@@ -113,6 +230,8 @@ def main(argv: list[str] | None = None) -> int:
             )
             return 3
         findings.extend(analyze_project_modules(modules, select=select))
+    if args.strict_suppressions:
+        findings.extend(check_suppression_hygiene(modules))
     findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
 
     if args.write_baseline:
@@ -124,17 +243,26 @@ def main(argv: list[str] | None = None) -> int:
             findings, load_baseline(args.baseline), root
         )
 
-    if args.format == "json":
+    _emit(findings, args.format, footer=(
+        "Suppress one site with '# bjx: ignore[RULE]' or grandfather "
+        "all with --write-baseline (see docs/static-analysis.md)."
+    ))
+    return _budget_exit(args, t0, bool(findings))
+
+
+def _emit(findings: list[Finding], fmt: str, footer: str) -> None:
+    if fmt == "json":
         print(json.dumps([f.__dict__ for f in findings], indent=2))
+    elif fmt == "sarif":
+        print(render_sarif(findings))
     else:
         for f in findings:
             print(f.render())
         if findings:
-            print(
-                f"\n{len(findings)} finding(s). Suppress one site with "
-                "'# bjx: ignore[RULE]' or grandfather all with "
-                "--write-baseline (see docs/static-analysis.md)."
-            )
+            print(f"\n{len(findings)} finding(s). {footer}")
+
+
+def _budget_exit(args, t0: float, found: bool) -> int:
     elapsed = time.perf_counter() - t0
     if args.max_seconds is not None and elapsed > args.max_seconds:
         print(
@@ -143,7 +271,7 @@ def main(argv: list[str] | None = None) -> int:
             file=sys.stderr,
         )
         return 4
-    return 1 if findings else 0
+    return 1 if found else 0
 
 
 if __name__ == "__main__":

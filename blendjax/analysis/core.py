@@ -349,6 +349,66 @@ def analyze_project_modules(
     return findings
 
 
+SUPPRESSION_HYGIENE_RULE = "BJX124"
+
+
+def check_suppression_hygiene(
+    modules: Iterable["ModuleContext"],
+) -> list[Finding]:
+    """``--strict-suppressions``: every real ``# bjx: ignore[...]``
+    comment must say WHY — trailing text after the marker on the same
+    line, or a non-empty comment on the line directly above. A bare
+    suppression is a permanent mystery to the next reader; the
+    justification is what separates a sanctioned shape from a silenced
+    rule. Markers inside string literals (rule messages, docstrings)
+    are comments ABOUT suppressions, not suppressions — the audit
+    walks real COMMENT tokens, not raw lines."""
+    import io
+    import tokenize
+
+    findings: list[Finding] = []
+    for module in modules:
+        try:
+            tokens = list(
+                tokenize.generate_tokens(io.StringIO(module.source).readline)
+            )
+        except (tokenize.TokenError, IndentationError):  # pragma: no cover
+            continue  # module parsed, so this never fires in practice
+        for tok in tokens:
+            if tok.type != tokenize.COMMENT:
+                continue
+            m = _SUPPRESS_RE.search(tok.string)
+            if m is None:
+                continue
+            if re.search(r"\w", tok.string[m.end():]):
+                continue  # justified inline, after the marker
+            line = tok.start[0]
+            above = module.lines[line - 2].strip() if line >= 2 else ""
+            if (
+                above.startswith("#")
+                and not _SUPPRESS_RE.search(above)
+                and re.search(r"\w", above.lstrip("#"))
+            ):
+                continue  # justified by the comment line above
+            findings.append(
+                Finding(
+                    SUPPRESSION_HYGIENE_RULE,
+                    module.relpath,
+                    line,
+                    tok.start[1] + m.start(),
+                    "suppression without a justification — say why "
+                    "after the marker on the same line or on the "
+                    "comment line above",
+                    identity=(
+                        f"suppression:{module.relpath}:"
+                        f"{' '.join(tok.string.split())}"
+                    ),
+                )
+            )
+    findings.sort(key=lambda f: (f.path, f.line, f.col))
+    return findings
+
+
 def analyze_source(
     source: str,
     relpath: str,

@@ -17,7 +17,10 @@ from blendjax.analysis.rules import (  # noqa: F401  (registration side effects)
     purity,
     reservoir_sync,
     resource_leak,
+    retrace_risk,
     scenario_ids,
+    stamp_leak,
+    use_after_donate,
     wall_clock,
     zmq_affinity,
 )

@@ -1,0 +1,80 @@
+"""BJX122 retrace-risk: a jit call site whose static argument or dict
+key set derives from per-message data — an unbounded jit cache.
+
+``jax.jit`` compiles once per distinct (static argument values, arg
+tree structure) signature. The repo's contract for data-dependent
+shapes is the bucket ladder: per-message sizes pass through
+``pad_to_bucket``/the decode-plan capacity idiom, so the set of
+distinct signatures is small and fixed. A static argument fed straight
+from a message (``fn(x, n=batch["img"].shape[0])``), or a batch dict
+whose KEY SET was extended under a per-message-derived key before
+crossing the boundary, recompiles per distinct value — silent, each
+compile seconds long, cache growth unbounded.
+
+Heuristics (all local to the calling function, by design — this is a
+call-site property): an expression is *per-message-derived* when it
+reads a parameter with a batch-ish name (``batch``/``msg``/``item``/
+``frame``/``sample``/``row``...) or a local assigned from one;
+derivation is laundered (bounded) by passing through a call whose name
+carries a ``bucket``/``plan``/``pad``/``cap``/``quant`` segment. Only
+resolvable jit wrappings with declared ``static_argnums``/
+``static_argnames`` are checked for the static variant; ANY resolvable
+jit is checked for the dynamic-key-set variant.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator
+
+from blendjax.analysis.core import Finding, ProjectRule, register
+from blendjax.analysis.project import ProjectContext
+
+
+@register
+class RetraceRiskRule(ProjectRule):
+    id = "BJX122"
+    name = "retrace-risk"
+    description = (
+        "a static argument (or dict key set) at a jit call site "
+        "derives from per-message/per-batch data without passing "
+        "through the pad_to_bucket/decode-plan ladder"
+    )
+
+    def check_project(self, project: ProjectContext) -> Iterator[Finding]:
+        df = project.dataflow()
+        for nid in sorted(df.ir):
+            ir = df.ir[nid]
+            if not ir.retraces:
+                continue
+            module = project.by_path[nid[0]]
+            seen: set[tuple[int, str]] = set()
+            for ev in ir.retraces:
+                dedup = (id(ev.node), ev.arg_desc)
+                if dedup in seen:
+                    continue
+                seen.add(dedup)
+                identity = (
+                    f"{module.modname}.{nid[1]}:{ev.jit_desc}:{ev.arg_desc}"
+                )
+                if ev.keyset:
+                    detail = (
+                        f"dict '{ev.arg_desc}' gained a key derived from "
+                        "per-message data before reaching "
+                        f"{ev.jit_desc} — every distinct key set is a "
+                        "fresh compilation"
+                    )
+                else:
+                    detail = (
+                        f"static argument '{ev.arg_desc}' of "
+                        f"{ev.jit_desc} derives from per-message data — "
+                        "every distinct value is a fresh compilation"
+                    )
+                yield self.finding(
+                    module,
+                    ev.node,
+                    f"retrace risk in '{nid[1]}': {detail}; bound it "
+                    "through the bucket ladder (pad_to_bucket / the "
+                    "decode-plan capacity idiom) or hoist it to config, "
+                    "or justify with '# bjx: ignore[BJX122]'",
+                    identity=identity,
+                )

@@ -1,0 +1,80 @@
+"""BJX120 stamp-leak-into-jit: a batch dict still carrying host-side
+sidecar keys reaches a jit-compiled callable's arguments.
+
+The bug class this pins has bitten twice: the ``_trace`` sampled-trace
+context leaked through the collate path into the train step (PR 6),
+and the ``_scenario_rows`` accounting sidecar reached a jit boundary
+through the echo sampler (PR 10, review round 4) — both crashed at
+runtime with jax's "not a valid JAX type" only AFTER a traced batch
+happened to arrive, i.e. rarely and in production. Statically, the
+shape is always the same: some frame stamps a dict (subscript store of
+an underscored key, a stamped dict literal, or a call returning a
+stamped batch), and the dict then flows — through rebinding, copies,
+helper calls — to a ``jax.jit``-wrapped callable without an
+intervening strip (``.pop``, ``del``, a filtered rebuild, or a helper
+like ``blendjax.obs.lineage.strip_stamps`` whose summary strips).
+
+The finding anchors in the frame where the taint ORIGINATES (that's
+where the fix goes), at the call that starts the leaking chain; the
+interprocedural part rides the per-function summaries of the
+:class:`~blendjax.analysis.project.Dataflow` layer, so a leak through
+one or more call hops is still one finding. Sanctioned crossings (an
+underscored key that IS an array, e.g. ``_mask``) are excluded by the
+sidecar-key universe itself; anything else suppresses inline with a
+justification.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator
+
+from blendjax.analysis.core import Finding, ProjectRule, register
+from blendjax.analysis.project import ProjectContext
+
+
+@register
+class StampLeakIntoJitRule(ProjectRule):
+    id = "BJX120"
+    name = "stamp-leak-into-jit"
+    description = (
+        "a batch dict that can carry non-array sidecar keys (_trace, "
+        "_scenario_rows, lineage stamps, ...) reaches a jit-compiled "
+        "callable's arguments without an intervening strip/pop"
+    )
+
+    def check_project(self, project: ProjectContext) -> Iterator[Finding]:
+        df = project.dataflow()
+        for nid in sorted(df.flow_results):
+            res = df.flow_results[nid]
+            if not res.leaks:
+                continue
+            module = project.by_path[nid[0]]
+            seen: set[tuple[int, frozenset[str]]] = set()
+            for leak in res.leaks:
+                dedup = (id(leak.node), leak.keys)
+                if dedup in seen:
+                    continue
+                seen.add(dedup)
+                keys = ", ".join(f"'{k}'" for k in sorted(leak.keys))
+                if leak.via is None:
+                    sink = f"jit-compiled {leak.jit_desc}"
+                else:
+                    sink = (
+                        f"'{leak.via}', which forwards it into a "
+                        "jit-compiled callable"
+                    )
+                identity = (
+                    f"{module.modname}.{nid[1]}:"
+                    f"{'+'.join(sorted(leak.keys))}->"
+                    f"{leak.via or leak.jit_desc}"
+                )
+                yield self.finding(
+                    module,
+                    leak.node,
+                    f"batch dict carrying sidecar key(s) {keys} is passed "
+                    f"to {sink} in '{nid[1]}' without an intervening "
+                    "strip — pop the sidecars (strip_stamps / pop_traces "
+                    "/ a filtered rebuild) before the jit boundary, or "
+                    "justify with '# bjx: ignore[BJX120]'",
+                    identity=identity,
+                )

@@ -1,0 +1,72 @@
+"""BJX121 use-after-donate: a buffer passed at a ``donate_argnums``
+position of a resolvable jit is read again before being rebound.
+
+The static twin of the runtime donation audit
+(:mod:`blendjax.testing.donation`) and the complement of BJX112's
+presence-only check: BJX112 forces step-like jits to DECLARE donation,
+this rule catches callers that keep using the buffers they donated.
+The PR 12 policy-sync bug is the model — a zero-copy view of the
+training state was handed to a donating fused step and then shipped to
+actors afterward, reading deallocated device memory once XLA actually
+reused the donation.
+
+Recognized donation sites are calls through a known jit wrapping
+(``jax.jit(...)`` assigned to a local/module variable or ``self``
+attribute, or a ``@jax.jit``-decorated def) whose ``donate_argnums``/
+``donate_argnames`` cover the argument. A "use" is any later read,
+return, or attribute/subscript access of the donated variable (or
+``self.x`` dotted attribute) in source order before a rebinding — plus
+the loop form: a donating call inside a loop whose donated variable is
+never rebound in the loop body reads it on the next iteration. The
+sanctioned idiom, ``state = step(state, batch)``, rebinds at the call
+statement and never flags.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator
+
+from blendjax.analysis.core import Finding, ProjectRule, register
+from blendjax.analysis.project import ProjectContext
+
+
+@register
+class UseAfterDonateRule(ProjectRule):
+    id = "BJX121"
+    name = "use-after-donate"
+    description = (
+        "a variable passed at a donate_argnums position of a jit is "
+        "read, returned, or stored after the donating call without "
+        "being rebound"
+    )
+
+    def check_project(self, project: ProjectContext) -> Iterator[Finding]:
+        df = project.dataflow()
+        for nid in sorted(df.ir):
+            ir = df.ir[nid]
+            if not ir.donate_uses:
+                continue
+            module = project.by_path[nid[0]]
+            for use in ir.donate_uses:
+                identity = f"{module.modname}.{nid[1]}:{use.var}"
+                if use.loop:
+                    detail = (
+                        f"'{use.var}' is donated to {use.jit_desc} inside "
+                        "a loop but never rebound in the loop body — the "
+                        "next iteration reads the donated buffer"
+                    )
+                else:
+                    detail = (
+                        f"'{use.var}' is read after being donated to "
+                        f"{use.jit_desc} at line "
+                        f"{getattr(use.donate_node, 'lineno', '?')}"
+                    )
+                yield self.finding(
+                    module,
+                    use.node,
+                    f"use-after-donate in '{nid[1]}': {detail}; rebind "
+                    "the variable from the step's return value (state = "
+                    "step(state, ...)) or copy before donating, or "
+                    "justify with '# bjx: ignore[BJX121]'",
+                    identity=identity,
+                )

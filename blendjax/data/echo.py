@@ -794,7 +794,7 @@ class EchoingPipeline:
             # bjx: ignore[BJX106]
             first = np.zeros(len(idx), bool)
             first[np.unique(idx, return_index=True)[1]] = True
-            # bjx: ignore[BJX106]
+            # bjx: ignore[BJX106] — host accounting; _use is host-side
             fresh_rows = first & (self._use[idx] == 0)
             fresh_n = int(fresh_rows.sum())
             if self._scen_active:

@@ -370,7 +370,13 @@ class SingleFileDataset:
         return len(self.reader)
 
     def __getitem__(self, idx):
-        return self.item_transform(self.reader[idx])
+        # Same contract as ReplayStream: recorded lineage stamps are
+        # stripped, not replayed — a map-style epoch would otherwise
+        # collate `_seq`/`_pub_wall` sidecars straight into the train
+        # batch (the BJX120 bug class).
+        from blendjax.obs.lineage import strip_stamps
+
+        return self.item_transform(strip_stamps(self.reader[idx]))
 
 
 class FileDataset:
@@ -407,7 +413,11 @@ class FileDataset:
 
         ri = bisect.bisect_right(self._cum, idx)
         base = self._cum[ri - 1] if ri else 0
-        return self.item_transform(self.readers[ri][idx - base])
+        # Stamps stripped for the same reason as ReplayStream /
+        # SingleFileDataset: replayed lineage is stale by construction.
+        from blendjax.obs.lineage import strip_stamps
+
+        return self.item_transform(strip_stamps(self.readers[ri][idx - base]))
 
     def __iter__(self):
         for i in range(len(self)):

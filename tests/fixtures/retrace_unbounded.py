@@ -1,0 +1,41 @@
+"""Deliberately-retracing fixture for BJX122: an unbounded static
+argument fed straight from per-message data, next to the sanctioned
+bucket-ladder path that must stay quiet.
+
+NOT production code — lives under ``tests/fixtures/`` so the repo
+self-run never sees it; ``tests/test_analysis.py`` asserts the
+dataflow pass flags exactly the unbounded call site end-to-end.
+
+``jax.jit`` compiles once per distinct static-argument value: feeding
+``n=batch["count"]`` recompiles per distinct count (silent, seconds
+per compile, unbounded cache). The decode-plan contract bounds it by
+quantizing through the bucket ladder first.
+
+Expected finding: BJX122 in ``feed`` at the ``decode`` call, static
+argument ``n``; ``feed_bucketed`` stays clean.
+"""
+
+import jax
+
+
+def _decode(tiles, n):
+    del n
+    return tiles
+
+
+decode = jax.jit(_decode, static_argnames=("n",))
+
+
+def pad_to_bucket(n):
+    return max(64, 1 << int(n).bit_length())
+
+
+def feed(batch):
+    # BJX122: the static arg derives from the message itself
+    return decode(batch["tiles"], n=int(batch["count"]))
+
+
+def feed_bucketed(batch):
+    # sanctioned: quantized through the bucket ladder first
+    n = pad_to_bucket(int(batch["count"]))
+    return decode(batch["tiles"], n=n)

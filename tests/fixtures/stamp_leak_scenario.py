@@ -1,0 +1,33 @@
+"""Deliberately-leaky fixture for BJX120: the PR-10 (review round 4)
+`_scenario_rows`-to-jit regression, reproduced shape-for-shape.
+
+NOT production code — lives under ``tests/fixtures/`` so the repo
+self-run never sees it; ``tests/test_analysis.py`` asserts the
+dataflow pass flags it end-to-end.
+
+The historical shape: the echo sampler stamps the per-scenario
+accounting sidecar (``batch["_scenario_rows"] = rows``) directly onto
+the draw it is about to dispatch, and the stamped dict goes straight
+into the reservoir's jitted gather+augment — a direct (zero-hop)
+leak, the complement of the collate shape in
+``stamp_leak_trace.py``.
+
+Expected finding: BJX120 in ``EchoSampler.draw`` at the
+``self._draw_fn`` call, keys ``_scenario_rows``.
+"""
+
+import jax
+
+
+def _gather_augment(batch):
+    return batch
+
+
+class EchoSampler:
+    def __init__(self):
+        self._draw_fn = jax.jit(_gather_augment)
+
+    def draw(self, batch, rows):
+        # per-scenario accounting sidecar, stamped on the live draw
+        batch["_scenario_rows"] = rows
+        return self._draw_fn(batch)  # BJX120: sidecar crosses the jit

@@ -1,0 +1,47 @@
+"""Deliberately-leaky fixture for BJX120: the PR-6 `_trace`-to-collate
+regression, reproduced shape-for-shape.
+
+NOT production code — this module exists so ``tests/test_analysis.py``
+can assert the jit-boundary dataflow pass flags the historical bug
+end-to-end through ``analyze_paths(project=True)`` and the CLI. It
+lives under ``tests/fixtures/`` so the repo self-run (which scans
+``blendjax/``) never sees it.
+
+The historical shape: a producer stamps the sampled frame-trace
+context onto a message (``msg["_trace"] = ...``); the collate helper
+merges fields into a batch but forgets the sidecar; the stamped batch
+reaches the donating train-step jit and crashes with "not a valid JAX
+type" — only when a *sampled* frame happens to arrive, i.e. rarely.
+
+Expected finding: BJX120 in ``feed`` at the ``train_step`` call,
+keys ``_trace`` — anchored where the tainted dict crosses the jit
+boundary, two call hops after the stamp.
+"""
+
+import functools
+
+import jax
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def train_step(state, batch):
+    del batch
+    return state
+
+
+def stamp(msg):
+    """Producer side: the sampled-trace context rides the message."""
+    msg["_trace"] = {"start": 0.0, "spans": []}
+    return msg
+
+
+def collate(batch):
+    """The collate path: rebuilds the dict but keeps every key —
+    including the sidecar it should have popped."""
+    return dict(batch)
+
+
+def feed(state, raw):
+    msg = stamp(raw)
+    batch = collate(msg)
+    return train_step(state, batch)  # BJX120: '_trace' reaches the jit

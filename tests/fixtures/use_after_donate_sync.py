@@ -1,0 +1,43 @@
+"""Deliberately-broken fixture for BJX121: the PR-12 policy-sync bug,
+reproduced shape-for-shape.
+
+NOT production code — lives under ``tests/fixtures/`` so the repo
+self-run never sees it; ``tests/test_analysis.py`` asserts the
+dataflow pass flags it end-to-end.
+
+The historical shape: the learner hands the training state to a
+donating fused step, then ships the SAME (now-donated) state object to
+the actors — a zero-copy view of deallocated device memory once XLA
+actually reuses the donation. The fix was to publish ``new_state``;
+the sanctioned idiom ``state = step(state, batch)`` (see
+``clean_update``) rebinds at the call statement and never flags.
+
+Expected finding: BJX121 in ``Learner.update`` at the
+``self.publish(state)`` read, variable ``state``.
+"""
+
+import jax
+
+
+def _fused(state, batch):
+    del batch
+    return state
+
+
+class Learner:
+    def __init__(self):
+        self._step = jax.jit(_fused, donate_argnums=(0,))
+
+    def publish(self, state):
+        del state
+
+    def update(self, state, batch):
+        new_state = self._step(state, batch)
+        self.publish(state)  # BJX121: reads the donated buffer
+        return new_state
+
+    def clean_update(self, state, batch):
+        # sanctioned: rebinds from the step's return at the donating call
+        state = self._step(state, batch)
+        self.publish(state)
+        return state

@@ -200,6 +200,33 @@ def test_file_dataset_glob_concat(tmp_path):
         FileDataset(str(tmp_path / "nope"))
 
 
+def test_map_datasets_strip_recorded_lineage_stamps(tmp_path):
+    """BJX120 regression: map-style replay returns items WITHOUT the
+    recorded transport stamps (`_seq`/`_pub_wall`/...). A recording made
+    off a live wire carries them, and collating them into a train batch
+    is exactly the stamp-leak-into-jit bug class — the datasets strip
+    like ReplayStream does, while the raw FileReader stays verbatim.
+    The content stamp (`_scenario`) survives: it must re-account
+    deterministically on replay."""
+    prefix = str(tmp_path / "run")
+    with FileRecorder(FileRecorder.filename(prefix, 0)) as rec:
+        for i in range(3):
+            m = _item(i)
+            m["_seq"] = i
+            m["_pub_wall"] = 1e9 + i
+            m["_pub_mono"] = float(i)
+            m["_scenario"] = {"sid": "a", "weight": 1.0}
+            rec.save(encode_message(m))
+    path = FileRecorder.filename(prefix, 0)
+    raw = FileReader(path)[1]
+    assert raw["_seq"] == 1  # the reader is the raw-access layer
+    for ds in (SingleFileDataset(path), FileDataset(prefix)):
+        item = ds[1]
+        assert item["frameid"] == 1
+        assert not {"_seq", "_pub_wall", "_pub_mono"} & set(item)
+        assert item["_scenario"]["sid"] == "a"
+
+
 # -- live stream ------------------------------------------------------------
 
 
