@@ -164,6 +164,18 @@ SCENARIO_MIN_STEPS = int(
 LIVE_RESUME = os.environ.get("BLENDJAX_BENCH_LIVE_RESUME", "1") == "1"
 RESUME_STEPS = int(os.environ.get("BLENDJAX_BENCH_RESUME_STEPS", "16"))
 RESUME_DIR = os.environ.get("BLENDJAX_BENCH_RESUME_DIR", "")
+# Instant-start row (docs/performance.md "Instant start"): three fresh
+# child processes over loopback. Legs 1+2 run the ndz wire sharing one
+# persistent compilation cache dir — leg 1 is the cold trace+compile,
+# leg 2 must come up warm (manifest all hits, compile_ms strictly below
+# cold by the CI-pinned ratio). Leg 3 runs the SAME deterministic
+# stream through the shared-memory ring (zero-copy local transport):
+# CI asserts its f32 loss vector identical to the ndz leg's, zero seq
+# gaps and zero torn slots on the clean run, one dispatch per step,
+# and shm throughput at least matching the compressed wire. Pure
+# CPU/loopback — weather-independent.
+LIVE_START = os.environ.get("BLENDJAX_BENCH_LIVE_START", "1") == "1"
+START_STEPS = int(os.environ.get("BLENDJAX_BENCH_START_STEPS", "12"))
 # RL actor-learner row (docs/rl.md): cartpole trained END TO END by
 # blendjax.rl — remote producer envs under an ActorPool, a
 # TrajectoryReservoir, and the one-dispatch DQN learner — as a
@@ -2242,6 +2254,7 @@ def _live_resume_child_main() -> int:
     )
     from blendjax.utils.metrics import metrics as reg
 
+    t_build = time.monotonic()
     mgr = SnapshotManager(args.directory, keep=3)
     state = make_train_state(
         CubeRegressor(features=(8,)),
@@ -2267,6 +2280,10 @@ def _live_resume_child_main() -> int:
     )
     if restored_driver is not None:
         drv.load_state_dict(restored_driver)
+    # no build() here (the step set is plain jit, which this row wants:
+    # it measures resume correctness, not compile) — stamp the clock
+    # build() would have, so the row still reports cold-start wall time
+    drv.startup_ms = (time.monotonic() - t_build) * 1e3
 
     addr_ready = threading.Event()
     addr_box: list = []
@@ -2329,6 +2346,11 @@ def _live_resume_child_main() -> int:
         "dispatch_per_step": round(
             report["spans"].get("train.dispatch", {}).get("count", 0)
             / max(drv.steps - start, 1), 3,
+        ),
+        "startup_ms": round(drv.startup_ms, 1),
+        "time_to_first_step_ms": (
+            round(drv.time_to_first_step_ms, 1)
+            if drv.time_to_first_step_ms is not None else None
         ),
     }
     if args.out:
@@ -2437,6 +2459,8 @@ def measure_live_resume(steps: int | None = None) -> dict:
         ),
         "seq_gaps": ref["seq_gaps"] + res["seq_gaps"],
         "restart_detected": res["producer_restarts"] >= 1,
+        "startup_ms": res["startup_ms"],
+        "time_to_first_step_ms": res["time_to_first_step_ms"],
         "ckpt": {
             "saves": ref["ckpt_saves"] + res["ckpt_saves"],
             "skipped": ref["ckpt_skipped"] + res["ckpt_skipped"],
@@ -2451,6 +2475,257 @@ def measure_live_resume(steps: int | None = None) -> dict:
         # (BLENDJAX_BENCH_RESUME_DIR points it into the workspace)
         row["snapshot_dir"] = base
         row["kill_leg_tail"] = (kill_out or "")[-500:]
+    return row
+
+
+_START_BATCH = 32
+_START_HW = 64
+_START_SEED = 23
+
+
+def _start_messages(n: int):
+    """Deterministic prebatched stream for the live_start legs: smooth
+    render-like frames (gradient shading + low-amplitude noise), 512 KB
+    per message (32 frames of 64x64x4). Two properties matter: the
+    payload is big enough that serialize+copy is a real per-message
+    cost (the regime the shm ring exists for — toy frames leave both
+    wires step-overhead-bound), and it is COMPRESSIBLE, so the ndz
+    codec actually compresses every message instead of engaging its
+    adaptive incompressible-noise skip and shipping raw."""
+    rng = np.random.default_rng(_START_SEED)
+    y, x = np.mgrid[0:_START_HW, 0:_START_HW]
+    ramp = (2 * x + 3 * y).astype(np.int64)[None, :, :, None]
+    for i in range(n):
+        noise = rng.integers(0, 8, (_START_BATCH, _START_HW, _START_HW, 4))
+        yield {
+            "_prebatched": True,
+            "image": ((ramp + noise + 5 * i) % 256).astype(np.uint8),
+            "xy": (
+                rng.random((_START_BATCH, 8, 2)) * _START_HW
+            ).astype(np.float32),
+        }
+
+
+def _live_start_child_main() -> int:
+    """Child mode for the instant-start row: build the driver through
+    ``TrainDriver.build`` (AOT step set + persistent compilation cache
+    at the shared ``cache_dir``), train a deterministic stream over a
+    real loopback socket on the requested wire (``ndz`` or ``shm``),
+    and write startup/compile/throughput/accounting evidence to
+    ``--out``. Fresh process per leg — that IS the cold/warm
+    experiment."""
+    import argparse
+    import threading
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--live-start-child", action="store_true")
+    ap.add_argument("cache_dir")
+    ap.add_argument("--wire", choices=("ndz", "shm"), default="ndz")
+    ap.add_argument("--steps", type=int, default=12)
+    ap.add_argument("--out", default=None)
+    args = ap.parse_args()
+
+    import jax  # noqa: F401  (backend init before any device work)
+
+    from blendjax.data import StreamDataPipeline
+    from blendjax.models import CubeRegressor
+    from blendjax.train import TrainDriver
+    from blendjax.utils.metrics import metrics as reg
+
+    example = {
+        k: v for k, v in next(iter(_start_messages(1))).items()
+        if not k.startswith("_")
+    }
+    drv = TrainDriver.build(
+        CubeRegressor(features=(8,)), example,
+        aot=True, aot_cache_dir=args.cache_dir,
+        inflight=2, sync_every=1,
+    )
+
+    addr_ready = threading.Event()
+    drain_go = threading.Event()
+    drain_n = 32
+    margin = 4
+    addr_box: list = []
+
+    def publish():
+        # socket created ON this thread (BJX104); wire-specific kwargs:
+        # shm ships descriptors through the ring, ndz pays zlib on the
+        # same content (compress_min_bytes=1 so every field compresses)
+        from blendjax.transport.channels import DataPublisherSocket
+
+        # shm ring provisioned past the training burst (the zmq legs
+        # get the same courtesy from the socket buffers); the drain
+        # phase below reuses slots, exercising the generation protocol
+        kw = (
+            {"shm": args.steps + 6} if args.wire == "shm"
+            else {"compress_level": 6, "compress_min_bytes": 1}
+        )
+        ch = DataPublisherSocket(
+            "tcp://127.0.0.1:*", btid=0, lingerms=30_000, **kw,
+        )
+        addr_box.append(ch.addr)
+        addr_ready.set()
+        # margin past the step target: the pipeline prefetches ahead
+        # and a PUSH stream has no EOS (same shape as live_resume)
+        for msg in _start_messages(args.steps + margin):
+            ch.publish(**msg)
+        # drain batch gated on the event so its serialize cost lands
+        # INSIDE the timed drain window, not overlapped with training;
+        # its own margin on top — the pipeline prefetches one ahead, so
+        # the last counted message must never be the last published
+        if drain_go.wait(timeout=120):
+            for msg in _start_messages(drain_n + margin):
+                ch.publish(**msg)
+        ch.close()
+
+    pub = threading.Thread(target=publish, daemon=True)
+    pub.start()
+    assert addr_ready.wait(timeout=10), "publisher never bound"
+    t_loop = time.monotonic()
+    with StreamDataPipeline(
+        [addr_box[0]], batch_size=_START_BATCH, timeoutms=30_000,
+    ) as pipe:
+        it = iter(pipe)
+        for sb in it:
+            drv.submit(sb)
+            if drv.steps >= args.steps:
+                break
+        drv.finish()
+        wall = time.monotonic() - t_loop
+        # transport drain: consume the remaining stream with no train
+        # step in the loop. The end-to-end legs above are step-bound on
+        # both wires (serialize overlaps training), so THIS is where
+        # the wire shows: ndz pays zlib-6 per 512 KB message, shm pays
+        # a memcpy out of the ring.
+        t_drain = time.monotonic()
+        drain_go.set()
+        drained = 0
+        for _ in range(margin + drain_n):
+            next(it)
+            drained += 1
+        drain_wall = time.monotonic() - t_drain
+        # join while the PULL side is still open: the publisher may
+        # still be sending its final margin messages, and a PUSH with
+        # no peer blocks forever
+        pub.join(timeout=30)
+
+    report = reg.report()
+    counters = report["counters"]
+    stats = drv.stats
+    result = {
+        "wire": args.wire,
+        "losses": [float(v) for v in drv.losses],
+        "steps": drv.steps,
+        "startup_ms": round(stats["startup_ms"], 1),
+        "time_to_first_step_ms": round(stats["time_to_first_step_ms"], 1),
+        "compile_ms": round(drv.step.compile_ms, 1),
+        "aot_signatures": len(drv.step.signatures),
+        "aot_cache_hits": int(counters.get("train.aot_cache_hits", 0)),
+        "aot_cache_misses": int(counters.get("train.aot_cache_misses", 0)),
+        "aot_fallbacks": int(counters.get("train.aot_fallbacks", 0)),
+        "imgs_per_s": round(drv.steps * _START_BATCH / max(wall, 1e-9), 1),
+        "wire_imgs_per_s": round(
+            drained * _START_BATCH / max(drain_wall, 1e-9), 1,
+        ),
+        "drained": drained,
+        "seq_gaps": int(counters.get("wire.seq_gaps", 0)),
+        "shm_reads": int(counters.get("wire.shm_reads", 0)),
+        "shm_torn": int(counters.get("wire.shm_torn", 0)),
+        "shm_fallbacks": int(counters.get("wire.shm_fallbacks", 0)),
+        "dispatch_per_step": round(
+            report["spans"].get("train.dispatch", {}).get("count", 0)
+            / max(drv.steps, 1), 3,
+        ),
+    }
+    if args.out:
+        with open(args.out, "w", encoding="utf-8") as f:
+            json.dump(result, f)
+    print("live-start-child done", json.dumps(
+        {k: result[k] for k in (
+            "wire", "compile_ms", "aot_cache_hits", "aot_cache_misses",
+        )}
+    ))
+    return 0
+
+
+def measure_live_start(steps: int | None = None) -> dict:
+    """Instant-start + zero-copy transport row (docs/performance.md
+    "Instant start"): cold and warm AOT legs sharing one persistent
+    cache dir (fresh processes — the restart experiment), plus a
+    shared-memory-wire leg on the same deterministic stream. The
+    headlines: ``warm_vs_cold_compile_ratio`` (CI pins warm strictly
+    below cold), ``equality.identical`` (shm f32 losses == ndz's), and
+    ``shm_vs_ndz_throughput``."""
+    import shutil
+    import subprocess
+    import tempfile
+
+    steps = START_STEPS if steps is None else steps
+    base = tempfile.mkdtemp(prefix="bjx-live-start-")
+    cache = os.path.join(base, "xla-cache")
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"  # loopback row: weather-independent
+    bench_path = os.path.abspath(__file__)
+
+    def leg(tag: str, wire: str) -> dict:
+        out = os.path.join(base, f"{tag}.json")
+        proc = subprocess.run(
+            [sys.executable, bench_path, "--live-start-child", cache,
+             "--wire", wire, "--steps", str(steps), "--out", out],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True, timeout=300,
+        )
+        assert proc.returncode == 0, proc.stdout[-2000:]
+        with open(out) as f:
+            return json.load(f)
+
+    cold = leg("cold", "ndz")
+    warm = leg("warm", "ndz")
+    shm = leg("shm", "shm")
+
+    identical = (
+        len(warm["losses"]) == len(shm["losses"]) == steps
+        and warm["losses"] == shm["losses"]
+    )
+    ok = (
+        identical
+        and cold["aot_cache_misses"] > 0 and cold["aot_cache_hits"] == 0
+        and warm["aot_cache_hits"] == warm["aot_signatures"]
+        and warm["aot_cache_misses"] == 0
+        and warm["compile_ms"] < cold["compile_ms"]
+    )
+    keys = ("startup_ms", "time_to_first_step_ms", "compile_ms",
+            "aot_signatures", "aot_cache_hits", "aot_cache_misses",
+            "aot_fallbacks", "imgs_per_s", "wire_imgs_per_s",
+            "seq_gaps", "shm_torn", "dispatch_per_step")
+    row = {
+        "steps": steps,
+        "cold": {k: cold[k] for k in keys},
+        "warm": {k: warm[k] for k in keys},
+        "shm": {k: shm[k] for k in keys + ("shm_reads", "shm_fallbacks")},
+        "warm_vs_cold_compile_ratio": round(
+            warm["compile_ms"] / max(cold["compile_ms"], 1e-9), 3,
+        ),
+        # transport-drain rate ratio, not the end-to-end train rate
+        # (both wires are step-bound end to end — serialize overlaps
+        # training — so only the drain phase can show the wire)
+        "shm_vs_ndz_throughput": round(
+            shm["wire_imgs_per_s"] / max(warm["wire_imgs_per_s"], 1e-9), 3,
+        ),
+        "equality": {
+            "identical": identical,
+            "compared": min(len(warm["losses"]), len(shm["losses"])),
+        },
+        "seq_gaps": cold["seq_gaps"] + warm["seq_gaps"] + shm["seq_gaps"],
+        "shm_torn": shm["shm_torn"],
+        "dispatch_per_step": max(
+            cold["dispatch_per_step"], warm["dispatch_per_step"],
+            shm["dispatch_per_step"],
+        ),
+        "value": 1.0 if ok else 0.0,
+    }
+    shutil.rmtree(base, ignore_errors=True)
     return row
 
 
@@ -3493,6 +3768,19 @@ def _build_record(progress: dict) -> dict:
             detail["live_resume"] = measure_live_resume()
         except Exception as e:  # pragma: no cover - spawn flake path
             detail["live_resume"] = {"error": repr(e)[:200]}
+    if LIVE_START:
+        # Instant-start A/B row (docs/performance.md "Instant start"):
+        # cold vs warm AOT legs sharing one persistent compilation
+        # cache (fresh child processes — a real restart), plus a
+        # shared-memory-wire leg. Pure CPU/loopback, weather-
+        # independent. CI asserts warm compile < cold, all-hits warm
+        # manifest, exact shm-vs-ndz loss equality, seq_gaps == 0,
+        # shm_torn == 0, dispatch_per_step == 1.0, and shm throughput
+        # at least matching ndz.
+        try:
+            detail["live_start"] = measure_live_start()
+        except Exception as e:  # pragma: no cover - spawn flake path
+            detail["live_start"] = {"error": repr(e)[:200]}
     if LIVE_RL:
         # RL actor-learner row (docs/rl.md): cartpole trained end to
         # end — uniform-vs-prioritized A/B, an 8-device CPU-mesh leg,
@@ -3671,6 +3959,8 @@ if __name__ == "__main__":
         sys.exit(_multichip_live_main())
     if "--live-resume-child" in sys.argv:
         sys.exit(_live_resume_child_main())
+    if "--live-start-child" in sys.argv:
+        sys.exit(_live_start_child_main())
     if "--live-rl-mesh" in sys.argv:
         sys.exit(_live_rl_mesh_main())
     if "--live-rl-child" in sys.argv:

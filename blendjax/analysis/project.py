@@ -878,6 +878,8 @@ class ProjectContext:
 #: Literal sidecar keys every blendjax batch dict may carry; the
 #: per-run universe extends this with ``*_KEY`` string constants.
 SIDECAR_LITERAL_KEYS = frozenset({
+    "_shm",
+    "_shm_torn",
     "_trace",
     "_traces",
     "_scenario",

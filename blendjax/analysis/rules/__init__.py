@@ -5,6 +5,7 @@ from __future__ import annotations
 from blendjax.analysis.rules import (  # noqa: F401  (registration side effects)
     actor_loop,
     checkpoint_sync,
+    cold_jit,
     concurrency,
     deserialization,
     donation,

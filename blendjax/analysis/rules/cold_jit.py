@@ -1,0 +1,110 @@
+"""BJX125 cold-jit-in-hot-loop: jit/step-builder construction inside a
+per-step or per-batch loop in a driver hot path.
+
+The instant-start work (``blendjax/train/aot.py``, docs/performance.md
+"Instant start") moves every trace+compile *before step 0*: the AOT set
+precompiles the bucket ladder and the persistent cache makes restarts
+pay milliseconds. Constructing a ``jax.jit`` wrapper — or calling a step
+builder like ``make_supervised_step``/``make_train_state`` — *inside*
+the loop that drives steps silently defeats both: each iteration gets a
+fresh wrapper with an empty dispatch cache, so every step re-traces and
+re-compiles, and none of it is the AOT set the driver warmed. The
+sanctioned shape is construction at build time (``TrainDriver.build``,
+the pipeline constructors) with only dispatch in the loop.
+
+Scope mirrors BJX106: modules opting in with ``bjx: driver-hot-path``
+(comment marker) plus anything named ``driver.py``.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterator
+
+from blendjax.analysis.core import (
+    Finding,
+    ModuleContext,
+    Rule,
+    register,
+)
+from blendjax.analysis.rules.driver_sync import (
+    LoopNode,
+    _is_driver_hot,
+    _walk_loop,
+)
+
+# Fully-qualified call targets that construct traced/compiled artifacts.
+JIT_CONSTRUCTORS = {
+    "jax.jit",
+    "jax.pjit",
+    "jax.experimental.pjit.pjit",
+}
+# Step/state builders by bare name: each returns a fresh jit wrapper (or
+# inits params), so per-iteration calls re-trace per iteration.
+BUILDER_NAME_RE = re.compile(
+    r"^(?:make_(?:[a-z0-9_]+_)?(?:step|state)|build_aot_step)$"
+)
+
+
+def _call_names(func: ast.AST, module: ModuleContext) -> tuple[str, str]:
+    """(resolved dotted name, bare trailing name) for a call target."""
+    resolved = module.resolve(func) or ""
+    if isinstance(func, ast.Attribute):
+        bare = func.attr
+    elif isinstance(func, ast.Name):
+        bare = func.id
+    else:
+        bare = ""
+    return resolved, (resolved.rsplit(".", 1)[-1] if resolved else bare)
+
+
+@register
+class ColdJitInHotLoopRule(Rule):
+    id = "BJX125"
+    name = "cold-jit-in-hot-loop"
+    description = (
+        "jax.jit / step-builder construction inside a per-step or "
+        "per-batch loop in a driver hot path (re-traces every "
+        "iteration; defeats the AOT step set and the persistent "
+        "compilation cache)"
+    )
+
+    def check(self, module: ModuleContext) -> Iterator[Finding]:
+        if not _is_driver_hot(module):
+            return
+        for qual, fn, _cls in module.iter_functions():
+            seen: set[tuple[int, int]] = set()
+            for node in ast.walk(fn):
+                if isinstance(node, (ast.For, ast.AsyncFor, ast.While)):
+                    for f in self._scan_loop(module, node, qual):
+                        key = (f.line, f.col)
+                        if key not in seen:  # nested loops scan twice
+                            seen.add(key)
+                            yield f
+
+    def _scan_loop(
+        self, module: ModuleContext, loop: LoopNode, qual: str
+    ) -> Iterator[Finding]:
+        for node in _walk_loop(loop):
+            if not isinstance(node, ast.Call):
+                continue
+            resolved, bare = _call_names(node.func, module)
+            if resolved in JIT_CONSTRUCTORS:
+                label = resolved
+            elif bare == "jit" and resolved.endswith(".jit"):
+                label = resolved
+            elif BUILDER_NAME_RE.match(bare):
+                label = bare
+            else:
+                continue
+            yield self.finding(
+                module,
+                node,
+                f"'{label}(...)' constructed inside a loop in driver "
+                f"hot path '{qual}': every iteration re-traces and "
+                "re-compiles with a cold dispatch cache, defeating the "
+                "AOT step set and the persistent compilation cache — "
+                "build steps once (TrainDriver.build / module scope) "
+                "and only dispatch in the loop",
+            )

@@ -265,6 +265,14 @@ class RemoteStream:
         if recorder is not None:
             recorder.save(raw)
         lineage.ingest(msg, track_gaps=self.track_gaps)
+        # Torn shm read (blendjax.transport.shm): the descriptor — and
+        # with it every lineage stamp — arrived intact, so the seq was
+        # ingested above and the gap accounting stays exact; only the
+        # payload is unreadable (writer died mid-slot or the slot was
+        # reclaimed). Skip the item without counting it: wire.shm_torn
+        # was already counted at resolve time.
+        if msg.pop("_shm_torn", False):
+            return None
         # Distributed frame trace: stamp the consumer-side arrival on
         # the sampled subset (one dict lookup per message off the
         # sampled path — no allocations).
@@ -323,7 +331,10 @@ class RemoteStream:
                 ):
                     continue
             fut, raw = pending.popleft()
-            yield self._account(fut.result(), raw, recorder)
+            item = self._account(fut.result(), raw, recorder)
+            if item is None:  # torn shm read: accounted, not delivered
+                continue
+            yield item
             n += 1
 
     def __iter__(self):
@@ -365,7 +376,10 @@ class RemoteStream:
                 if out is None:  # request_stop(): exit through cleanup
                     return
                 msg, raw = out
-                yield self._account(msg, raw, recorder)
+                item = self._account(msg, raw, recorder)
+                if item is None:  # torn shm read: accounted, not delivered
+                    continue
+                yield item
                 n += 1
         finally:
             if recorder is not None:

@@ -133,13 +133,16 @@ def _parse(argv):
     )
     parser.add_argument("--trace-every", type=int, default=0)
     parser.add_argument(
-        "--wire", choices=("raw", "ndz", "ndr"), default="raw",
+        "--wire", choices=("raw", "ndz", "ndr", "shm"), default="raw",
         help="wire compression: raw frames (default), zlib 'ndz' "
         "(host inflate on the consumer), or run-length 'ndr' (near-"
         "free host inflate; deferred into the consumer's train jit on "
         "the fused path). Both compressed modes publish _prebatched "
         "(opaque pass-through) so the consumer's batch shapes never "
-        "enter schema assembly — the tile-stream contract.",
+        "enter schema assembly — the tile-stream contract. 'shm' "
+        "ships tensors through a shared-memory ring for same-host "
+        "consumers (blendjax.transport.shm): only a tiny descriptor "
+        "rides the socket, no pickle/inflate on either side.",
     )
     parser.add_argument(
         "--rle-cap", type=int, default=0, metavar="N",
@@ -212,14 +215,23 @@ def main(argv=None) -> int:
         compress_level=6 if opts.wire == "ndz" else 0,
         compress_rle=opts.wire == "ndr",
         rle_cap=opts.rle_cap or None,
-        **({"compress_min_bytes": 1024} if opts.wire != "raw" else {}),
+        **({"compress_min_bytes": 1024}
+           if opts.wire in ("ndz", "ndr") else {}),
         quantize_f16=("xy",) if opts.quantize_xy else (),
+        # shm: the publisher writes pool slots into a shared-memory
+        # ring and ships descriptors; the ring's per-slot ack counters
+        # replace MessageTracker as the slot-reuse bound (trackers
+        # return pre-completed). Under a fleet launcher the segment is
+        # registered for retire_instance to unlink.
+        shm=4 if opts.wire == "shm" else None,
     )
     # Compressed-wire modes publish opaque prebatched messages (the
     # tile-stream pass-through): deferred "ndr" buffers have content-
     # dependent packed shapes that must never enter schema assembly.
+    # shm messages decode to plain arrays on the consumer — same shape
+    # contract as raw, so they keep the _batched fast path.
     batch_stamp = (
-        {"_prebatched": True} if opts.wire != "raw"
+        {"_prebatched": True} if opts.wire in ("ndz", "ndr")
         else {"_batched": True}
     )
 

@@ -29,6 +29,8 @@ import time
 
 from blendjax.launcher.arguments import format_launch_args
 from blendjax.launcher.launch_info import LaunchInfo
+from blendjax.transport.shm import REGISTRY_ENV as SHM_REGISTRY_ENV
+from blendjax.transport.shm import reap_registry
 from blendjax.utils.ipaddr import get_primary_ip
 from blendjax.utils.logging import get_logger
 from blendjax.utils.tg import guard
@@ -180,6 +182,7 @@ class ProcessLauncher:
         self.launch_info: LaunchInfo | None = None
         self._argvs: list = []
         self._ipc_dir: str | None = None
+        self._shm_registry: str | None = None
         self._retired: set = guard(
             set(), name="launcher.retired", lock=self._lock,
             exempt=_MEMBER_READS,
@@ -293,6 +296,17 @@ class ProcessLauncher:
             p for p in env.get("PYTHONPATH", "").split(os.pathsep) if p
         ]
         env["PYTHONPATH"] = os.pathsep.join(dict.fromkeys(parts))
+
+        # Shared-memory segment lifecycle (blendjax.transport.shm): the
+        # launcher owns the unlink for instances it spawned. Producers
+        # that create an ShmRing register it (one marker file per
+        # segment) in this directory; retire_instance reaps that
+        # instance's segments after the kill, __exit__ reaps the rest —
+        # so segments are unlinked exactly once even when a producer is
+        # SIGKILLed mid-write.
+        if self._shm_registry is None:
+            self._shm_registry = tempfile.mkdtemp(prefix="blendjax-shm-")
+        env[SHM_REGISTRY_ENV] = self._shm_registry
 
         # Orphan-proofing (Linux): if the launcher dies without its
         # __exit__ running (SIGKILL, `timeout`), the kernel delivers
@@ -513,6 +527,7 @@ class ProcessLauncher:
             self._retired.add(i)
             proc = self.processes[i]
             sockets = self.instance_sockets(i)
+            shm_registry = self._shm_registry
         if proc.poll() is None:
             if drain:
                 try:
@@ -535,6 +550,13 @@ class ProcessLauncher:
                     proc.wait(timeout=timeout)
                 except subprocess.TimeoutExpired:
                     pass
+        # The launcher owns the unlink for segments this instance
+        # registered (btid == index): reaped only after the process is
+        # gone, so a drain's in-flight descriptors stayed readable.
+        # reap_registry removes each marker file with its segment, so
+        # racing the teardown reap stays exactly-once.
+        if shm_registry is not None:
+            reap_registry(shm_registry, btid=i)
         logger.info("retired instance %d (%s)", i, sockets)
         return sockets
 
@@ -548,6 +570,11 @@ class ProcessLauncher:
                 raise ValueError(f"instance {i} is retired")
             if self.processes[i].poll() is None:
                 return self.processes[i]
+            # the dead producer's segments are unreadable going forward
+            # (fresh spawn creates a fresh ring); reap them now so
+            # respawn churn can't accumulate /dev/shm leaks
+            if self._shm_registry is not None:
+                reap_registry(self._shm_registry, btid=i)
             proc = self._spawn(self._argvs[i])
             self.processes[i] = proc
             self.launch_info.processes[i] = proc.pid
@@ -620,6 +647,15 @@ class ProcessLauncher:
 
             shutil.rmtree(self._ipc_dir, ignore_errors=True)
             self._ipc_dir = None
+        if self._shm_registry is not None:
+            # every child is dead: unlink whatever segments remain
+            # registered (retire_instance already reaped its own), then
+            # drop the registry dir itself
+            import shutil
+
+            reap_registry(self._shm_registry)
+            shutil.rmtree(self._shm_registry, ignore_errors=True)
+            self._shm_registry = None
         if still:
             # Never mask an in-flight exception with the leak report.
             if exc_type is None:

@@ -9,6 +9,11 @@ decision procedure over one :meth:`Metrics.report` snapshot:
 ==============  ============================================================
 verdict         evidence
 ==============  ============================================================
+compile-bound   one-time jit/AOT compile wall time (``train.compile_ms``)
+                dominates the window: a cold start, not a slow step —
+                checked first so cold-start runs never misread as
+                step-bound; the advice points at the persistent
+                compilation cache (docs/performance.md "Instant start")
 step-bound      ingest outruns the consumer: ``ingest.queue_full_waits``
                 climbing while the consumer barely waits on the queue, or
                 the driver's dispatch ring blocking (``driver.ring_wait`` /
@@ -48,6 +53,7 @@ import dataclasses
 
 # Verdict kinds, in the order the decision procedure tests them.
 VERDICTS = (
+    "compile-bound",
     "step-bound",
     "feed-bound",
     "decode-bound",
@@ -125,8 +131,15 @@ def diagnose(
     # waiting for fresh frames (the inner consumer's queue_wait accrues
     # concurrently in the drain thread).
     ewait = _total(spans, "echo.wait_fresh")
+    # One-time jit/AOT compile wall time (blendjax.train.aot). Included
+    # in the evidence so a cold-start-dominated run reads compile-bound
+    # — not step-bound — and the advice points at the persistent cache.
+    compile_s = _total(spans, "train.compile_ms")
 
-    busy = recv + qwait + place + throttle + decode + train + ring + ewait
+    busy = (
+        recv + qwait + place + throttle + decode + train + ring + ewait
+        + compile_s
+    )
     shares = {
         "ingest.recv": recv,
         "ingest.queue_wait": qwait,
@@ -136,6 +149,7 @@ def diagnose(
         "train.dispatch": train,
         "driver.ring_wait": ring,
         "echo.wait_fresh": ewait,
+        "train.compile_ms": compile_s,
     }
     if busy <= 0.0:
         return Verdict(
@@ -159,6 +173,26 @@ def diagnose(
         vals = [v for v in vals if v is not None]
         if vals:
             staleness_p95_s = max(vals) / 1e3
+
+    # 0. compile-bound: one-time trace+compile wall time dominates the
+    #    window — a cold start, not a slow step. Checked FIRST: compile
+    #    stalls the consumer loop, so every downstream signature (full
+    #    ingest queue, ring waits) fires too and would misread as
+    #    step-bound.
+    if shares["train.compile_ms"] > 0.5:
+        return Verdict(
+            "compile-bound",
+            f"train.compile_ms share={shares['train.compile_ms']:.0%} "
+            f"(aot_cache_hits={int(counters.get('train.aot_cache_hits', 0))}, "
+            f"aot_cache_misses="
+            f"{int(counters.get('train.aot_cache_misses', 0))}): this "
+            "window is cold-start compilation, not steady-state work",
+            "AOT-compile before step 0 behind the persistent cache "
+            "(TrainDriver.build(aot=True, aot_cache_dir=...)); warm "
+            "restarts then pay milliseconds — see docs/performance.md "
+            "'Instant start'",
+            shares,
+        )
 
     # 1. step-bound (specific evidence): the dispatch ring genuinely
     #    filling — these signals implicate the STEP itself, so they
@@ -209,7 +243,7 @@ def diagnose(
         shares["ingest.recv"], shares["ingest.queue_wait"],
         shares["feed.place"], shares["feed.throttle_wait"],
         shares["train.dispatch"], shares["driver.ring_wait"],
-        shares["echo.wait_fresh"],
+        shares["echo.wait_fresh"], shares["train.compile_ms"],
     )
     if shares["decode.dispatch"] > 0.30 and shares["decode.dispatch"] >= others:
         return Verdict(

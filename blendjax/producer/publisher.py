@@ -30,6 +30,8 @@ class DataPublisher(DataPublisherSocket):
         lineage: bool = True,
         telemetry_every: int = 64,
         trace_every: int = 64,
+        shm=None,
+        shm_timeout_s: float = 5.0,
     ):
         # lineage/telemetry_every: publish-time stamps + the periodic
         # producer-metrics piggyback (docs/observability.md) — on by
@@ -53,4 +55,6 @@ class DataPublisher(DataPublisherSocket):
             lineage=lineage,
             telemetry_every=telemetry_every,
             trace_every=trace_every,
+            shm=shm,
+            shm_timeout_s=shm_timeout_s,
         )

@@ -6,6 +6,11 @@ package is the consumer-side training half of the north star: jitted,
 donated, mesh-sharded steps fed by ``blendjax.data``.
 """
 
+from blendjax.train.aot import (
+    AotStepSet,
+    build_aot_step,
+    configure_compilation_cache,
+)
 from blendjax.train.steps import (
     corner_loss,
     make_chunked_supervised_step,
@@ -36,6 +41,9 @@ from blendjax.train.precision import (
 )
 
 __all__ = [
+    "AotStepSet",
+    "build_aot_step",
+    "configure_compilation_cache",
     "make_train_state",
     "make_supervised_step",
     "make_chunked_supervised_step",

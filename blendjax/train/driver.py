@@ -135,6 +135,79 @@ class TrainDriver:
         self.images_retired = 0
         self._mfu_mark: tuple | None = None  # (t_mono, images_retired)
         self._t_first_dispatch: float | None = None
+        # Cold-start accounting (docs/performance.md "Instant start"):
+        # build() stamps startup_ms (model init + step AOT-compile wall
+        # time); the first retirement stamps time_to_first_step_ms
+        # relative to construction. Both surface in `stats` and the
+        # live_start bench row, where the warm-vs-cold persistent-cache
+        # ratio is CI-gated.
+        self._t_created = time.monotonic()
+        self._t_first_retire: float | None = None
+        self.startup_ms: float | None = None
+
+    @classmethod
+    def build(cls, model, example_batch, *, loss_fn=None, optimizer=None,
+              learning_rate: float = 1e-3, rng=None, augment=None,
+              augment_rng=None, precision=None, aot: bool = True,
+              aot_cache_dir: str | None = None, resume: bool = False,
+              **driver_kwargs):
+        """Model -> ready driver, with the step set AOT-compiled.
+
+        One call covers init, restore, and warm-up: ``make_train_state``
+        from ``example_batch["image"]``, an optional checkpoint restore
+        (``resume=True`` with ``checkpoint=`` in ``driver_kwargs`` —
+        restored driver counters are loaded and the session dict is left
+        on ``driver.resumed_session`` for the caller's lineage restore),
+        then ``blendjax.train.aot.build_aot_step`` compiles every
+        bucket-ladder shape before step 0 — behind the persistent
+        compilation cache when ``aot_cache_dir`` is set, so elastic
+        resume and preemption churn pay milliseconds, not re-trace
+        time. The total build wall time lands on ``driver.startup_ms``.
+        """
+        from blendjax.train.steps import (
+            make_supervised_step,
+            make_train_state,
+        )
+
+        t0 = time.monotonic()
+        if not isinstance(example_batch, dict) or "image" not in example_batch:
+            raise TypeError(
+                "build() needs a full example batch dict (at least "
+                "'image' + the loss's fields) to derive the AOT ladder"
+            )
+        state = make_train_state(
+            model, example_batch["image"], optimizer=optimizer,
+            learning_rate=learning_rate, rng=rng,
+        )
+        session = None
+        mgr = driver_kwargs.get("checkpoint")
+        if resume and mgr is not None:
+            restored = mgr.restore(state)
+            if restored is not None:
+                state = restored.state
+                session = restored.session
+        step = make_supervised_step(
+            loss_fn=loss_fn, augment=augment, augment_rng=augment_rng,
+            precision=precision,
+        )
+        if aot:
+            from blendjax.train.aot import build_aot_step, cache_key
+
+            buckets = driver_kwargs.get("buckets")
+            step = build_aot_step(
+                step, state, example_batch, buckets=buckets,
+                cache_dir=aot_cache_dir,
+                key=cache_key(
+                    model=model, precision=precision, buckets=buckets,
+                ) if aot_cache_dir else None,
+            )
+        drv = cls(step, state, **driver_kwargs)
+        drv._t_created = t0  # cold-start clock starts at build entry
+        drv.startup_ms = (time.monotonic() - t0) * 1e3
+        drv.resumed_session = session
+        if isinstance(session, dict) and session.get("driver"):
+            drv.load_state_dict(session["driver"])
+        return drv
 
     # -- ring ----------------------------------------------------------------
 
@@ -153,6 +226,8 @@ class TrainDriver:
         only — the loss value itself is NOT fetched here."""
         _loss, t0, images, traces = entry
         now = time.monotonic()
+        if self._t_first_retire is None:
+            self._t_first_retire = now
         metrics.observe("train.step_device_ms", (now - t0) * 1e3)
         self.images_retired += images
         if self.flops_per_image and self.peak_flops:
@@ -492,6 +567,15 @@ class TrainDriver:
         return self.finish()
 
     @property
+    def time_to_first_step_ms(self) -> float | None:
+        """Wall time from driver construction to the first retired step
+        (``None`` until one retires) — the end-to-end cold-start number
+        the ``live_start`` bench row gates warm-vs-cold."""
+        if self._t_first_retire is None:
+            return None
+        return (self._t_first_retire - self._t_created) * 1e3
+
+    @property
     def stats(self) -> dict:
         return {
             "steps": self.steps,
@@ -502,4 +586,6 @@ class TrainDriver:
             "syncs": len(self.losses),
             "images_retired": self.images_retired,
             "checkpoints": self.checkpoints,
+            "startup_ms": self.startup_ms,
+            "time_to_first_step_ms": self.time_to_first_step_ms,
         }

@@ -268,16 +268,29 @@ class MeshTrainDriver(TrainDriver):
     def build(cls, model, mesh, example_batch, loss_fn=None,
               fused: bool = False, optimizer=None,
               learning_rate: float = 1e-3, rng=None, augment=None,
-              augment_rng=None, **driver_kwargs):
+              augment_rng=None, aot: bool = False,
+              aot_cache_dir: str | None = None, aot_batch=None,
+              **driver_kwargs):
         """One call from model to mesh-resident driver: init the train
         state sharded by the mesh rules (params over ``fsdp``/
         ``tensor`` where the axes exist, replicated otherwise — see
         ``param_sharding_rules``), build the pinned-sharding step
         (``fused=True`` for packed tile/pal streams), and wrap the
         driver. ``example_batch`` is one host batch of the stream's
-        image field (shapes only; values never train)."""
+        image field (shapes only; values never train).
+
+        ``aot=True`` with ``aot_batch`` (a full example batch dict —
+        image + the loss's fields) AOT-compiles the step for every
+        bucket-ladder shape before step 0, behind the persistent
+        compilation cache when ``aot_cache_dir`` is set (docs/
+        performance.md "Instant start"). The fused tile step is a host
+        dispatcher over inner jits and is not lowerable as one unit, so
+        AOT applies to the supervised step only."""
+        import time as _time
+
         from blendjax.train.steps import make_train_state
 
+        t0 = _time.monotonic()
         state = make_train_state(
             model, example_batch, optimizer=optimizer,
             learning_rate=learning_rate, rng=rng, mesh=mesh,
@@ -295,7 +308,21 @@ class MeshTrainDriver(TrainDriver):
                 state, mesh, loss_fn=loss_fn, augment=augment,
                 augment_rng=augment_rng,
             )
-        return cls(step, state, mesh, **driver_kwargs)
+        if aot and not fused and aot_batch is not None:
+            from blendjax.train.aot import build_aot_step, cache_key
+
+            buckets = driver_kwargs.get("buckets")
+            step = build_aot_step(
+                step, state, aot_batch, buckets=buckets,
+                cache_dir=aot_cache_dir,
+                key=cache_key(
+                    model=model, mesh=mesh, buckets=buckets,
+                ) if aot_cache_dir else None,
+            )
+        drv = cls(step, state, mesh, **driver_kwargs)
+        drv._t_created = t0
+        drv.startup_ms = (_time.monotonic() - t0) * 1e3
+        return drv
 
     def batch_sharding(self):
         """The layout live batches must arrive in (what

@@ -22,8 +22,18 @@ from blendjax.transport.channels import (
     ReceiveTimeoutError,
     term_context,
 )
+from blendjax.transport.shm import (
+    ShmCapacityError,
+    ShmRing,
+    attach_ring,
+    detach_all,
+)
 
 __all__ = [
+    "ShmRing",
+    "ShmCapacityError",
+    "attach_ring",
+    "detach_all",
     "TensorCodec",
     "PickleCodec",
     "encode_message",

@@ -29,6 +29,12 @@ import time
 import zmq
 
 from blendjax import constants
+from blendjax.transport.shm import (
+    REGISTRY_ENV,
+    ShmCapacityError,
+    ShmRing,
+    resolve_message,
+)
 from blendjax.transport.wire import (
     DEFAULT_COMPRESS_MIN_BYTES,
     WireCompressState,
@@ -124,12 +130,19 @@ class _Channel:
         ``decode_message(inflate_pool=)`` surface: the stream path's
         whole-message decode-ahead subsumes it and must not re-enter
         the same executor from inside a decode job."""
-        return decode_message(
+        msg = decode_message(
             buffers, copy_arrays=copy_arrays,
             allow_pickle=self.allow_pickle,
             count_metrics=self.wire_metrics,
             defer_rle=self.defer_rle,
         )
+        if isinstance(msg, dict) and "_shm" in msg:
+            # Co-located producer: the wire carried only a descriptor;
+            # the tensor bytes come straight out of the shared-memory
+            # ring (blendjax.transport.shm). A torn generation leaves a
+            # `_shm_torn` marker for the stream layer to account + skip.
+            msg = resolve_message(msg)
+        return msg
 
     def _poll_recv(self, timeoutms: int, copy_arrays: bool):
         """Receive+decode one message within ``timeoutms``; returns
@@ -154,6 +167,19 @@ class _Channel:
 
     def __exit__(self, *exc):
         self.close()
+
+
+class _SentTracker:
+    """`zmq.MessageTracker` stand-in for shm publishes: the payload was
+    copied into the ring before send, so it is 'done' immediately."""
+
+    done = True
+
+    def wait(self, timeout=None):
+        return None
+
+
+_DONE_TRACKER = _SentTracker()
 
 
 class DataPublisherSocket(_Channel):
@@ -186,10 +212,33 @@ class DataPublisherSocket(_Channel):
         lineage: bool = True,
         telemetry_every: int = 64,
         trace_every: int = 64,
+        shm=None,
+        shm_timeout_s: float = 5.0,
     ):
         self.codec = codec
         self.btid = btid
         self.copy = copy
+        # Zero-copy local transport (docs/wire-protocol.md "Shared-memory
+        # descriptors"): with ``shm`` set, ndarray payloads are written
+        # into a shared-memory ring and only a tiny descriptor rides the
+        # socket — same-host consumers attach and read the slot with no
+        # pickle/inflate. Pass an ``ShmRing`` to share one, ``True``/an
+        # int slot count to lazily create a ring sized from the first
+        # payload. Messages without arrays (or that outgrow the slot)
+        # fall back to the wire codecs transparently, so remote-capable
+        # code needs no changes.
+        self._shm_timeout_s = float(shm_timeout_s)
+        self._shm_owned = False
+        if isinstance(shm, ShmRing):
+            self._shm_ring = shm
+            self._shm_slots = shm.slots
+        elif shm:
+            self._shm_ring = None
+            self._shm_slots = 4 if shm is True else int(shm)
+            self._shm_owned = True
+        else:
+            self._shm_ring = None
+            self._shm_slots = 0
         # Per-publisher wire compression (tensor codec only): level > 0
         # ships large array frames as zlib "ndz" entries. Trades producer
         # CPU for wire bytes — the right trade on tunneled/cross-host
@@ -248,9 +297,48 @@ class DataPublisherSocket(_Channel):
         (reference stamps every payload, ``publisher.py:42``) plus the
         lineage stamps (seq + publish times; see ``__init__``)."""
         data = self._stamp({"btid": self.btid, **kwargs})
+        if self._shm_slots:
+            frames = self._encode_shm(data)
+            if frames is not None:
+                # descriptor frames are tiny: copy-send, nothing to track
+                self.sock.send_multipart(frames, copy=True)
+                return
         self.sock.send_multipart(
             self._encode(data), copy=self.copy
         )
+
+    def _encode_shm(self, data: dict) -> list | None:
+        """Write the message's arrays into the shm ring and encode the
+        descriptor message; ``None`` means "use the wire codecs" (no
+        array payload, or the payload outgrew the slot)."""
+        import numpy as np
+
+        arrs = {
+            k: v for k, v in data.items()
+            if isinstance(v, np.ndarray) and v.ndim >= 1
+        }
+        if not arrs:
+            return None
+        ring = self._shm_ring
+        if ring is None:
+            # size the ring from the first payload (stable shapes are the
+            # co-located steady state), with headroom for stamp jitter
+            slot_bytes = sum(v.nbytes + 64 for v in arrs.values()) * 2
+            ring = ShmRing(
+                slots=self._shm_slots, slot_bytes=slot_bytes,
+                btid=self.btid,
+            )
+            self._shm_ring = ring
+        try:
+            desc = ring.write(arrs, timeout_s=self._shm_timeout_s)
+        except ShmCapacityError:
+            from blendjax.utils.metrics import metrics
+
+            metrics.count("wire.shm_fallbacks")
+            return None
+        small = {k: v for k, v in data.items() if k not in arrs}
+        small["_shm"] = desc
+        return self._encode(small)
 
     def _stamp(self, data: dict) -> dict:
         if not self.lineage:
@@ -322,9 +410,30 @@ class DataPublisherSocket(_Channel):
         connected consumers: PUSH keeps one queue per pipe, so per-pipe HWM
         alone does not cap the total number of in-flight messages."""
         data = self._stamp({"btid": self.btid, **kwargs})
+        if self._shm_slots:
+            frames = self._encode_shm(data)
+            if frames is not None:
+                # the ring copied the arrays already: the caller's buffers
+                # are free the moment we return, so the tracker is a
+                # pre-completed stand-in (the ring's ack counters — not
+                # MessageTracker — now bound slot reuse)
+                self.sock.send_multipart(frames, copy=True)
+                return _DONE_TRACKER
         return self.sock.send_multipart(
             self._encode(data), copy=False, track=True
         )
+
+    def close(self):
+        super().close()
+        ring = self._shm_ring
+        if ring is not None and self._shm_owned:
+            ring.close()
+            # Under a fleet launcher the registry owns the unlink (after
+            # the consumer drains); standalone producers unlink on clean
+            # close so nothing leaks in /dev/shm. ShmRing.unlink() is
+            # idempotent, so racing the launcher is harmless.
+            if not os.environ.get(REGISTRY_ENV):
+                ring.unlink()
 
 
 

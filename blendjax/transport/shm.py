@@ -1,0 +1,469 @@
+"""Shared-memory ring transport for co-located producers.
+
+The synthetic tier's 4-slot publish-by-reference pool showed that same-host
+producers pay the wire codecs for nothing: the arrays are already in RAM on
+the right machine.  ``ShmRing`` generalizes that pool into a
+``multiprocessing.shared_memory`` segment that a producer process writes and
+a consumer process reads directly — the ZMQ message shrinks to a tiny
+*descriptor* (segment name, slot index, generation, field layout) while the
+tensor bytes never touch pickle, zlib, or the socket.
+
+Cross-process discipline is a seqlock per slot (the same single-writer
+contract the in-process threadguard/BJX117 pass polices):
+
+* the writer bumps the slot's generation counter to an **odd** value before
+  touching the payload and to the next **even** value after — a reader that
+  observes an odd generation, or a generation that changed across its copy,
+  discards the slot as *torn* (``wire.shm_torn``);
+* the reader acknowledges consumption by storing the generation it consumed
+  into the slot's ``ack`` counter; the writer reuses a slot only once
+  ``ack == gen`` — bounded by a timeout, after which the slot is *reclaimed*
+  (``wire.shm_reclaims``) so a kill -9'd reader never wedges the writer.
+
+Both counters are 8-byte-aligned u64 stores, which are atomic on every
+platform JAX runs on; no cross-process locks exist anywhere in the protocol.
+
+Segment lifecycle: creators register their segment in the directory named by
+``$BLENDJAX_SHM_REGISTRY`` (one marker file per segment, ``<btid>__<name>``)
+when the env var is set — the fleet launcher exports it and then *owns* the
+unlink in ``retire_instance``/teardown, so segments are unlinked exactly once
+even when the producer dies abnormally.  Without a registry (standalone
+producers) the creator unlinks on clean close.  Attach-side handles are
+cached per process (``attach_ring``); an attached mapping survives the
+segment's unlink, so in-flight descriptors keep resolving during scale-down.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import re
+import threading
+import time
+from multiprocessing import shared_memory
+
+import numpy as np
+
+from blendjax.utils.metrics import metrics
+
+logger = logging.getLogger(__name__)
+
+REGISTRY_ENV = "BLENDJAX_SHM_REGISTRY"
+
+_MAGIC = b"BJXSHM1\0"
+_HDR_BYTES = 24  # magic(8) + slots(u64) + slot_bytes(u64)
+_ALIGN = 64
+
+__all__ = [
+    "ShmRing",
+    "ShmCapacityError",
+    "attach_ring",
+    "detach_all",
+    "resolve_message",
+    "reap_registry",
+    "unlink_segment",
+    "REGISTRY_ENV",
+]
+
+
+class ShmCapacityError(ValueError):
+    """Payload does not fit a slot; callers fall back to the wire codecs."""
+
+
+def _align(n: int, a: int = _ALIGN) -> int:
+    return (int(n) + a - 1) // a * a
+
+
+def _untrack(shm: shared_memory.SharedMemory) -> None:
+    """Detach this handle from the resource_tracker.
+
+    Cleanup ownership is explicit (registry + launcher, or creator close):
+    leaving the tracker registered means a second, racing unlink attempt at
+    interpreter exit plus a leaked-resource warning for segments that were
+    already reclaimed.  Attach-side handles must never be tracked at all.
+    """
+    try:  # pragma: no cover - depends on stdlib internals staying put
+        from multiprocessing import resource_tracker
+
+        resource_tracker.unregister(shm._name, "shared_memory")
+    except Exception:
+        pass
+
+
+def _unlink_quietly(shm: shared_memory.SharedMemory) -> None:
+    """Unlink without resource_tracker noise.
+
+    Stdlib ``unlink()`` unregisters the name from the tracker — but every
+    handle here was untracked at creation/attach (cleanup ownership is
+    explicit), so the tracker process would log a ``KeyError`` removing a
+    name it never had.  Re-registering immediately before the unlink keeps
+    the pair balanced; the two messages are ordered on the tracker pipe.
+    """
+    try:  # pragma: no cover - depends on stdlib internals staying put
+        from multiprocessing import resource_tracker
+
+        resource_tracker.register(shm._name, "shared_memory")
+    except Exception:
+        pass
+    shm.unlink()
+
+
+def _sanitize(btid: object) -> str:
+    return re.sub(r"[^A-Za-z0-9_-]", "-", str(btid))
+
+
+def _register(name: str, btid: object) -> None:
+    reg = os.environ.get(REGISTRY_ENV)
+    if not reg:
+        return
+    try:
+        os.makedirs(reg, exist_ok=True)
+        path = os.path.join(reg, f"{_sanitize(btid)}__{name}")
+        with open(path, "w"):
+            pass
+    except OSError:  # registry dir raced away: cleanup falls to the creator
+        logger.warning("could not register shm segment %s in %s", name, reg)
+
+
+def _deregister(name: str) -> None:
+    reg = os.environ.get(REGISTRY_ENV)
+    if not reg:
+        return
+    try:
+        for fn in os.listdir(reg):
+            if fn.partition("__")[2] == name:
+                try:
+                    os.remove(os.path.join(reg, fn))
+                except FileNotFoundError:
+                    pass
+    except OSError:
+        pass
+
+
+class ShmRing:
+    """Fixed-slot shared-memory ring with per-slot seqlock generations.
+
+    One process creates (and writes) the ring; any number of processes may
+    attach, but the protocol is single-writer / single-reader-per-slot —
+    exactly the shape ``DataPublisherSocket(shm=...)`` + PUSH/PULL gives.
+
+    Layout (offsets in bytes)::
+
+        0                magic  "BJXSHM1\\0"
+        8                u64    slots
+        16               u64    slot_bytes (aligned payload capacity)
+        24               u64[slots]  gen   (odd = write in progress)
+        24 + 8*slots     u64[slots]  ack   (last generation consumed)
+        align64(...)     slots * slot_bytes payload
+    """
+
+    def __init__(
+        self,
+        slots: int = 4,
+        slot_bytes: int = 0,
+        *,
+        name: str | None = None,
+        create: bool = True,
+        btid: object = None,
+    ) -> None:
+        self._closed = False
+        self._unlinked = False
+        self._cursor = 0
+        self.reclaims = 0
+        self._owner = bool(create)
+        if create:
+            slots = int(slots)
+            if slots < 1:
+                raise ValueError("ShmRing needs at least one slot")
+            slot_bytes = _align(max(int(slot_bytes), _ALIGN))
+            payload_off = _align(_HDR_BYTES + 16 * slots)
+            total = payload_off + slots * slot_bytes
+            self._shm = shared_memory.SharedMemory(
+                create=True, size=total, name=name,
+            )
+            buf = self._shm.buf
+            buf[:8] = _MAGIC
+            hdr = np.ndarray((2,), dtype=np.uint64, buffer=buf, offset=8)
+            hdr[0] = slots
+            hdr[1] = slot_bytes
+            _register(self._shm.name, btid if btid is not None else os.getpid())
+        else:
+            if not name:
+                raise ValueError("attach requires a segment name")
+            self._shm = shared_memory.SharedMemory(name=name)
+            buf = self._shm.buf
+            if bytes(buf[:8]) != _MAGIC:
+                self._shm.close()
+                raise ValueError(f"segment {name!r} is not a blendjax shm ring")
+            hdr = np.ndarray((2,), dtype=np.uint64, buffer=buf, offset=8)
+            slots = int(hdr[0])
+            slot_bytes = int(hdr[1])
+        _untrack(self._shm)
+        self.slots = slots
+        self.slot_bytes = slot_bytes
+        self._payload_off = _align(_HDR_BYTES + 16 * slots)
+        self._gen = np.ndarray(
+            (slots,), dtype=np.uint64, buffer=self._shm.buf, offset=_HDR_BYTES,
+        )
+        self._ack = np.ndarray(
+            (slots,), dtype=np.uint64, buffer=self._shm.buf,
+            offset=_HDR_BYTES + 8 * slots,
+        )
+
+    # -- identity ------------------------------------------------------------
+
+    @property
+    def name(self) -> str:
+        return self._shm.name
+
+    @classmethod
+    def attach(cls, name: str) -> "ShmRing":
+        return cls(name=name, create=False)
+
+    # -- writer side ---------------------------------------------------------
+
+    def _slot_view(self, slot: int, shape, dtype, off: int) -> np.ndarray:
+        base = self._payload_off + slot * self.slot_bytes + off
+        return np.ndarray(shape, dtype=dtype, buffer=self._shm.buf, offset=base)
+
+    def write(
+        self,
+        fields: dict[str, np.ndarray],
+        *,
+        timeout_s: float = 5.0,
+    ) -> dict:
+        """Copy ``fields`` into the next slot; return the wire descriptor.
+
+        Raises :class:`ShmCapacityError` *before* touching the slot when the
+        payload cannot fit, so an oversized message never tears a
+        generation.  Blocks (bounded by ``timeout_s``) while the slot's last
+        generation is unacknowledged, then reclaims it.
+        """
+        layout: list[tuple[str, np.ndarray, int]] = []
+        off = 0
+        for key, arr in fields.items():
+            arr = np.ascontiguousarray(arr)
+            layout.append((key, arr, off))
+            off = _align(off + arr.nbytes, 16)
+        if off > self.slot_bytes:
+            raise ShmCapacityError(
+                f"payload needs {off} bytes, slot holds {self.slot_bytes}"
+            )
+        slot = self._cursor
+        self._cursor = (slot + 1) % self.slots
+        gen = int(self._gen[slot])
+        if gen and int(self._ack[slot]) != gen:
+            deadline = time.monotonic() + timeout_s
+            while int(self._ack[slot]) != gen:
+                if time.monotonic() >= deadline:
+                    # Reader gone (kill -9) or hopelessly behind: reclaim.
+                    # The stale descriptor, if ever consumed, fails its
+                    # generation check and is counted wire.shm_torn there.
+                    self.reclaims += 1
+                    metrics.count("wire.shm_reclaims")
+                    break
+                time.sleep(0.0005)
+        self._gen[slot] = gen + 1  # odd: write in progress
+        desc_fields: list[list] = []
+        for key, arr, f_off in layout:
+            np.copyto(self._slot_view(slot, arr.shape, arr.dtype, f_off), arr)
+            desc_fields.append([key, arr.dtype.str, list(arr.shape), f_off])
+        self._gen[slot] = gen + 2  # even: stable
+        return {
+            "n": self.name,
+            "s": slot,
+            "g": gen + 2,
+            "f": desc_fields,
+        }
+
+    def begin_write(self, slot: int) -> None:
+        """Test hook: mark ``slot`` write-in-progress (odd generation).
+
+        Simulates a writer killed mid-copy — ``read`` of any descriptor for
+        this slot reports torn until :meth:`end_write` runs.
+        """
+        self._gen[slot] = int(self._gen[slot]) + 1
+
+    def end_write(self, slot: int) -> int:
+        self._gen[slot] = int(self._gen[slot]) + 1
+        return int(self._gen[slot])
+
+    # -- reader side ---------------------------------------------------------
+
+    def read(self, desc: dict) -> dict[str, np.ndarray] | None:
+        """Copy the descriptor's fields out of the ring; ``None`` when torn.
+
+        Torn covers every unsafe case: generation odd (write in progress or
+        writer died mid-copy), generation behind/ahead of the descriptor
+        (slot reclaimed), or a concurrent overwrite detected by the re-check
+        after the copy.  A successful read acknowledges the generation so
+        the writer may reuse the slot.
+        """
+        slot = int(desc["s"])
+        gen = int(desc["g"])
+        if slot < 0 or slot >= self.slots:
+            return None
+        if int(self._gen[slot]) != gen or gen % 2:
+            return None
+        out: dict[str, np.ndarray] = {}
+        for key, dtype_str, shape, off in desc["f"]:
+            src = self._slot_view(slot, tuple(shape), np.dtype(dtype_str), off)
+            out[key] = src.copy()
+        if int(self._gen[slot]) != gen:
+            return None  # overwritten mid-copy
+        self._ack[slot] = gen
+        return out
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        # numpy views pin the mmap's exported buffer; drop them first
+        self._gen = None
+        self._ack = None
+        try:
+            self._shm.close()
+        except BufferError:  # pragma: no cover - stray external view
+            pass
+
+    def unlink(self) -> None:
+        """Remove the segment name; idempotent (safe to race the launcher)."""
+        if self._unlinked:
+            return
+        self._unlinked = True
+        _deregister(self._shm.name)
+        try:
+            _unlink_quietly(self._shm)
+        except FileNotFoundError:
+            pass
+
+    def __enter__(self) -> "ShmRing":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+        if self._owner:
+            self.unlink()
+
+
+# -- attach cache (consumer side) -------------------------------------------
+
+_attach_lock = threading.Lock()
+_attached: dict[str, ShmRing] = {}
+_attach_failed: set[str] = set()
+
+
+def attach_ring(name: str) -> ShmRing | None:
+    """Attach to ``name``, caching the handle per process.
+
+    Returns ``None`` (once-logged) when the segment no longer exists — the
+    producer died and the launcher reaped it before we ever attached; the
+    caller treats the message as torn.
+    """
+    with _attach_lock:
+        ring = _attached.get(name)
+        if ring is not None:
+            return ring
+        if name in _attach_failed:
+            return None
+        try:
+            ring = ShmRing.attach(name)
+        except (FileNotFoundError, ValueError, OSError) as e:
+            _attach_failed.add(name)
+            logger.warning("cannot attach shm segment %s: %s", name, e)
+            return None
+        _attached[name] = ring
+        return ring
+
+
+def detach_all() -> None:
+    """Close every cached attach handle (tests / consumer teardown)."""
+    with _attach_lock:
+        rings = list(_attached.values())
+        _attached.clear()
+        _attach_failed.clear()
+    for ring in rings:
+        ring.close()
+
+
+def resolve_message(msg: dict) -> dict:
+    """Resolve a ``_shm`` descriptor in a decoded message, in place.
+
+    On success the slot's arrays are copied out and merged into ``msg``.  A
+    torn generation (or a vanished segment) counts ``wire.shm_torn`` and
+    returns the message with a ``_shm_torn`` marker instead — the lineage
+    stamps rode the descriptor and arrived intact, so the caller still
+    ingests them (no phantom seq gaps) before dropping the payload.
+    """
+    desc = msg.pop("_shm", None)
+    if desc is None:
+        return msg
+    out = None
+    ring = attach_ring(desc["n"])
+    if ring is not None:
+        try:
+            out = ring.read(desc)
+        except (IndexError, ValueError, TypeError):
+            out = None
+    if out is None:
+        metrics.count("wire.shm_torn")
+        msg["_shm_torn"] = True
+        return msg
+    nbytes = 0
+    for key, arr in out.items():
+        msg[key] = arr
+        nbytes += arr.nbytes
+    metrics.count("wire.shm_reads")
+    metrics.count("wire.shm_bytes", nbytes)
+    return msg
+
+
+# -- registry reaping (launcher side) ---------------------------------------
+
+def unlink_segment(name: str) -> bool:
+    """Unlink a segment by name; ``True`` if it existed."""
+    try:
+        seg = shared_memory.SharedMemory(name=name)
+    except FileNotFoundError:
+        return False
+    except OSError:
+        return False
+    _untrack(seg)
+    seg.close()
+    try:
+        _unlink_quietly(seg)
+    except FileNotFoundError:
+        return False
+    return True
+
+
+def reap_registry(registry_dir: str, btid: object = None) -> int:
+    """Unlink every segment registered under ``registry_dir``.
+
+    With ``btid`` given, only that producer's segments are reaped (the
+    ``retire_instance`` path); otherwise everything goes (teardown).  Marker
+    files are removed either way, so a second pass is a no-op — this is what
+    makes "unlinked exactly once" hold across retire + teardown + atexit.
+    """
+    reaped = 0
+    try:
+        entries = os.listdir(registry_dir)
+    except OSError:
+        return 0
+    prefix = None if btid is None else f"{_sanitize(btid)}__"
+    for fn in entries:
+        if "__" not in fn:
+            continue
+        if prefix is not None and not fn.startswith(prefix):
+            continue
+        if unlink_segment(fn.partition("__")[2]):
+            reaped += 1
+        try:
+            os.remove(os.path.join(registry_dir, fn))
+        except FileNotFoundError:
+            pass
+        except OSError:
+            pass
+    return reaped

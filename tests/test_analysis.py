@@ -1259,7 +1259,7 @@ def test_every_rule_registered():
         "BJX101", "BJX102", "BJX103", "BJX104", "BJX105", "BJX106",
         "BJX107", "BJX108", "BJX109", "BJX110", "BJX111", "BJX112",
         "BJX113", "BJX114", "BJX115", "BJX116", "BJX117", "BJX118",
-        "BJX119", "BJX120", "BJX121", "BJX122",
+        "BJX119", "BJX120", "BJX121", "BJX122", "BJX125",
     }
 
 
