@@ -223,6 +223,24 @@ MULTICHIP_TIME_CAP_S = float(
 MULTICHIP_PASSES = int(
     os.environ.get("BLENDJAX_BENCH_MULTICHIP_PASSES", "2")
 )
+# Device-ledger row (docs/performance.md "Reading the device ledger"):
+# the blendjax.obs.devledger contracts exercised live. Single-chip leg:
+# TrainDriver.build on synthetic in-memory batches — cost-model MFU
+# (ledger-derived flops_per_image) within 10% of the hand-fed
+# measure_model_flops probe on the SAME program, collective_bytes == 0,
+# device.retraces == 0 on the bucketed dispatch path and EXACTLY 1
+# (signature attributed) after a deliberately unbucketed shape is
+# injected. Mesh leg (subprocess, forced 8-device CPU mesh like
+# multichip_live): the data-parallel grad sync's all-reduce bytes must
+# match the analytic expectation (param bytes x policy dtype width).
+# Pure CPU — weather-independent; all four contracts CI-asserted.
+LIVE_DEVLEDGER = (
+    os.environ.get("BLENDJAX_BENCH_LIVE_DEVLEDGER", "1") == "1"
+)
+# When set, the full ledger report (per-signature entries + retrace
+# events) is written to this path beside the record — the
+# device_ledger.json artifact bench-smoke uploads.
+DEVLEDGER_EXPORT = os.environ.get("BLENDJAX_BENCH_DEVLEDGER_EXPORT", "")
 # Precision-policy A/B row (docs/performance.md "Raising the device
 # ceiling"): step-alone img/s + mfu_step_alone for the bf16-grads vs
 # bf16-compute policies, on BOTH the headline CNN and the longseq
@@ -716,7 +734,7 @@ def measure(encoding: str, chunk: int, items: int, time_cap: float,
                 k: int(v) for k, v in report["counters"].items()
                 if k.startswith(
                     ("tiles.", "ingest.", "pal.", "wire.", "train.",
-                     "feed.", "echo.")
+                     "feed.", "echo.", "device.")
                 )
             },
             # Occupancy gauges beside the counters: queue_full_waits
@@ -726,7 +744,9 @@ def measure(encoding: str, chunk: int, items: int, time_cap: float,
             # distinguishable in the record.
             "gauges": {
                 k: v for k, v in report["gauges"].items()
-                if k.startswith(("ingest.", "feed.", "train.", "echo."))
+                if k.startswith(
+                    ("ingest.", "feed.", "train.", "echo.", "device.")
+                )
             },
             # Observe-only histograms (spans already carry their own
             # percentiles above): the driver's device-timeline step
@@ -963,31 +983,30 @@ def measure_pipelined_ceiling(chunk: int, items: int = 512,
     return out
 
 
-# Peak dense bf16 throughput of one TPU v5e chip (197 TFLOP/s,
-# public spec) — the denominator weather can't move (VERDICT r3 next
-# #7: a FLOPs-based MFU row beside the throughput-ratio utilization).
-V5E_PEAK_FLOPS = 197e12
-
-
-_FLOPS_MEMO: dict = {}
+# The cost-model FLOPs probe lives in the device ledger now
+# (blendjax.obs.devledger — one home for the path; the drivers derive
+# live MFU numerators from the same cost_analysis() figures). Bench
+# imports it back; memoization is keyed by model class + geometry
+# inside the ledger module, so the per-class one-extra-lowering cost
+# is unchanged. Import-cheap: devledger pulls no jax at module level.
+from blendjax.obs.devledger import (  # noqa: E402
+    V5E_PEAK_FLOPS,
+    measure_model_flops,
+)
 
 
 def _live_flops_per_image(model, loss_fn) -> float | None:
-    """``flops_per_image`` for a live driver's ``train.mfu`` gauge,
-    memoized per model class (one extra lowering per class per bench
-    run); None off-v5e (the gauge's peak denominator is chip-specific)
-    or when the cost analysis fails."""
+    """``flops_per_image`` for a live driver's ``train.mfu`` gauge;
+    None off-v5e (the gauge's peak denominator is chip-specific) or
+    when the cost analysis fails."""
     if not _is_v5e():
         return None
-    key = type(model).__name__
-    if key not in _FLOPS_MEMO:
-        try:
-            _FLOPS_MEMO[key] = measure_model_flops(
-                model=model, loss_fn=loss_fn, label=key
-            )["flops_per_image"]
-        except Exception:
-            _FLOPS_MEMO[key] = None
-    return _FLOPS_MEMO[key]
+    try:
+        return measure_model_flops(
+            model=model, loss_fn=loss_fn, label=type(model).__name__
+        )["flops_per_image"]
+    except Exception:
+        return None
 
 
 def _is_v5e() -> bool:
@@ -1001,47 +1020,6 @@ def _is_v5e() -> bool:
     return jax.default_backend() == "tpu" and (
         "v5e" in device_kind or "v5 lite" in device_kind
     )
-
-
-def measure_model_flops(model=None, loss_fn=None,
-                        label: str = "CubeRegressor fwd+bwd",
-                        shape=None, batch=None) -> dict:
-    """Fwd+bwd FLOPs per image of the benchmark step, from the compiled
-    executable's own cost analysis (XLA's count, not a hand estimate).
-
-    Always lowers the UNCHUNKED per-batch step: the per-image math is
-    identical at any chunk, and XLA's cost model counts a ``lax.scan``
-    body ONCE regardless of trip count, so the chunked program would
-    under-report per-image FLOPs by ~chunk (verified on this backend).
-    """
-    from blendjax.models import CubeRegressor
-    from blendjax.parallel import batch_sharding, create_mesh
-    from blendjax.train import make_supervised_step, make_train_state
-
-    shape = SHAPE if shape is None else shape
-    batch = BATCH if batch is None else batch
-    mesh = create_mesh({"data": -1})
-    state = make_train_state(
-        CubeRegressor() if model is None else model,
-        np.zeros((batch, *shape, 4), np.uint8), mesh=mesh,
-    )
-    step = make_supervised_step(
-        mesh=mesh, batch_sharding=batch_sharding(mesh), loss_fn=loss_fn
-    )
-    sb = {
-        "image": np.zeros((batch, *shape, 4), np.uint8),
-        "xy": np.zeros((batch, 8, 2), np.float32),
-    }
-    ca = step.lower(state, sb).compile().cost_analysis()
-    ca = ca[0] if isinstance(ca, (list, tuple)) else ca
-    flops = float(ca["flops"])
-    return {
-        "flops_per_image": round(flops / batch),
-        "model": label,
-        "source": "compiled.cost_analysis() (unchunked step)",
-        "chip": "TPU v5e",
-        "peak_flops": V5E_PEAK_FLOPS,
-    }
 
 
 def _transformer_model_and_loss():
@@ -2916,6 +2894,261 @@ def _multichip_live_main() -> None:
     print(json.dumps(_multichip_live_legs()))
 
 
+def measure_live_device_ledger() -> dict:
+    """The device-ledger contracts (blendjax.obs.devledger) exercised
+    live on synthetic in-memory batches — no producers, pure CPU.
+
+    Single-chip leg (this process): ``TrainDriver.build`` registers the
+    AOT step set with the ledger, so the driver's MFU numerator comes
+    from XLA's own cost model; the row measures one settled dispatch
+    rate and computes BOTH MFU figures from it — cost-model
+    (ledger-derived ``flops_per_image``) and hand-fed
+    (``measure_model_flops`` on the identical architecture/geometry) —
+    asserting they agree within 10%. ``device.collective_bytes`` must
+    read 0 (nothing to sync on one chip), ``device.retraces`` 0 across
+    the bucketed dispatches and EXACTLY 1 (signature attributed) after
+    a deliberately unbucketed shape is injected twice (the second
+    dispatch is a jit cache hit — a second count would mean the audit
+    miscounts).
+
+    Mesh leg (subprocess, ``bench.py --devledger-mesh``): the 8-device
+    CPU mesh's data-parallel grad sync, where the ledger's HLO parse
+    must report a nonzero all-reduce byte count matching the analytic
+    expectation — param bytes x policy dtype width (+ the f32 loss
+    scalar's own all-reduce).
+    """
+    from blendjax.models import CubeRegressor
+    from blendjax.obs.devledger import ledger, measure_model_flops
+    from blendjax.train.driver import TrainDriver
+    from blendjax.utils.metrics import metrics as reg
+
+    reg.reset()
+    ledger.reset()
+    shape, batch = (32, 32), BATCH
+    model = CubeRegressor(features=(4,))
+    full = {
+        "image": np.zeros((batch, *shape, 4), np.uint8),
+        "xy": np.zeros((batch, 8, 2), np.float32),
+    }
+    # explicit peak: CPU has no known-chip default, and the MFU gauge
+    # needs a denominator — its VALUE is meaningless off-accelerator,
+    # but both MFU figures share it, so the agreement contract holds
+    # on any host
+    drv = TrainDriver.build(
+        model, full, aot=True, buckets=(4,),
+        inflight=2, sync_every=0, peak_flops=1e12,
+    )
+    fpi_cost = drv.flops_per_image
+    hand = measure_model_flops(
+        model=CubeRegressor(features=(4,)),
+        label="CubeRegressor devledger", shape=shape, batch=batch,
+        memo=False,
+    )
+    fpi_hand = float(hand["flops_per_image"])
+
+    # settled dispatch rate over the bucketed (compiled) path: full
+    # batches plus one padded partial tail, the shapes the ladder holds
+    from blendjax.data.batcher import pad_to_bucket
+
+    steps = 24
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        drv.submit(dict(full))
+    tail = {
+        "image": np.zeros((3, *shape, 4), np.uint8),
+        "xy": np.zeros((3, 8, 2), np.float32),
+        "_partial": True,
+    }
+    drv.submit(pad_to_bucket(tail, buckets=(4,)))
+    drv.drain()
+    dt = max(time.perf_counter() - t0, 1e-9)
+    rate = drv.images_retired / dt
+    snap = reg.report()
+    retraces_bucketed = int(snap["counters"].get("device.retraces", 0))
+    collective_single = int(
+        snap["gauges"].get("device.collective_bytes", -1)
+    )
+
+    # the deliberate retrace: lead 6 is in no ladder and carries no
+    # `_partial` flag, so it reaches the fallback jit and compiles
+    bad = {
+        "image": np.zeros((6, *shape, 4), np.uint8),
+        "xy": np.zeros((6, 8, 2), np.float32),
+    }
+    drv.submit(dict(bad))
+    drv.submit(dict(bad))  # cache hit: must NOT count again
+    drv.drain()
+    snap = reg.report()
+    retraces_after = int(snap["counters"].get("device.retraces", 0))
+    events = ledger.report()["retraces"]["events"]
+    offending = events[-1]["signature"] if events else None
+
+    mfu_cost = rate * fpi_cost / drv.peak_flops if fpi_cost else None
+    mfu_hand = rate * fpi_hand / drv.peak_flops
+    rel_err = (
+        abs(mfu_cost - mfu_hand) / mfu_hand if mfu_cost else None
+    )
+    row = {
+        "mfu_source": drv.mfu_source,
+        "flops_per_image_cost_model": fpi_cost,
+        "flops_per_image_hand_fed": fpi_hand,
+        "mfu_cost_model": mfu_cost,
+        "mfu_hand_fed": mfu_hand,
+        "mfu_rel_err": round(rel_err, 4) if rel_err is not None else None,
+        "mfu_within_tol": rel_err is not None and rel_err <= 0.10,
+        "collective_bytes_single_chip": collective_single,
+        "retraces_bucketed": retraces_bucketed,
+        "retraces_after_inject": retraces_after,
+        "retrace_contract": (
+            retraces_bucketed == 0 and retraces_after == 1
+        ),
+        "offending_signature": offending,
+        "signature_attributed": bool(
+            offending and "(6," in offending
+        ),
+        "hbm_peak_bytes": snap["gauges"].get("device.hbm_peak_bytes"),
+        "ledger_entries": len(ledger.report()["entries"]),
+        "img_s": round(rate, 1),
+    }
+    row["value"] = row["mfu_rel_err"]
+    row["mesh"] = _devledger_mesh_subprocess()
+    mesh = row["mesh"]
+    row["mesh_all_reduce_ok"] = bool(
+        isinstance(mesh, dict) and mesh.get("within_tol")
+    )
+    if DEVLEDGER_EXPORT:
+        try:
+            with open(DEVLEDGER_EXPORT, "w", encoding="utf-8") as f:
+                json.dump(
+                    {
+                        "single_chip": ledger.report(),
+                        "mesh": mesh,
+                        "contracts": {
+                            k: row[k]
+                            for k in (
+                                "mfu_within_tol", "retrace_contract",
+                                "collective_bytes_single_chip",
+                                "mesh_all_reduce_ok",
+                            )
+                        },
+                    },
+                    f, default=str, indent=2,
+                )
+        except OSError as e:
+            row["export_error"] = repr(e)[:200]
+    return row
+
+
+def _devledger_mesh_subprocess(timeout_s: float = 300.0) -> dict:
+    """Run the mesh half of the ledger row in a subprocess on a forced
+    8-device CPU mesh (``bench.py --devledger-mesh``) — same dance as
+    ``measure_multichip_live``: the parent's backend is already
+    initialized with the real topology."""
+    import subprocess
+
+    try:
+        proc = subprocess.run(
+            [
+                sys.executable, os.path.abspath(__file__),
+                "--devledger-mesh",
+            ],
+            capture_output=True, text=True, timeout=timeout_s,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+        )
+    except Exception as e:
+        return {"error": repr(e)[:200]}
+    lines = [
+        ln for ln in (proc.stdout or "").strip().splitlines()
+        if ln.startswith("{")
+    ]
+    if proc.returncode != 0 or not lines:
+        return {
+            "error": (
+                f"rc={proc.returncode} "
+                f"stderr={(proc.stderr or '')[-300:]}"
+            )
+        }
+    return json.loads(lines[-1])
+
+
+def _devledger_mesh_main() -> None:
+    """``bench.py --devledger-mesh`` entry: 8-device CPU data mesh,
+    ``MeshTrainDriver.build`` with a SHARDED aot batch (the executable
+    must see the live batch layout, or XLA compiles the replicated
+    no-collectives program), then check the ledger's all-reduce byte
+    count against the analytic DP grad-sync expectation."""
+    import jax
+
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            f"{flags} --xla_force_host_platform_device_count=8"
+        ).strip()
+    jax.config.update("jax_platforms", "cpu")
+
+    from blendjax.models import CubeRegressor
+    from blendjax.obs.devledger import ledger
+    from blendjax.parallel import batch_sharding, create_mesh
+    from blendjax.train.mesh_driver import MeshTrainDriver
+    from blendjax.utils.metrics import metrics as reg
+
+    n_dev = 8
+    mesh = create_mesh({"data": n_dev}, devices=jax.devices()[:n_dev])
+    bs = batch_sharding(mesh)
+    shape, batch = (16, 16), 8
+    img = np.zeros((batch, *shape, 4), np.uint8)
+    aot_batch = {
+        "image": jax.device_put(img, bs),
+        "xy": jax.device_put(
+            np.zeros((batch, 8, 2), np.float32), bs
+        ),
+    }
+    drv = MeshTrainDriver.build(
+        CubeRegressor(features=(4,), dtype=jax.numpy.float32), mesh,
+        img, aot=True, aot_batch=aot_batch, buckets=(batch,),
+        sync_every=0, inflight=2,
+    )
+    param_bytes = sum(
+        int(np.prod(p.shape)) * p.dtype.itemsize
+        for p in jax.tree_util.tree_leaves(drv.state.params)
+    )
+    # a couple of live dispatches through the compiled sharded path:
+    # fallbacks/retraces here would mean the AOT layout didn't match
+    drv.submit(dict(aot_batch))
+    drv.submit(dict(aot_batch))
+    drv.drain()
+    snap = reg.report()
+    ar = int(snap["gauges"].get("device.collective.all_reduce_bytes", 0))
+    # analytic expectation: one all-reduce per grad leaf summing to the
+    # param bytes (x f32 width, already in itemsize), plus slack for
+    # the loss scalar's own sync and fusion rounding
+    tol = 64 + 0.02 * param_bytes
+    entries = ledger.report()["entries"]
+    per_axis = {}
+    for e in entries:
+        c = e.get("collectives")
+        if isinstance(c, dict) and c.get("per_axis"):
+            per_axis = c["per_axis"]
+    print(json.dumps({
+        "chips": drv.chips,
+        "all_reduce_bytes": ar,
+        "expected_param_bytes": param_bytes,
+        "tolerance_bytes": round(tol, 1),
+        "within_tol": abs(ar - param_bytes) <= tol,
+        "per_axis": per_axis,
+        "collective_bytes": int(
+            snap["gauges"].get("device.collective_bytes", 0)
+        ),
+        "mfu_source": drv.mfu_source,
+        "flops_per_image": drv.flops_per_image,
+        "aot_fallbacks": int(
+            snap["counters"].get("train.aot_fallbacks", 0)
+        ),
+        "retraces": int(snap["counters"].get("device.retraces", 0)),
+        "ledger": ledger.report(),
+    }, default=str))
+
+
 def measure_rl_hz(seconds: float = 3.0) -> dict:
     """Full REQ/REP rendezvous stepping rate, rendering off (the
     reference's '2000 Hz are easily achieved' row, ``Readme.md:95``;
@@ -3803,6 +4036,18 @@ def _build_record(progress: dict) -> dict:
             detail["multichip_live"] = measure_multichip_live()
         except Exception as e:  # pragma: no cover - spawn flake path
             detail["multichip_live"] = {"error": repr(e)[:200]}
+    if LIVE_DEVLEDGER:
+        # Device-ledger row (docs/performance.md "Reading the device
+        # ledger"): cost-model-vs-hand-fed MFU agreement, single-chip
+        # collective_bytes == 0, the exact-count retrace injection, and
+        # the 8-device mesh leg's analytic all-reduce byte contract.
+        # Pure CPU, weather-independent; all four CI-asserted, and the
+        # full ledger report ships as the device_ledger.json artifact
+        # (BLENDJAX_BENCH_DEVLEDGER_EXPORT).
+        try:
+            detail["live_device_ledger"] = measure_live_device_ledger()
+        except Exception as e:  # pragma: no cover - spawn flake path
+            detail["live_device_ledger"] = {"error": repr(e)[:200]}
     if ENCODING == "tile" and INGEST_AB and not degraded:
         # Sharded-ingest A/B (same weather regime as the headline): does
         # a second recv/decode worker raise end-to-end img/s on THIS
@@ -3957,6 +4202,8 @@ def main() -> None:
 if __name__ == "__main__":
     if "--multichip-live" in sys.argv:
         sys.exit(_multichip_live_main())
+    if "--devledger-mesh" in sys.argv:
+        sys.exit(_devledger_mesh_main())
     if "--live-resume-child" in sys.argv:
         sys.exit(_live_resume_child_main())
     if "--live-start-child" in sys.argv:

@@ -6,8 +6,12 @@ The observability layer over the streaming stack (docs/observability.md):
   staleness histograms, exact seq-gap/reorder counters, and the fleet
   telemetry view assembled from producer-piggybacked snapshots.
 - :mod:`blendjax.obs.doctor` — the stall doctor: classifies the current
-  bottleneck (producer-/wire-/decode-/feed-/step-bound) from one
-  metrics snapshot.
+  bottleneck (producer-/wire-/decode-/feed-/step-bound, plus the device
+  ledger's memory-bound / retrace-storm arms) from one metrics snapshot.
+- :mod:`blendjax.obs.devledger` — the device ledger: per-signature XLA
+  cost/memory accounting and collective-bytes breakdowns at compile
+  time, live HBM gauges at reporter ticks, and the per-dispatch retrace
+  audit — the ``device.*`` metric family.
 - :mod:`blendjax.obs.exporters` — Prometheus text over a stdlib HTTP
   endpoint, JSONL snapshot archives, Chrome/Perfetto trace export of
   span events.
@@ -33,7 +37,17 @@ producer processes (Blender's Python) can export their own metrics.
 
 from __future__ import annotations
 
+from blendjax.obs.devledger import (  # noqa: F401
+    ExecutableLedger,
+    RetraceAudit,
+    default_peak_flops,
+    ledger,
+    measure_model_flops,
+    parse_collectives,
+)
 from blendjax.obs.doctor import (  # noqa: F401
+    DEFAULT_HBM_HEADROOM_FLOOR,
+    DEFAULT_RETRACE_STORM,
     DEFAULT_STALE_WIRE_S,
     VERDICTS,
     Verdict,
@@ -77,6 +91,14 @@ __all__ = [
     "FlightRecorder",
     "Slo",
     "SloWatchdog",
+    "ExecutableLedger",
+    "RetraceAudit",
+    "default_peak_flops",
+    "ledger",
+    "measure_model_flops",
+    "parse_collectives",
+    "DEFAULT_HBM_HEADROOM_FLOOR",
+    "DEFAULT_RETRACE_STORM",
     "DEFAULT_STALE_WIRE_S",
     "VERDICTS",
     "Verdict",

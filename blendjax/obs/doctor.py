@@ -32,6 +32,14 @@ echo-saturated  a data-echoing pipeline's draw loop blocked on its echo
                 budget (``echo.saturated_waits`` / ``echo.wait_fresh``):
                 echoing already absorbs all it may — raise producers,
                 reservoir capacity, or ``max_echo_factor``
+retrace-storm   compiles recurring past warm-up: the device ledger's
+                retrace audit counted ``device.retraces`` dispatches
+                whose batch signature missed every compiled shape —
+                each one re-traces and re-compiles mid-run
+memory-bound    HBM headroom collapsing (``device.hbm_headroom_frac``
+                below the floor), with the ledger's static accounting
+                (``device.temp_bytes`` vs ``device.hbm_peak_bytes``)
+                naming whether temporaries or resident state dominate
 ==============  ============================================================
 
 plus ``balanced`` (no single stage dominates — the healthy verdict) and
@@ -54,6 +62,8 @@ import dataclasses
 # Verdict kinds, in the order the decision procedure tests them.
 VERDICTS = (
     "compile-bound",
+    "retrace-storm",
+    "memory-bound",
     "step-bound",
     "feed-bound",
     "decode-bound",
@@ -69,6 +79,16 @@ VERDICTS = (
 # milliseconds; a quarter second of age on arrival means the frames
 # existed long before we got them.
 DEFAULT_STALE_WIRE_S = 0.25
+
+# device.retraces at or above which recurring mid-run recompiles read as
+# a storm: one or two can be a legitimately novel shape; three means
+# shapes keep missing the compiled ladder.
+DEFAULT_RETRACE_STORM = 3
+
+# device.hbm_headroom_frac below which the run reads memory-bound: under
+# ~8% free, allocator fragmentation alone can OOM a step whose peak fits
+# on paper.
+DEFAULT_HBM_HEADROOM_FLOOR = 0.08
 
 
 @dataclasses.dataclass(frozen=True)
@@ -103,6 +123,8 @@ def diagnose(
     staleness_p95_s: float | None = None,
     stale_wire_s: float = DEFAULT_STALE_WIRE_S,
     prefetch: int | None = None,
+    retrace_storm: int = DEFAULT_RETRACE_STORM,
+    hbm_headroom_floor: float = DEFAULT_HBM_HEADROOM_FLOOR,
 ) -> Verdict:
     """Classify one :meth:`blendjax.utils.metrics.Metrics.report`
     snapshot. ``driver`` is an optional ``TrainDriver.stats`` dict;
@@ -191,6 +213,53 @@ def diagnose(
             "(TrainDriver.build(aot=True, aot_cache_dir=...)); warm "
             "restarts then pay milliseconds — see docs/performance.md "
             "'Instant start'",
+            shares,
+        )
+
+    # 0b. retrace-storm: the device ledger's audit counted dispatches
+    #     whose batch signature missed every compiled shape — each one
+    #     re-traces and re-compiles MID-RUN (unlike arm 0's one-time
+    #     cold start). Checked before step-bound: a storm's compile
+    #     stalls produce ring waits and full queues too, and the lever
+    #     is shape hygiene, not a faster step.
+    retraces = int(counters.get("device.retraces", 0))
+    if retraces >= max(1, int(retrace_storm)):
+        return Verdict(
+            "retrace-storm",
+            f"device.retraces={retraces} (threshold {retrace_storm}): "
+            "batch shapes keep missing the compiled ladder and "
+            "re-compile mid-run — the ledger's retrace events name the "
+            "offending signatures",
+            "bucket the ragged tails (pad_to_bucket / driver "
+            "pad_partial=True), widen buckets= to cover the observed "
+            "shapes, or AOT-compile the full ladder "
+            "(TrainDriver.build(aot=True))",
+            shares,
+        )
+
+    # 0c. memory-bound: live HBM headroom collapsing (the reporter-tick
+    #     device.memory_stats() poll). Before step-bound for the same
+    #     reason: an allocator running at the wall thrashes and stalls
+    #     dispatches, and the fix is memory, not compute.
+    headroom = gauges.get("device.hbm_headroom_frac")
+    if headroom is not None and float(headroom) < hbm_headroom_floor:
+        temp = float(gauges.get("device.temp_bytes", 0) or 0)
+        peak = float(gauges.get("device.hbm_peak_bytes", 0) or 0)
+        temp_dominant = peak > 0 and temp / peak > 0.5
+        culprit = (
+            "step temporaries dominate the compiled peak "
+            f"(temp {temp / peak:.0%} of it)" if temp_dominant
+            else "resident state (params/optimizer/batches), not step "
+            "temporaries, holds the memory"
+        )
+        return Verdict(
+            "memory-bound",
+            f"device.hbm_headroom_frac={float(headroom):.1%} < floor "
+            f"{hbm_headroom_floor:.0%}: {culprit}",
+            "shrink batch/chunk or remat the step if temporaries "
+            "dominate; shard state over the mesh (fsdp) or drop "
+            "optimizer precision if resident state does — see "
+            "docs/performance.md 'Reading the device ledger'",
             shares,
         )
 

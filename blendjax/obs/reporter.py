@@ -119,10 +119,24 @@ class StatsReporter:
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
         self.last_verdict = None
+        # The reporter tick is the device ledger's runtime cadence: the
+        # HBM poll runs before each snapshot, and the ledger's retrace
+        # storm can trip this reporter's flight recorder.
+        from blendjax.obs.devledger import ledger as _ledger
+
+        self.ledger = _ledger
+        if self.flight is not None:
+            self.ledger.attach_flight(self.flight)
 
     def tick(self):
         """One report cycle (public so tests — and callers that want a
         verdict NOW — can run it synchronously)."""
+        try:
+            # device.hbm_* gauges land in the snapshot below; a no-stats
+            # backend (CPU) returns None without publishing
+            self.ledger.poll_memory(self.registry)
+        except Exception:
+            self.log.exception("device memory poll failed")
         report = self.registry.report()
         driver = self.driver_stats() if callable(self.driver_stats) else None
         verdict = diagnose(
@@ -173,6 +187,19 @@ class StatsReporter:
             }
             if echo:
                 extra["echo"] = echo
+            # Device ledger family beside the verdict: the static
+            # compile-time accounting gauges plus the live HBM poll and
+            # retrace counter, so a JSONL trail answers "what did the
+            # device look like when the verdict flipped".
+            device = {
+                k: v
+                for src in (report.get("counters", {}),
+                            report.get("gauges", {}))
+                for k, v in src.items()
+                if k.startswith("device.")
+            }
+            if device:
+                extra["device"] = device
             self._jsonl.write(report, extra=extra)
         return verdict
 

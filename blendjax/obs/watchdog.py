@@ -353,6 +353,17 @@ class FlightRecorder:
             "report": frame_tracer.report(),
             "records": frame_tracer.records()[-self.keep_traces:],
         }))
+
+        def _device_ledger(p):
+            from blendjax.obs.devledger import ledger
+
+            with open(p, "w", encoding="utf-8") as f:
+                json.dump(ledger.report(), f, default=str, indent=2)
+
+        # per-signature cost/memory/collective accounting + retrace
+        # events + last HBM sample — what the device was doing when the
+        # breach (or retrace storm) fired
+        _write("device_ledger.json", _device_ledger)
         if self.checkpoint is not None:
             def _ckpt_arm(p):
                 result = self.checkpoint()

@@ -40,6 +40,7 @@ import jax
 import numpy as np
 
 from blendjax.data.batcher import bucket_sizes
+from blendjax.obs.devledger import ledger
 from blendjax.utils.metrics import metrics
 
 logger = logging.getLogger(__name__)
@@ -199,12 +200,30 @@ def batch_specs_for_ladder(
     ladder = tuple(buckets) if buckets else bucket_sizes(lead)
     specs = []
 
+    def _field_sharding(v, shape):
+        """Carry the example batch's committed sharding into the spec —
+        a mesh run's live batches arrive sharded over the data axis,
+        and an executable lowered against a replicated batch is a
+        different program (no grad-sync collectives, rejected layouts
+        at dispatch). Only reused when the bucketed lead still divides
+        over it; numpy example batches have no sharding and lower
+        exactly as before."""
+        sharding = getattr(v, "sharding", None)
+        if sharding is not None:
+            try:
+                sharding.shard_shape(tuple(shape))
+            except Exception:
+                sharding = None
+        return sharding
+
     def _spec(size: int, with_mask: bool) -> dict:
-        out = {
-            k: jax.ShapeDtypeStruct((size,) + tuple(v.shape[1:]),
-                                    np.dtype(v.dtype))
-            for k, v in fields.items()
-        }
+        out = {}
+        for k, v in fields.items():
+            shape = (size,) + tuple(v.shape[1:])
+            out[k] = jax.ShapeDtypeStruct(
+                shape, np.dtype(v.dtype),
+                sharding=_field_sharding(v, shape),
+            )
         if with_mask:
             out["_mask"] = jax.ShapeDtypeStruct((size,), np.dtype(np.float32))
         return out
@@ -243,6 +262,7 @@ class AotStepSet:
         self.compile_ms = compile_ms
         self.cache_hits = cache_hits
         self.cache_misses = cache_misses
+        self.ledger_entries: list = []
         self._warned: set = set()
 
     @property
@@ -276,6 +296,8 @@ def build_aot_step(
     buckets: tuple | list | None = None,
     cache_dir: str | None = None,
     key: str | None = None,
+    mesh=None,
+    ledger_name: str = "aot_step",
 ) -> AotStepSet:
     """Compile ``step`` for every ladder signature before step 0.
 
@@ -286,6 +308,13 @@ def build_aot_step(
     the keyed manifest decides hit/miss per signature — a warm manifest
     entry means XLA will be served from disk, and ``train.aot_cache_hits``
     counts it; a cold one counts ``train.aot_cache_misses``.
+
+    Every compiled executable is registered with the device ledger
+    (cost/memory/collective accounting published as ``device.*`` gauges;
+    ``mesh`` enables per-axis collective attribution) — the entries land
+    on ``AotStepSet.ledger_entries`` so the drivers can derive the
+    cost-model MFU numerator. Registration is accounting only and can
+    never fail the build.
     """
     manifest: dict = {}
     seen: set = set()
@@ -323,4 +352,11 @@ def build_aot_step(
         "aot step set: %d signatures compiled in %.0f ms (%d warm, %d cold)",
         len(compiled), compile_ms, hits, misses,
     )
-    return AotStepSet(step, compiled, compile_ms, hits, misses)
+    step_set = AotStepSet(step, compiled, compile_ms, hits, misses)
+    try:
+        step_set.ledger_entries = ledger.register_aot_set(
+            ledger_name, compiled, mesh=mesh
+        )
+    except Exception:  # pragma: no cover - accounting must not fail builds
+        logger.debug("device ledger registration failed", exc_info=True)
+    return step_set

@@ -25,11 +25,13 @@ from __future__ import annotations
 # outputs inside this module's dispatch loops)
 
 import collections
+import logging
 import threading
 import time
 
 import numpy as np
 
+from blendjax.obs.devledger import RetraceAudit, default_peak_flops
 from blendjax.obs.trace import (
     TERMINAL_STAGE,
     pop_traces as trace_pop,
@@ -37,6 +39,19 @@ from blendjax.obs.trace import (
     tracer,
 )
 from blendjax.utils.metrics import metrics
+
+logger = logging.getLogger(__name__)
+
+_LOGGED_ONCE: set = set()
+
+
+def _log_once(fn, msg: str, *args) -> None:
+    """Per-process dedup for build-time knob advice — a bench that
+    constructs dozens of drivers should name a missing knob once, not
+    once per leg."""
+    if msg not in _LOGGED_ONCE:
+        _LOGGED_ONCE.add(msg)
+        fn(msg, *args)
 
 
 class TrainDriver:
@@ -72,12 +87,14 @@ class TrainDriver:
     feeding the ``train.step_device_ms`` histogram — an upper bound on
     per-step device latency that converges on it while the ring cycles
     (a finished entry is examined again within one submit). Given
-    ``flops_per_image`` (the bench measures it via
-    ``compiled.cost_analysis()`` — ``measure_model_flops``) and
-    ``peak_flops``, retirements additionally maintain a live
-    ``train.mfu`` gauge (retired images/s x flops_per_image /
-    peak_flops over ~1 s windows), so MFU is an always-on run metric
-    the SLO watchdog can bound, not just a bench artifact.
+    ``flops_per_image`` (hand-fed, or derived by :meth:`build` from
+    the device ledger's ``compiled.cost_analysis()`` entries —
+    :mod:`blendjax.obs.devledger`) and ``peak_flops`` (explicit, or
+    defaulted from the known-chip peaks table), retirements
+    additionally maintain a live ``train.mfu`` gauge (retired
+    images/s x flops_per_image / peak_flops over ~1 s windows), so
+    MFU is an always-on run metric the SLO watchdog can bound, not
+    just a bench artifact.
     """
 
     def __init__(self, step, state, inflight: int = 4,
@@ -107,6 +124,16 @@ class TrainDriver:
             float(flops_per_image) if flops_per_image else None
         )
         self.peak_flops = float(peak_flops) if peak_flops else None
+        # Where the MFU numerator came from: "hand-fed" (caller knob),
+        # "cost-model" (build() derived it from the device ledger's
+        # cost_analysis entries), or None (gauge off).
+        self.mfu_source = "hand-fed" if self.flops_per_image else None
+        self._resolve_peak_flops()
+        # Retrace audit (blendjax.obs.devledger): watches the step's
+        # jit dispatch cache per submit — on the AOT path that is the
+        # fallback jit, so any growth IS the unbucketed-shape signal.
+        # None when the step isn't a watchable jit wrapper.
+        self.retrace_audit = RetraceAudit.for_step(step)
         # Checkpointing (blendjax.checkpoint, docs/checkpointing.md):
         # every `checkpoint_every` steps — and whenever
         # request_checkpoint() was called from any thread — submit()
@@ -144,6 +171,34 @@ class TrainDriver:
         self._t_created = time.monotonic()
         self._t_first_retire: float | None = None
         self.startup_ms: float | None = None
+
+    def _resolve_peak_flops(self) -> None:
+        """The ``train.mfu`` gauge needs BOTH knobs; historically
+        ``flops_per_image`` without ``peak_flops`` silently published
+        nothing. Now the denominator defaults from the known-chip peaks
+        table (x ``self.chips`` on mesh drivers) when the backend is
+        identifiable, and otherwise the missing knob is named once at
+        build time instead of the gauge vanishing without a word."""
+        if not self.flops_per_image or self.peak_flops:
+            return
+        chips = max(1, int(getattr(self, "chips", 1) or 1))
+        default = default_peak_flops()
+        if default:
+            peak, label = default
+            self.peak_flops = peak * chips
+            _log_once(
+                logger.info,
+                "train.mfu: peak_flops defaulted to %.4g "
+                "(%s known-chip peak x %d chip(s))",
+                self.peak_flops, label, chips,
+            )
+        else:
+            _log_once(
+                logger.warning,
+                "train.mfu gauge disabled: flops_per_image is set but "
+                "peak_flops=None and this backend's chip is not in the "
+                "known-peaks table — pass peak_flops= to the driver",
+            )
 
     @classmethod
     def build(cls, model, example_batch, *, loss_fn=None, optimizer=None,
@@ -200,14 +255,48 @@ class TrainDriver:
                 key=cache_key(
                     model=model, precision=precision, buckets=buckets,
                 ) if aot_cache_dir else None,
+                ledger_name=f"{type(model).__name__}.supervised_step",
             )
         drv = cls(step, state, **driver_kwargs)
+        drv._adopt_cost_model_flops(step, example_batch)
         drv._t_created = t0  # cold-start clock starts at build entry
         drv.startup_ms = (time.monotonic() - t0) * 1e3
         drv.resumed_session = session
         if isinstance(session, dict) and session.get("driver"):
             drv.load_state_dict(session["driver"])
         return drv
+
+    def _adopt_cost_model_flops(self, step, example_batch,
+                                entries=None) -> None:
+        """Cost-model MFU numerator from the device ledger: when the
+        caller hand-fed no ``flops_per_image``, the AOT build's ledger
+        entries already hold XLA's own FLOPs count per signature — use
+        the full-batch entry's flops / batch as the numerator (hand-fed
+        stays the override). Accounting only; never fails a build."""
+        if self.flops_per_image:
+            return
+        try:
+            if entries is None:
+                entries = getattr(step, "ledger_entries", None) or []
+            entries = [
+                e for e in entries
+                if isinstance(e.get("flops"), float) and e.get("batch_images")
+            ]
+            if not entries:
+                return
+            lead = int(np.shape(example_batch["image"])[0])
+            match = [e for e in entries if e["batch_images"] == lead]
+            e = max(match or entries, key=lambda e: e["batch_images"])
+            # cost_analysis() counts the PER-DEVICE partitioned program;
+            # on a mesh the global batch spreads over `chips` devices,
+            # so total flops per image is per-device flops x chips /
+            # global batch (chips=1 single-chip: a plain ratio)
+            chips = max(1, int(getattr(self, "chips", 1) or 1))
+            self.flops_per_image = e["flops"] * chips / e["batch_images"]
+            self.mfu_source = "cost-model"
+            self._resolve_peak_flops()
+        except Exception:  # pragma: no cover - accounting-only path
+            logger.debug("cost-model flops adoption failed", exc_info=True)
 
     # -- ring ----------------------------------------------------------------
 
@@ -384,6 +473,10 @@ class TrainDriver:
         with metrics.span("train.dispatch"):
             self.state, m = self.step(self.state, batch)
         metrics.count("train.dispatches")
+        if self.retrace_audit is not None:
+            # cache-size delta AFTER the dispatch: growth past warm-up
+            # counts device.retraces and attributes this batch signature
+            self.retrace_audit.observe(batch)
         self.dispatches += 1
         self.steps += 1
         pending = self._pending
@@ -588,4 +681,5 @@ class TrainDriver:
             "checkpoints": self.checkpoints,
             "startup_ms": self.startup_ms,
             "time_to_first_step_ms": self.time_to_first_step_ms,
+            "mfu_source": self.mfu_source,
         }

@@ -308,6 +308,7 @@ class MeshTrainDriver(TrainDriver):
                 state, mesh, loss_fn=loss_fn, augment=augment,
                 augment_rng=augment_rng,
             )
+        ledger_entry = None
         if aot and not fused and aot_batch is not None:
             from blendjax.train.aot import build_aot_step, cache_key
 
@@ -318,8 +319,26 @@ class MeshTrainDriver(TrainDriver):
                 key=cache_key(
                     model=model, mesh=mesh, buckets=buckets,
                 ) if aot_cache_dir else None,
+                mesh=mesh,
+                ledger_name=f"{type(model).__name__}.mesh_supervised_step",
+            )
+        elif aot_batch is not None and not fused:
+            # Accounting-only registration for the non-AOT path: one
+            # extra lower+compile (served from the persistent cache on
+            # the first real dispatch) buys the mesh's per-collective
+            # byte breakdown + cost-model FLOPs at build time. Opt-in
+            # by passing aot_batch; guarded inside register_step.
+            from blendjax.obs.devledger import ledger
+
+            ledger_entry = ledger.register_step(
+                f"{type(model).__name__}.mesh_supervised_step",
+                step, state, aot_batch, mesh=mesh,
             )
         drv = cls(step, state, mesh, **driver_kwargs)
+        drv._adopt_cost_model_flops(
+            step, {"image": example_batch},
+            entries=[ledger_entry] if ledger_entry else None,
+        )
         drv._t_created = t0
         drv.startup_ms = (_time.monotonic() - t0) * 1e3
         return drv

@@ -89,6 +89,36 @@ def test_batch_specs_require_array_fields():
         batch_specs_for_ladder({"frameid": 3, "_seq": 0})
 
 
+def test_batch_specs_carry_committed_sharding():
+    """A mesh run's example batch arrives sharded over the data axis;
+    the ladder specs must keep that sharding — an executable lowered
+    against a replicated batch is a DIFFERENT program (no grad-sync
+    collectives) and rejects the live sharded layout at dispatch."""
+    import jax
+
+    from blendjax.parallel import batch_sharding, create_mesh
+
+    import numpy as _np
+
+    mesh = create_mesh({"data": -1})  # conftest forces 8 CPU devices
+    sharded = {
+        k: jax.device_put(v, batch_sharding(mesh))
+        for k, v in _batch().items()
+    }
+    n_dev = int(_np.prod(tuple(mesh.devices.shape)))
+    specs = batch_specs_for_ladder(sharded, buckets=(B, 4))
+    assert specs[0]["image"].sharding == sharded["image"].sharding
+    # a bucket the mesh still divides keeps the sharding (B == lead)
+    assert specs[1]["image"].sharding == sharded["image"].sharding
+    # a bucket the mesh can NOT divide (4 over 8 devices) drops it
+    # rather than compiling an executable no real batch could feed
+    if 4 % n_dev:
+        assert specs[2]["image"].sharding is None
+    # numpy example batches lower exactly as before: no sharding
+    plain = batch_specs_for_ladder(_batch(), buckets=(4,))
+    assert plain[0]["image"].sharding is None
+
+
 # -- AOT-vs-eager equality ----------------------------------------------------
 
 

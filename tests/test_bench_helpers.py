@@ -7,6 +7,17 @@ import pytest
 jax = pytest.importorskip("jax")
 
 
+def test_model_flops_is_the_ledger_probe():
+    """bench.py re-exports the device ledger's probe (the one home for
+    the cost-model path) — a second copy drifting in bench.py is how
+    the MFU denominator silently forks."""
+    import bench
+
+    from blendjax.obs import devledger
+
+    assert bench.measure_model_flops is devledger.measure_model_flops
+
+
 def test_model_flops_matches_analytic_count():
     """cost_analysis-derived FLOPs/img must agree with the analytic
     conv count — catches the lax.scan-body-counted-once class of bug
