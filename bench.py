@@ -241,6 +241,46 @@ LIVE_DEVLEDGER = (
 # events) is written to this path beside the record — the
 # device_ledger.json artifact bench-smoke uploads.
 DEVLEDGER_EXPORT = os.environ.get("BLENDJAX_BENCH_DEVLEDGER_EXPORT", "")
+# Model-parallel A/B row (docs/parallelism.md "Choosing a layout"):
+# the SAME model + deterministic f32 batch stream trained end-to-end
+# under each mesh layout on a forced 8-device CPU mesh (subprocess,
+# same dance as multichip_live), diffing throughput and the ledger's
+# per-axis collective bytes. Contracts CI asserts: final f32 loss
+# equal across every layout (the layouts are mathematically the same
+# program), dispatch_per_step == 1.0 on every leg, the pure-data leg
+# all-reduce-only, fsdp-axis bytes nonzero exactly on fsdp layouts
+# (param all-gather-on-use + grad sync), tp-axis bytes nonzero on tp
+# layouts, and the forced-HBM-budget leg: the replicated layout's
+# device.hbm_peak figure EXCEEDS the budget while data×fsdp fits and
+# still trains. Per-axis attribution is by replica-group size, so
+# contracts are only asserted on size-unambiguous layouts (the 2×2×2
+# leg reports bytes but is flagged attribution_ambiguous).
+MODEL_PARALLEL_AB = (
+    os.environ.get("BLENDJAX_BENCH_MODEL_PARALLEL", "1") == "1"
+)
+MODEL_PARALLEL_LAYOUTS = tuple(
+    v for v in os.environ.get(
+        "BLENDJAX_BENCH_MODEL_PARALLEL_LAYOUTS",
+        "data8,data2xfsdp4,data4xtp2,data2xfsdp2xtp2",
+    ).split(",") if v
+)
+MODEL_PARALLEL_STEPS = int(
+    os.environ.get("BLENDJAX_BENCH_MODEL_PARALLEL_STEPS", "6")
+)
+# f32 cross-layout loss tolerance: resharding reorders f32 reductions
+# (all-gather boundaries move), so "equal" means equal to reduction
+# rounding — 5e-5 is ~10x the observed drift, far below any real
+# divergence (a wrong program differs in the first decimal).
+MODEL_PARALLEL_LOSS_TOL = float(
+    os.environ.get("BLENDJAX_BENCH_MODEL_PARALLEL_LOSS_TOL", "5e-5")
+)
+# Forced per-device HBM budget (bytes) for the does-not-fit contract;
+# "auto" pins it to the midpoint of the replicated and fsdp legs'
+# measured device.hbm_peak figures, so the contract stays meaningful
+# as the bench model changes size.
+MODEL_PARALLEL_HBM_BUDGET = os.environ.get(
+    "BLENDJAX_BENCH_MODEL_PARALLEL_HBM_BUDGET", "auto"
+)
 # Precision-policy A/B row (docs/performance.md "Raising the device
 # ceiling"): step-alone img/s + mfu_step_alone for the bf16-grads vs
 # bf16-compute policies, on BOTH the headline CNN and the longseq
@@ -3149,6 +3189,282 @@ def _devledger_mesh_main() -> None:
     }, default=str))
 
 
+def _model_parallel_ab_legs(layouts=None, n_steps: int | None = None,
+                            batch: int = 16, shape=(16, 16)) -> dict:
+    """The in-process body of the ``model_parallel_ab`` row: one
+    CubeRegressor, one deterministic f32 batch stream, trained
+    end-to-end under each requested mesh layout; the legs diff
+    throughput and the ledger's per-kind/per-axis collective bytes
+    while the contracts pin that every layout computed the SAME
+    program (final f32 loss equal to reduction rounding). Requires 8
+    devices — the bench parent runs it in a subprocess via ``bench.py
+    --model-parallel-ab``; tests call it directly on their 8-device
+    CPU mesh.
+
+    Per-axis attribution matches replica-group size to mesh axis size
+    (``blendjax.obs.devledger.parse_collectives``), which is exact
+    only when the layout's axis sizes are pairwise distinct — the
+    2×2×2 leg is reported with ``attribution_ambiguous`` and skipped
+    by the axis contracts."""
+    import jax
+    import jax.numpy as jnp
+
+    from blendjax.models import CubeRegressor
+    from blendjax.obs.devledger import ledger
+    from blendjax.parallel import (
+        batch_sharding,
+        resolve_layout,
+        state_resident_bytes,
+    )
+    from blendjax.train.mesh_driver import MeshTrainDriver
+    from blendjax.utils.metrics import metrics as reg
+
+    layouts = tuple(layouts or MODEL_PARALLEL_LAYOUTS)
+    n_steps = MODEL_PARALLEL_STEPS if n_steps is None else n_steps
+    n_steps = max(3, n_steps)
+    # one deterministic batch stream, shared by every leg: loss
+    # equality is only meaningful if each layout consumes byte-equal
+    # data in the same order
+    rng = np.random.default_rng(20)
+    host_batches = [
+        {
+            "image": rng.integers(
+                0, 255, (batch, *shape, 4), dtype=np.uint8
+            ),
+            "xy": rng.normal(size=(batch, 8, 2)).astype(np.float32),
+        }
+        for _ in range(n_steps)
+    ]
+
+    def one_leg(name: str) -> dict:
+        reg.reset()
+        ledger.reset()
+        layout = resolve_layout(name)
+        mesh = layout.create_mesh()
+        bs = batch_sharding(mesh)
+        drv = MeshTrainDriver.build(
+            CubeRegressor(features=(8, 16), dtype=jnp.float32), mesh,
+            host_batches[0]["image"], layout=name, aot=True,
+            aot_batch={
+                k: jax.device_put(v, bs)
+                for k, v in host_batches[0].items()
+            },
+            buckets=(batch,), sync_every=0, inflight=2,
+        )
+        # registration-time figures (memory_analysis of the compiled
+        # sharded step) — read before the dispatch window resets reg
+        snap0 = reg.report()["gauges"]
+        resident = int(state_resident_bytes(drv.state))
+        reg.reset()
+        steps0 = drv.steps
+        t0 = time.perf_counter()
+        for b in host_batches:
+            drv.submit({k: jax.device_put(v, bs) for k, v in b.items()})
+        final_loss = drv.drain()
+        dt = time.perf_counter() - t0
+        steps = drv.steps - steps0
+        spans = reg.report()["spans"]
+        train_calls = spans.get("train.dispatch", {}).get("count", 0)
+        # merge collectives over every registered executable of this
+        # leg (the bucket ladder is one entry per shape here)
+        per_kind: dict = {}
+        per_axis: dict = {}
+        total_bytes = 0
+        for e in ledger.report()["entries"]:
+            c = e.get("collectives")
+            if not isinstance(c, dict):
+                continue
+            total_bytes += int(c.get("total_bytes", 0))
+            for k, v in (c.get("per_kind") or {}).items():
+                per_kind[k] = per_kind.get(k, 0) + int(v)
+            for k, v in (c.get("per_axis") or {}).items():
+                per_axis[k] = per_axis.get(k, 0) + int(v)
+        sizes = [mesh.shape[a] for a in mesh.axis_names]
+        return {
+            "layout": layout.name,
+            "mesh": dict(mesh.shape),
+            "steps": steps,
+            "final_loss": final_loss,
+            "img_s": round(steps * batch / dt, 1) if dt else None,
+            "seconds": round(dt, 3),
+            "dispatch_per_step": (
+                round(train_calls / steps, 3) if steps else None
+            ),
+            "flops_per_image": drv.flops_per_image,
+            "state_resident_bytes_per_device": resident,
+            "hbm_peak_bytes": snap0.get("device.hbm_peak_bytes"),
+            "argument_bytes": snap0.get("device.argument_bytes"),
+            "collective_total_bytes": total_bytes,
+            "per_kind": per_kind,
+            "per_axis": per_axis,
+            # replica-group-size attribution is exact only when axis
+            # sizes are pairwise distinct (devledger joins ties "|")
+            "attribution_ambiguous": len(set(sizes)) != len(sizes),
+        }
+
+    legs = {name: one_leg(name) for name in layouts}
+
+    def axis_bytes(leg: dict, axis: str) -> int:
+        return sum(
+            v for k, v in leg["per_axis"].items()
+            if axis in k.split("|")
+        )
+
+    def fig(leg: dict) -> int:
+        # the budget contract reads the ledger's hbm figure; resident
+        # state is the fallback if a backend reports no memory stats
+        return int(
+            leg["hbm_peak_bytes"]
+            or leg["state_resident_bytes_per_device"]
+        )
+
+    losses = [
+        leg["final_loss"] for leg in legs.values()
+        if leg["final_loss"] is not None
+    ]
+    loss_delta = (
+        max(losses) - min(losses) if len(losses) == len(legs) else None
+    )
+    data_legs = [
+        leg for leg in legs.values() if set(leg["mesh"]) == {"data"}
+    ]
+    fsdp_legs = [leg for leg in legs.values() if "fsdp" in leg["mesh"]]
+    unambig = [
+        leg for leg in legs.values() if not leg["attribution_ambiguous"]
+    ]
+    contracts = {
+        "loss_equality_max_delta": loss_delta,
+        "loss_equality": (
+            loss_delta is not None
+            and loss_delta <= MODEL_PARALLEL_LOSS_TOL
+        ),
+        "dispatch_per_step_one": all(
+            leg["dispatch_per_step"] == 1.0 for leg in legs.values()
+        ),
+        # pure data parallelism needs exactly one collective: the grad
+        # all-reduce — a gather/scatter there means a mis-sharded state
+        "data_leg_all_reduce_only": all(
+            leg["per_kind"].get("all-gather", 0) == 0
+            and leg["per_kind"].get("reduce-scatter", 0) == 0
+            and leg["per_kind"].get("all-reduce", 0) > 0
+            for leg in data_legs
+        ),
+        # fsdp traffic (param all-gather-on-use + grad sync, attributed
+        # to the fsdp axis) present exactly on fsdp layouts
+        "fsdp_axis_bytes_iff_fsdp": all(
+            (axis_bytes(leg, "fsdp") > 0) == ("fsdp" in leg["mesh"])
+            for leg in unambig
+        ),
+        "fsdp_gather_traffic": all(
+            leg["per_kind"].get("all-gather", 0)
+            + leg["per_kind"].get("reduce-scatter", 0) > 0
+            for leg in fsdp_legs if not leg["attribution_ambiguous"]
+        ),
+        "tp_axis_bytes_iff_tp": all(
+            (axis_bytes(leg, "tp") > 0) == ("tp" in leg["mesh"])
+            for leg in unambig
+        ),
+    }
+    # the beyond-one-chip contract: under the forced per-device HBM
+    # budget the replicated state does NOT fit, the fsdp-sharded one
+    # does — and still trained end-to-end above
+    rep = next(iter(data_legs), None)
+    fsdp = next(
+        (leg for leg in fsdp_legs if set(leg["mesh"]) <= {"data", "fsdp"}),
+        None,
+    ) or next(iter(fsdp_legs), None)
+    if rep is not None and fsdp is not None:
+        if MODEL_PARALLEL_HBM_BUDGET == "auto":
+            budget = (fig(rep) + fig(fsdp)) // 2
+        else:
+            budget = int(MODEL_PARALLEL_HBM_BUDGET)
+        contracts.update({
+            "hbm_budget_bytes": budget,
+            "hbm_exceeds_budget_replicated": fig(rep) > budget,
+            "hbm_fits_budget_fsdp": fig(fsdp) <= budget,
+            "fsdp_trains_end_to_end": bool(
+                fsdp["steps"] == n_steps
+                and fsdp["final_loss"] is not None
+                and np.isfinite(fsdp["final_loss"])
+            ),
+            "fsdp_resident_ratio": (
+                round(
+                    rep["state_resident_bytes_per_device"]
+                    / fsdp["state_resident_bytes_per_device"], 3
+                )
+                if fsdp["state_resident_bytes_per_device"] else None
+            ),
+        })
+    contracts["all_ok"] = all(
+        v for k, v in contracts.items()
+        if isinstance(v, bool)
+    )
+    row = {
+        "legs": legs,
+        "global_batch": batch,
+        "steps_per_leg": n_steps,
+        "loss_tol": MODEL_PARALLEL_LOSS_TOL,
+        "contracts": contracts,
+        "cpu_count": os.cpu_count(),
+    }
+    if rep is not None and rep["img_s"]:
+        for leg in legs.values():
+            leg["throughput_vs_data"] = (
+                round(leg["img_s"] / rep["img_s"], 3)
+                if leg["img_s"] else None
+            )
+    row["value"] = contracts.get("loss_equality_max_delta")
+    return row
+
+
+def measure_model_parallel_ab(timeout_s: float = 420.0) -> dict:
+    """Run the model-parallel A/B legs in a SUBPROCESS on a forced
+    8-device CPU mesh (``bench.py --model-parallel-ab``) — same dance
+    as ``measure_multichip_live``: this process's backend is already
+    initialized with the real topology. One JSON line comes back with
+    the per-layout legs and the layout contracts."""
+    import subprocess
+
+    try:
+        proc = subprocess.run(
+            [
+                sys.executable, os.path.abspath(__file__),
+                "--model-parallel-ab",
+            ],
+            capture_output=True, text=True, timeout=timeout_s,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+        )
+    except Exception as e:
+        return {"error": repr(e)[:200]}
+    lines = [
+        ln for ln in (proc.stdout or "").strip().splitlines()
+        if ln.startswith("{")
+    ]
+    if proc.returncode != 0 or not lines:
+        return {
+            "error": (
+                f"rc={proc.returncode} "
+                f"stderr={(proc.stderr or '')[-300:]}"
+            )
+        }
+    return json.loads(lines[-1])
+
+
+def _model_parallel_ab_main() -> None:
+    """``bench.py --model-parallel-ab`` entry: force the 8-device CPU
+    platform BEFORE the first backend query, run the layout legs,
+    print one JSON line."""
+    import jax
+
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            f"{flags} --xla_force_host_platform_device_count=8"
+        ).strip()
+    jax.config.update("jax_platforms", "cpu")
+    print(json.dumps(_model_parallel_ab_legs(), default=str))
+
+
 def measure_rl_hz(seconds: float = 3.0) -> dict:
     """Full REQ/REP rendezvous stepping rate, rendering off (the
     reference's '2000 Hz are easily achieved' row, ``Readme.md:95``;
@@ -4048,6 +4364,18 @@ def _build_record(progress: dict) -> dict:
             detail["live_device_ledger"] = measure_live_device_ledger()
         except Exception as e:  # pragma: no cover - spawn flake path
             detail["live_device_ledger"] = {"error": repr(e)[:200]}
+    if MODEL_PARALLEL_AB:
+        # Model-parallel A/B row (docs/parallelism.md "Choosing a
+        # layout"): the same model + deterministic batches under each
+        # mesh layout on a forced 8-device CPU mesh; CI asserts f32
+        # loss equality across layouts, dispatch_per_step == 1.0 on
+        # every leg, all-reduce-only on pure data, fsdp/tp axis bytes
+        # present exactly on their layouts, and the forced-HBM-budget
+        # beyond-one-chip contract. Pure CPU, weather-independent.
+        try:
+            detail["model_parallel_ab"] = measure_model_parallel_ab()
+        except Exception as e:  # pragma: no cover - spawn flake path
+            detail["model_parallel_ab"] = {"error": repr(e)[:200]}
     if ENCODING == "tile" and INGEST_AB and not degraded:
         # Sharded-ingest A/B (same weather regime as the headline): does
         # a second recv/decode worker raise end-to-end img/s on THIS
@@ -4204,6 +4532,8 @@ if __name__ == "__main__":
         sys.exit(_multichip_live_main())
     if "--devledger-mesh" in sys.argv:
         sys.exit(_devledger_mesh_main())
+    if "--model-parallel-ab" in sys.argv:
+        sys.exit(_model_parallel_ab_main())
     if "--live-resume-child" in sys.argv:
         sys.exit(_live_resume_child_main())
     if "--live-start-child" in sys.argv:

@@ -4,6 +4,7 @@ from __future__ import annotations
 
 from blendjax.analysis.rules import (  # noqa: F401  (registration side effects)
     actor_loop,
+    axis_literals,
     checkpoint_sync,
     cold_jit,
     concurrency,

@@ -304,12 +304,30 @@ class SnapshotManager:
     # -- restore --------------------------------------------------------------
 
     def restore(self, template, step: int | None = None,
-                shardings=None) -> Restored | None:
+                shardings=None, mesh=None, rules=None,
+                layout=None) -> Restored | None:
         """Restore the latest (or ``step``) committed snapshot onto
         ``template``'s structure and layout; ``None`` when no snapshot
         exists. ``shardings`` overrides the per-leaf placement (the
         elastic-resume path: ``state_shardings(template, mesh=mesh)``
-        for a DIFFERENT mesh than the snapshot was taken on)."""
+        for a DIFFERENT mesh than the snapshot was taken on).
+
+        Resharding extends across *layouts*, not just mesh sizes: pass
+        ``mesh`` (with optional ``rules``/``layout``) and the target
+        tree is derived via :func:`blendjax.parallel.state_shardings`
+        — a run saved under ``data×fsdp`` (or ``data×fsdp×tp``)
+        resumes as pure-``data`` and vice versa, each re-placed leaf
+        counted under ``ckpt.resharded_restores``. The snapshot format
+        stores GLOBAL extents per shard, so any source partition
+        reassembles under any target one; loss continuation is
+        f32-identical because the math never depended on the layout
+        (tests/test_checkpoint.py pins the cross-layout leg)."""
+        if shardings is None and mesh is not None:
+            from blendjax.parallel.sharding import state_shardings
+
+            shardings = state_shardings(
+                template, mesh=mesh, rules=rules, layout=layout
+            )
         if step is None:
             step = self.latest_step()
             if step is None:

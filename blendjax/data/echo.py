@@ -116,7 +116,12 @@ class SampleReservoir:
         self.sharding = sharding
         if sharding is not None:
             from blendjax.data.ring import validate_ring_capacity
+            from blendjax.parallel.sharding import validate_batch_sharding
 
+            # samples are batch-shaped: a model-axis (fsdp-only/tp)
+            # ring layout is a wrong rule — reject at construction,
+            # not deep inside the first jitted insert
+            validate_batch_sharding(sharding, what="reservoir ring")
             validate_ring_capacity(self.capacity, sharding)
         self.augment = augment
         self._rng_seed = rng

@@ -98,6 +98,20 @@ class DeviceFeeder:
             from blendjax.parallel.sharding import batch_sharding
 
             sharding = batch_sharding(mesh, axis=data_axis)
+        elif sharding is not None:
+            from blendjax.parallel.sharding import validate_batch_sharding
+
+            # an explicit feeder layout must still be a BATCH layout:
+            # fsdp/tp partition parameters, and a wrong rule here would
+            # otherwise fail deep inside the first placed jit dispatch
+            for key, s in (
+                sharding.items() if isinstance(sharding, dict)
+                else [(None, sharding)]
+            ):
+                validate_batch_sharding(
+                    s, data_axis=data_axis,
+                    what=f"feeder field {key!r}" if key else "feeder batch",
+                )
         if multihost is None:
             # auto only in mesh mode: a mesh spanning several processes
             # must assemble globals; explicit sharding keeps the old

@@ -29,6 +29,21 @@ class CubeRegressor(nn.Module):
     # pass an explicit dtype (or policy.module_kwargs()) to override
     dtype: Any = None
 
+    def partition_rules(self):
+        """Tensor-parallel layout for this param tree (picked up by
+        :func:`blendjax.parallel.resolve_rules` when a build passes no
+        explicit rules): the pooled MLP is a Megatron pair — hidden
+        Dense column-split over ``tp``, the corner head row-split — and
+        conv kernels fall to the generic defaults (output features
+        column-split when divisible, ``fsdp`` on the largest free
+        dim)."""
+        from blendjax.parallel.sharding import PartitionRule
+
+        return (
+            PartitionRule(r"^Dense_0/kernel$", ("tp",)),       # hidden
+            PartitionRule(r"^Dense_1/kernel$", ("tp", None)),  # head, row
+        )
+
     @nn.compact
     def __call__(self, images):
         """``images``: (B, H, W, 4) uint8 (or float in [0,1]).

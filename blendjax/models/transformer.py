@@ -161,6 +161,22 @@ class StreamFormer(nn.Module):
     # memory in backprop for long sequences/deep stacks, recompute on the
     # backward pass (jax.checkpoint via nn.remat — HBM for FLOPs)
 
+    def partition_rules(self):
+        """Megatron-style tensor-parallel layout for this param tree
+        (:func:`blendjax.parallel.resolve_rules` picks this up when a
+        build passes no explicit rules): attention heads column-split
+        over ``tp`` on the qkv kernel's heads dim, the output/MLP
+        projections row-split, the MLP hidden dim column-split, and
+        the vocab-analog output head column-split — composing with
+        ``seq`` ring/ulysses attention so longseq runs ``data×tp``.
+        The ``fsdp`` axis then takes each leaf's largest free dim
+        (generic defaults), so one rule set serves every layout."""
+        from blendjax.parallel.sharding import DEFAULT_TP_RULES, PartitionRule
+
+        return DEFAULT_TP_RULES + (
+            PartitionRule(r"^Dense_0/kernel$", ("tp",)),  # output head
+        )
+
     @nn.compact
     def __call__(self, images):
         dtype = default_compute_dtype(self.dtype)

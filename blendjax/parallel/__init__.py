@@ -2,21 +2,32 @@
 
 No reference counterpart exists — blendtorch's only "distributed backend"
 is ZMQ between processes (SURVEY.md §2.4); the accelerator-side plane is
-designed fresh for TPU: a named mesh (``data``/``fsdp``/``tensor``/
-``seq``), ``NamedSharding`` annotations, XLA collectives via ``shard_map``,
-and ring attention for sequence/context parallelism over ICI.
+designed fresh for TPU: a named mesh (``data``/``fsdp``/``tp``/
+``seq``; ``tensor`` is the legacy ``tp`` spelling), :class:`Layout`
+specs composing them (``data×fsdp``, ``data×tp``, ``data×fsdp×tp``)
+with per-model :class:`PartitionRule` sets, ``NamedSharding``
+annotations, XLA collectives via ``shard_map``, and ring attention for
+sequence/context parallelism over ICI.
 """
 
 from blendjax.parallel.mesh import MeshSpec, create_mesh
 from blendjax.parallel.sharding import (
+    DEFAULT_TP_RULES,
+    LAYOUTS,
+    Layout,
+    PartitionRule,
     batch_sharding,
     leading_shard_count,
     mesh_chip_count,
     param_sharding_rules,
     replicated,
+    resolve_layout,
+    resolve_rules,
     ring_sharding,
     shard_params,
+    state_resident_bytes,
     state_shardings,
+    validate_batch_sharding,
 )
 from blendjax.parallel.collectives import (
     all_gather,
@@ -31,6 +42,14 @@ from blendjax.parallel.pipeline import pipeline_apply, stack_stage_params
 __all__ = [
     "MeshSpec",
     "create_mesh",
+    "DEFAULT_TP_RULES",
+    "LAYOUTS",
+    "Layout",
+    "PartitionRule",
+    "resolve_layout",
+    "resolve_rules",
+    "state_resident_bytes",
+    "validate_batch_sharding",
     "batch_sharding",
     "replicated",
     "param_sharding_rules",

@@ -3,14 +3,18 @@
 Axis vocabulary (scaling-book conventions):
 
 - ``data``  — batch (DP); the streamed global batch is split here.
-- ``fsdp``  — parameter/optimizer sharding (ZeRO-style), usually folded
-  with ``data`` on small pods.
-- ``tensor`` — intra-layer model parallelism (TP).
+- ``fsdp``  — parameter/optimizer sharding (ZeRO-style), folded into
+  the batch's leading dim as extra DP (every chip sees distinct rows).
+- ``tp``    — intra-layer model parallelism (heads/MLP hidden/vocab);
+  ``tensor`` is the legacy spelling and stays accepted everywhere.
 - ``seq``   — sequence/context parallelism (SP; ring attention).
 
 ``create_mesh`` lays the requested axis sizes over the available devices
-in ICI-friendly order (innermost axes change fastest so ``tensor``/``seq``
-neighbors are physically adjacent).
+in ICI-friendly order (innermost axes change fastest so ``tp``/``seq``
+neighbors are physically adjacent). It also accepts a
+:class:`blendjax.parallel.Layout` (or its name string) directly, so
+``create_mesh("data×fsdp")`` and ``create_mesh(Layout(fsdp=4))`` build
+the 2-D mesh the layout commits to.
 """
 
 from __future__ import annotations
@@ -47,14 +51,19 @@ def create_mesh(spec: MeshSpec | dict | None = None, devices=None):
     """Build a ``jax.sharding.Mesh``.
 
     >>> mesh = create_mesh({"data": -1})                    # pure DP
-    >>> mesh = create_mesh({"data": -1, "tensor": 2})       # DP x TP
+    >>> mesh = create_mesh({"data": -1, "tp": 2})           # DP x TP
     >>> mesh = create_mesh({"data": 1, "seq": 8})           # ring SP
+    >>> mesh = create_mesh("data×fsdp")                     # a Layout name
     """
     import jax
     from jax.sharding import Mesh
 
     if spec is None:
         spec = MeshSpec()
+    elif isinstance(spec, str) or hasattr(spec, "mesh_axes"):
+        from blendjax.parallel.sharding import resolve_layout
+
+        spec = MeshSpec(resolve_layout(spec).mesh_axes())
     elif isinstance(spec, dict):
         spec = MeshSpec(dict(spec))
     devices = list(devices if devices is not None else jax.devices())

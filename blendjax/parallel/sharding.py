@@ -1,6 +1,32 @@
-"""NamedSharding helpers and parameter partitioning rules."""
+"""NamedSharding helpers, parameter partitioning rules, and layouts.
+
+Two vocabularies live here:
+
+- **Axes** — the named mesh dimensions (``data``/``fsdp``/``tp``/
+  ``seq``/``expert``; ``tensor`` is the legacy spelling of ``tp`` and
+  both resolve to whichever the mesh actually carries).
+- **Layouts** — how a whole training run maps onto those axes: a
+  :class:`Layout` names the composition (``data``, ``data×fsdp``,
+  ``data×tp``, ``data×fsdp×tp``) plus the per-model
+  :class:`PartitionRule` overrides that put attention heads / MLP
+  hidden / vocab on the tensor axis. ``state_shardings(...,
+  layout=)`` is the single pinning helper the step builders, the
+  checkpoint restore path, and ``mesh_rl_step_kwargs`` all share, so
+  one spelling of the layout governs params, optimizer moments, the
+  donated jit boundary, and cross-layout resume.
+
+The batch side never shards over model axes: data enters over
+``data`` (with ``fsdp`` folded into the leading dim as extra data
+parallelism — ZeRO-style, every chip still sees distinct rows).
+:func:`validate_batch_sharding` is the build-time gate the AOT ladder
+and the reservoir rings apply so a parameter-style rule on a *batch*
+fails with a named error instead of deep inside jit.
+"""
 
 from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
 
 
 def _np():
@@ -9,10 +35,28 @@ def _np():
     return NamedSharding, PartitionSpec
 
 
+#: every axis name a blendjax mesh may carry (docs/parallelism.md)
+MESH_AXES = ("data", "fsdp", "tp", "tensor", "seq", "expert", "pipe")
+
+#: axes that partition *parameters* — never a batch dimension
+MODEL_AXES = ("fsdp", "tp", "tensor", "expert", "pipe")
+
+
+def tensor_axis(mesh):
+    """The mesh's tensor-parallel axis name (``tp`` preferred,
+    ``tensor`` legacy), or None when the mesh has neither."""
+    for ax in ("tp", "tensor"):
+        if ax in mesh.axis_names:
+            return ax
+    return None
+
+
 def batch_sharding(mesh, axis: str = "data"):
     """Shard the leading (batch) axis across ``axis`` — the layout the
     ingest pipeline feeds (SURVEY.md §2.4: per-host ingest -> global batch
-    on the ``data`` axis)."""
+    on the ``data`` axis). On an fsdp mesh the ``fsdp`` axis folds into
+    the leading dim as extra data parallelism (ZeRO: params shard over
+    ``fsdp``, batches split over it)."""
     NamedSharding, P = _np()
     names = [axis] if axis in mesh.axis_names else []
     if "fsdp" in mesh.axis_names and axis == "data":
@@ -25,34 +69,105 @@ def replicated(mesh):
     return NamedSharding(mesh, P())
 
 
-def param_sharding_rules(mesh, path: tuple, value) -> "object":
-    """Default parameter layout:
+# -- per-model partition rules ------------------------------------------------
+
+@dataclass(frozen=True)
+class PartitionRule:
+    """One explicit parameter-layout override.
+
+    ``pattern`` is a regex searched against the ``/``-joined parameter
+    path (``block0/qkv/kernel``); ``spec`` is a partition entry per
+    *trailing* dimension (``("tp", None)`` puts the second-to-last dim
+    on the tensor axis). Entries naming an axis the mesh lacks, or one
+    whose size does not divide the dim, degrade to ``None`` — a rule
+    set written for ``data×fsdp×tp`` is valid verbatim on a pure
+    ``data`` mesh (where it does nothing)."""
+
+    pattern: str
+    spec: tuple
+
+
+#: transformer layout (Megatron-style): attention heads and the MLP
+#: hidden dim column-parallel over ``tp``, their output projections
+#: row-parallel, the vocab/output head column-parallel. Matches the
+#: flax param paths :class:`blendjax.models.StreamFormer` produces; a
+#: model with its own naming ships its own ``partition_rules()``.
+DEFAULT_TP_RULES = (
+    PartitionRule(r"qkv/kernel$", ("tp", None)),        # heads dim
+    PartitionRule(r"proj/kernel$", ("tp", None)),       # attn out, row
+    PartitionRule(r"block\d+/Dense_0/kernel$", ("tp",)),  # MLP hidden
+    PartitionRule(r"block\d+/Dense_1/kernel$", ("tp", None)),  # MLP out
+)
+
+
+def _path_str(path: tuple) -> str:
+    return "/".join(
+        str(getattr(k, "key", getattr(k, "name", k))) for k in path
+    )
+
+
+def _mesh_axis(mesh, name):
+    """Resolve a rule's axis name onto the mesh (``tp`` <-> ``tensor``
+    are interchangeable); None when absent or trivial (size 1)."""
+    if name in ("tp", "tensor"):
+        name = tensor_axis(mesh)
+    if name is None or name not in mesh.axis_names:
+        return None
+    return name if mesh.shape[name] > 1 else None
+
+
+def param_sharding_rules(mesh, path: tuple, value, rules=()) -> "object":
+    """Parameter layout for one leaf.
+
+    Explicit ``rules`` (:class:`PartitionRule`) are checked first —
+    first match wins, its spec aligned to the leaf's trailing dims.
+    The generic defaults then fill in (and handle every unmatched
+    leaf):
 
     - ``expert`` axis: MoE parameters (name starts with ``expert_``,
       leading dim = num_experts) split on dim 0 — expert parallelism;
       GSPMD inserts the dispatch/combine all-to-alls.
-    - ``tensor`` axis: dense/conv kernels split on their output-feature
-      (last) dimension when divisible — Megatron-style column parallel.
+    - ``tp``/``tensor`` axis: dense/conv kernels split on their
+      output-feature (last) dimension when divisible — Megatron-style
+      column parallel.
     - ``fsdp`` axis: remaining large params split on their largest
-      divisible dimension (ZeRO-3 style).
+      divisible dimension (ZeRO-3 style) — the all-gather on use /
+      reduce-scatter on grads is GSPMD's, derived from this placement.
     - small params (biases, norms) replicated.
     """
     NamedSharding, P = _np()
     shape = getattr(value, "shape", ())
     spec = [None] * len(shape)
     name = str(getattr(path[-1], "key", path[-1])) if path else ""
+    matched = False
+    if rules:
+        pstr = _path_str(path)
+        for rule in rules:
+            if not re.search(rule.pattern, pstr):
+                continue
+            matched = True
+            for i, ax in enumerate(reversed(rule.spec)):
+                dim = len(shape) - 1 - i
+                if dim < 0 or ax is None:
+                    continue
+                ax = _mesh_axis(mesh, ax)
+                if ax is not None and shape[dim] % mesh.shape[ax] == 0:
+                    spec[dim] = ax
+            break
     if (
-        "expert" in mesh.axis_names
+        not matched
+        and "expert" in mesh.axis_names
         and name.startswith("expert_")
         and shape
         and shape[0] % mesh.shape["expert"] == 0
     ):
         spec[0] = "expert"
     if len(shape) >= 2:
-        if "tensor" in mesh.axis_names:
-            tp = mesh.shape["tensor"]
-            if tp > 1 and shape[-1] % tp == 0:
-                spec[-1] = "tensor"
+        tp = tensor_axis(mesh)
+        if not matched and tp is not None:
+            ways = mesh.shape[tp]
+            if ways > 1 and shape[-1] % ways == 0:
+                spec[-1] = tp
         if "fsdp" in mesh.axis_names:
             fs = mesh.shape["fsdp"]
             if fs > 1:
@@ -69,19 +184,143 @@ def param_sharding_rules(mesh, path: tuple, value) -> "object":
     return NamedSharding(mesh, P(*spec))
 
 
-def shard_params(mesh, params):
+def shard_params(mesh, params, rules=()):
     """Apply :func:`param_sharding_rules` over a pytree and device_put."""
     import jax
 
     def place(path, leaf):
-        return jax.device_put(leaf, param_sharding_rules(mesh, path, leaf))
+        return jax.device_put(
+            leaf, param_sharding_rules(mesh, path, leaf, rules=rules)
+        )
 
     return jax.tree_util.tree_map_with_path(place, params)
 
 
+# -- layouts ------------------------------------------------------------------
+
+@dataclass(frozen=True)
+class Layout:
+    """How a run maps onto mesh axes: axis sizes + partition rules.
+
+    ``data=-1`` absorbs whatever devices the model axes leave free, so
+    one spelling (``Layout(fsdp=4)``) works on 8 chips and 256.
+    ``rules`` are the per-model :class:`PartitionRule` overrides
+    (``None`` -> ask the model via ``model.partition_rules()``, falling
+    back to the generic defaults)."""
+
+    name: str = "data"
+    data: int = -1
+    fsdp: int = 1
+    tp: int = 1
+    seq: int = 1
+    rules: tuple | None = field(default=None, compare=False)
+
+    def mesh_axes(self) -> dict:
+        """Axis sizes for :func:`blendjax.parallel.create_mesh`, in
+        ICI-friendly order — ``tp`` innermost so tensor-parallel
+        neighbors are physically adjacent."""
+        axes = {"data": self.data}
+        if self.fsdp != 1:
+            axes["fsdp"] = self.fsdp
+        if self.seq != 1:
+            axes["seq"] = self.seq
+        if self.tp != 1:
+            axes["tp"] = self.tp
+        return axes
+
+    def create_mesh(self, devices=None):
+        from blendjax.parallel.mesh import create_mesh
+
+        return create_mesh(self.mesh_axes(), devices=devices)
+
+
+#: the canonical layout names (docs/parallelism.md "Choosing a layout")
+LAYOUTS = ("data", "data×fsdp", "data×tp", "data×fsdp×tp")
+
+_AXIS_SIZE_RE = re.compile(r"^([a-z]+?)(\d+)?$")
+
+#: model axes named without a size in a layout string default to the
+#: smallest nontrivial split; ``data`` without a size absorbs the rest
+_DEFAULT_WAYS = 2
+
+
+def resolve_layout(layout) -> Layout:
+    """Normalize a layout spec to a :class:`Layout`.
+
+    Accepts a :class:`Layout` (returned as-is), ``None`` (pure data
+    parallelism), a dict of axis sizes, or a name string: axis names
+    joined by ``×``/``x``/``_``/``*``/spaces, each optionally carrying
+    a size (``"data×fsdp"``, ``"data2xfsdp4"``, ``"data4×tp2"``).
+    Sizeless model axes split ``2``-way; sizeless ``data`` absorbs the
+    remaining devices (``-1``)."""
+    if layout is None:
+        return Layout("data")
+    if isinstance(layout, Layout):
+        return layout
+    if isinstance(layout, dict):
+        sizes = dict(layout)
+        name = "×".join(sizes) if sizes else "data"
+        return Layout(
+            name=name,
+            data=int(sizes.pop("data", 1)),
+            fsdp=int(sizes.pop("fsdp", 1)),
+            tp=int(sizes.pop("tp", sizes.pop("tensor", 1))),
+            seq=int(sizes.pop("seq", 1)),
+        )
+    text = str(layout).strip().lower().replace("×", "x")
+    sizes: dict = {}
+    for part in (p for p in re.split(r"[x_*\s+,]+", text) if p):
+        m = _AXIS_SIZE_RE.match(part)
+        axis = m.group(1) if m else part
+        if axis == "tensor":
+            axis = "tp"
+        if m is None or axis not in ("data", "fsdp", "tp", "seq"):
+            raise ValueError(
+                f"unknown layout axis {part!r} in {layout!r} — compose "
+                "from data/fsdp/tp/seq (optionally sized, e.g. "
+                "'data2xfsdp4'); canonical layouts: "
+                + ", ".join(LAYOUTS)
+            )
+        if m.group(2) is not None:
+            sizes[axis] = int(m.group(2))
+        else:
+            sizes[axis] = -1 if axis == "data" else _DEFAULT_WAYS
+    if "data" not in sizes:
+        sizes["data"] = 1
+    canonical = "×".join(
+        ax for ax in ("data", "fsdp", "seq", "tp")
+        if ax in sizes and (ax == "data" or sizes[ax] != 1)
+    )
+    return Layout(
+        name=canonical or "data",
+        data=sizes.get("data", -1),
+        fsdp=sizes.get("fsdp", 1),
+        tp=sizes.get("tp", 1),
+        seq=sizes.get("seq", 1),
+    )
+
+
+def resolve_rules(rules=None, layout=None, model=None):
+    """The partition-rule set for a build: explicit ``rules`` win, then
+    the layout's, then the model's own ``partition_rules()``, then
+    none (generic defaults only)."""
+    if rules is not None:
+        return tuple(rules)
+    if layout is not None:
+        lay = resolve_layout(layout)
+        if lay.rules is not None:
+            return tuple(lay.rules)
+    pr = getattr(model, "partition_rules", None)
+    if callable(pr):
+        return tuple(pr())
+    return ()
+
+
 def mesh_chip_count(mesh) -> int:
     """Total participating chips (all processes): the factor live MFU
-    and per-chip throughput figures scale by on a mesh run."""
+    and per-chip throughput figures scale by on a mesh run — the
+    product over EVERY axis (``data×fsdp×tp`` runs the step on all of
+    them), not the data-axis size."""
     import numpy as np
 
     return int(np.prod([int(s) for s in mesh.shape.values()])) if getattr(
@@ -89,24 +328,46 @@ def mesh_chip_count(mesh) -> int:
     ) else 1
 
 
-def state_shardings(state, mesh=None):
-    """The sharding pytree of a concrete train state — what
+def state_shardings(state, mesh=None, rules=None, layout=None):
+    """The sharding pytree of a train state — what
     ``jax.jit(in_shardings=(state_shardings(state, mesh), ...),
     out_shardings=(state_shardings(state, mesh), ...))`` pins so a
     donated step can never silently reshard params/optimizer state
     mid-run (``blendjax.train.mesh_driver`` builds its steps on this).
 
-    With ``mesh`` given the tree is normalized ONTO it: array leaves
-    already holding a NamedSharding on this mesh keep it (params and
-    optimizer moments under the mesh rules), every other array leaf —
-    the step counters optax creates on the default device — pins to
-    replicated on the SAME mesh, so the whole state lives on one
-    device set (a jit mixing device sets refuses to run). Without
-    ``mesh``, leaves map to their current sharding as-is. Non-array
-    leaves (flax's integer ``step`` before the first update,
+    With ``rules``/``layout`` given (and a mesh), the tree is
+    DERIVED rather than read: every array leaf's spec comes from
+    :func:`param_sharding_rules` applied to its path — optimizer
+    moments mirror the parameter tree's paths, so ``mu``/``nu`` land
+    on the same partition as the params they track, and a *template*
+    state (freshly initialized, any placement) yields the target
+    layout's tree. This is the cross-layout restore path:
+    ``restore(template, shardings=state_shardings(template, mesh=mesh,
+    layout="data×fsdp"))`` resumes a pure-``data`` run fsdp-sharded
+    and vice versa.
+
+    With only ``mesh`` given the tree is normalized ONTO it: array
+    leaves already holding a NamedSharding on this mesh keep it
+    (params and optimizer moments under the mesh rules), every other
+    array leaf — the step counters optax creates on the default
+    device — pins to replicated on the SAME mesh, so the whole state
+    lives on one device set (a jit mixing device sets refuses to run).
+    Without ``mesh``, leaves map to their current sharding as-is.
+    Non-array leaves (flax's integer ``step`` before the first update,
     ``apply_fn``) map to ``None`` — "unspecified", which jit infers."""
     import jax
 
+    if layout is not None and rules is None:
+        rules = resolve_rules(layout=layout)
+    if mesh is not None and rules is not None:
+        rules = tuple(rules)
+
+        def derive(path, v):
+            if not hasattr(v, "shape"):
+                return None
+            return param_sharding_rules(mesh, path, v, rules=rules)
+
+        return jax.tree_util.tree_map_with_path(derive, state)
     if mesh is None:
         return jax.tree_util.tree_map(
             lambda v: getattr(v, "sharding", None), state
@@ -125,10 +386,41 @@ def state_shardings(state, mesh=None):
     return jax.tree_util.tree_map(pin, state)
 
 
+def state_resident_bytes(state) -> int:
+    """Per-device resident bytes of a concrete state: the sum over
+    leaves of ONE device's shard (replicated leaves count in full,
+    ``fsdp``-sharded leaves at 1/|fsdp|) — the figure the device
+    ledger's ``device.hbm_peak_bytes`` argument accounting reflects,
+    computable without a compile. An fsdp layout's resident state is
+    ~1/|fsdp| of the replicated figure; tests and the
+    ``model_parallel_ab`` HBM-budget contract pin that ratio."""
+    import jax
+    import numpy as np
+
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(state):
+        shape = getattr(leaf, "shape", None)
+        dtype = getattr(leaf, "dtype", None)
+        if shape is None or dtype is None:
+            continue
+        sharding = getattr(leaf, "sharding", None)
+        if sharding is not None:
+            try:
+                shape = sharding.shard_shape(tuple(shape))
+            except Exception:
+                pass
+        total += int(np.prod(shape, dtype=np.int64)) * np.dtype(dtype).itemsize
+    return total
+
+
 def leading_shard_count(sharding) -> int:
     """How many ways a sharding splits dim 0 (1 for ``None``/replicated)
     — the divisibility a global batch size / reservoir capacity must
-    satisfy so every chip takes an equal shard."""
+    satisfy so every chip takes an equal shard. Multi-axis tolerant:
+    a ``(data, fsdp)`` fold multiplies both axis sizes; model axes the
+    batch does NOT cover (``tp`` on a ``data×tp`` mesh) contribute
+    nothing, so batch divisibility never scales with chips the batch
+    doesn't split over."""
     spec = getattr(sharding, "spec", None)
     mesh = getattr(sharding, "mesh", None)
     if not spec or mesh is None:
@@ -141,6 +433,46 @@ def leading_shard_count(sharding) -> int:
         if part is not None:
             total *= int(mesh.shape[part])
     return total
+
+
+def validate_batch_sharding(sharding, data_axis: str = "data",
+                            what: str = "batch"):
+    """Build-time gate: data enters over ``data`` only.
+
+    A *parameter*-style rule applied to a batch (``tp`` on the feature
+    dim, ``fsdp`` without the data fold) compiles into a different —
+    wrong — program and otherwise fails deep inside jit as an opaque
+    shard-divisibility or layout-mismatch error. Accepted: replicated;
+    dim 0 over ``data_axis`` (with the canonical ``fsdp`` fold); inner
+    dims over ``seq`` (sequence parallelism pre-splits tokens). Any
+    model axis elsewhere raises with the offending axis named. Returns
+    ``sharding`` so call sites can validate inline."""
+    spec = getattr(sharding, "spec", None)
+    if not spec:
+        return sharding
+    for dim, entry in enumerate(spec):
+        names = tuple(
+            n for n in (entry if isinstance(entry, tuple) else (entry,))
+            if n is not None
+        )
+        if not names:
+            continue
+        if dim == 0:
+            bad = [n for n in names if n not in (data_axis, "fsdp")]
+            if not bad and "fsdp" in names and data_axis not in names:
+                bad = ["fsdp"]  # fsdp folds WITH data, never alone
+        else:
+            bad = [n for n in names if n != "seq"]
+        if bad:
+            raise ValueError(
+                f"{what} sharding {tuple(spec)!r} puts mesh axis "
+                f"{bad[0]!r} on dim {dim} — data enters over "
+                f"{data_axis!r} (dim 0; fsdp folds in as extra DP) "
+                "only. fsdp/tp partition parameters, not batches: use "
+                "batch_sharding(mesh)/ring_sharding(mesh) for the "
+                "batch side and Layout/partition rules for the state."
+            )
+    return sharding
 
 
 def ring_sharding(mesh, axis: str = "data"):

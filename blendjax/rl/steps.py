@@ -63,19 +63,26 @@ class RLTrainState(TrainState):
 
 def make_rl_train_state(model, example_obs, optimizer=None,
                         learning_rate: float = 1e-3, rng=None,
-                        mesh=None, target: bool = True) -> RLTrainState:
+                        mesh=None, target: bool = True,
+                        rules=None, layout=None) -> RLTrainState:
     """Init an :class:`RLTrainState` (params sharded onto ``mesh`` per
-    the default rules; ``target=True`` clones them into the target
+    the partition rules — ``rules``/``layout`` select fsdp/tp layouts
+    exactly as :func:`blendjax.train.make_train_state` does, so big
+    policies shard too; ``target=True`` clones them into the target
     network — distinct buffers, both donated through the step)."""
-    from blendjax.parallel.sharding import param_sharding_rules
+    from blendjax.parallel.sharding import (
+        param_sharding_rules,
+        resolve_rules,
+    )
 
     rng = rng if rng is not None else jax.random.key(0)
     optimizer = optimizer or optax.adam(learning_rate)
     params = model.init(rng, example_obs)["params"]
     if mesh is not None:
+        resolved = resolve_rules(rules=rules, layout=layout, model=model)
         params = jax.tree_util.tree_map_with_path(
             lambda p, v: jax.device_put(
-                v, param_sharding_rules(mesh, p, v)
+                v, param_sharding_rules(mesh, p, v, rules=resolved)
             ),
             params,
         )
@@ -107,13 +114,17 @@ def _rl_jit_kwargs(state_sharding, buffer_sharding,
     return {"in_shardings": tuple(in_sh), "out_shardings": tuple(out)}
 
 
-def mesh_rl_step_kwargs(state, mesh, data_axis: str = "data") -> dict:
+def mesh_rl_step_kwargs(state, mesh, data_axis: str = "data",
+                        rules=None, layout=None) -> dict:
     """The mesh hook pair for either builder, mirroring
     :func:`blendjax.train.mesh_driver.make_mesh_echo_fused_step`:
     ``state_sharding`` pinned from the concrete state (the donated
     update can never drift layouts) and a ``draw_constraint`` that
     re-shards the just-gathered transition batch over the batch axis
-    inside the jit. Usage::
+    inside the jit. ``rules``/``layout`` derive the state tree from
+    partition rules instead of reading concrete placements — the SAME
+    fsdp/tp layouts the supervised path trains under, so big policies
+    shard identically. Usage::
 
         step = make_dqn_step(reservoir, model.apply,
                              **mesh_rl_step_kwargs(state, mesh))
@@ -139,7 +150,9 @@ def mesh_rl_step_kwargs(state, mesh, data_axis: str = "data") -> dict:
         )
 
     return {
-        "state_sharding": state_shardings(state, mesh=mesh),
+        "state_sharding": state_shardings(
+            state, mesh=mesh, rules=rules, layout=layout
+        ),
         "draw_constraint": _pin_drawn_batch,
     }
 

@@ -105,13 +105,17 @@ def cache_key(
     mesh: object = None,
     decode_plan: object = None,
     buckets: tuple | list | None = None,
+    layout: object = None,
+    rules: tuple | list | None = None,
 ) -> str:
     """Stable manifest key over everything that invalidates compiled steps.
 
     Anatomy (see docs/performance.md): model class qualname, precision
-    policy, mesh layout (axis names x sizes), decode plan, bucket ladder,
-    plus the JAX version and backend — change any one and the key moves,
-    so a stale cache can never serve a mismatched executable.
+    policy, mesh layout (axis names x sizes), the named Layout + its
+    partition-rule set (two rule sets on the SAME mesh are different
+    programs), decode plan, bucket ladder, plus the JAX version and
+    backend — change any one and the key moves, so a stale cache can
+    never serve a mismatched executable.
     """
     if model is not None and not isinstance(model, str):
         model = f"{type(model).__module__}.{type(model).__qualname__}"
@@ -123,10 +127,17 @@ def cache_key(
             )
         except Exception:
             mesh = repr(mesh)
+    if layout is not None and not isinstance(layout, str):
+        layout = getattr(layout, "name", None) or repr(layout)
     parts = {
         "model": model,
         "precision": str(precision) if precision is not None else None,
         "mesh": mesh,
+        "layout": layout,
+        "rules": [
+            (r.pattern, list(r.spec)) if hasattr(r, "pattern") else repr(r)
+            for r in rules
+        ] if rules else None,
         "decode_plan": str(decode_plan) if decode_plan is not None else None,
         "buckets": list(buckets) if buckets is not None else None,
         "jax": jax.__version__,
@@ -182,6 +193,7 @@ def _abstract(tree):
 def batch_specs_for_ladder(
     example_batch: dict,
     buckets: tuple | list | None = None,
+    data_axis: str = "data",
 ) -> list[dict]:
     """Every batch signature the driver can dispatch, as ShapeDtypeStructs.
 
@@ -189,13 +201,27 @@ def batch_specs_for_ladder(
     without ``_mask`` (the steady-state shape) plus each ``pad_to_bucket``
     ladder size *with* its f32 ``_mask`` — partial tails always carry the
     mask, full batches from normal assembly never do.
+
+    A committed batch sharding over a MODEL axis (``fsdp`` without the
+    data fold, ``tp`` anywhere) is rejected here, at build time:
+    lowering the ladder against it would compile a wrong program and
+    the error would otherwise surface deep inside jit at the first
+    dispatch (:func:`blendjax.parallel.validate_batch_sharding`).
     """
+    from blendjax.parallel.sharding import validate_batch_sharding
+
     fields = {
         k: v for k, v in example_batch.items()
         if k != "_mask" and _is_batch_array(k, v)
     }
     if not fields:
         raise ValueError("example batch has no array fields to lower against")
+    for k, v in fields.items():
+        sh = getattr(v, "sharding", None)
+        if sh is not None:
+            validate_batch_sharding(
+                sh, data_axis=data_axis, what=f"ladder batch field {k!r}"
+            )
     lead = next(iter(fields.values())).shape[0]
     ladder = tuple(buckets) if buckets else bucket_sizes(lead)
     specs = []
@@ -297,6 +323,7 @@ def build_aot_step(
     cache_dir: str | None = None,
     key: str | None = None,
     mesh=None,
+    data_axis: str = "data",
     ledger_name: str = "aot_step",
 ) -> AotStepSet:
     """Compile ``step`` for every ladder signature before step 0.
@@ -325,7 +352,7 @@ def build_aot_step(
         seen = set(manifest.get(key, ()))
 
     state_spec = _abstract(state)
-    specs = batch_specs_for_ladder(example_batch, buckets)
+    specs = batch_specs_for_ladder(example_batch, buckets, data_axis=data_axis)
     compiled: dict = {}
     hits = misses = 0
     t0 = time.monotonic()

@@ -265,19 +265,29 @@ class MeshTrainDriver(TrainDriver):
         )
 
     @classmethod
-    def build(cls, model, mesh, example_batch, loss_fn=None,
+    def build(cls, model, mesh=None, example_batch=None, loss_fn=None,
               fused: bool = False, optimizer=None,
               learning_rate: float = 1e-3, rng=None, augment=None,
               augment_rng=None, aot: bool = False,
               aot_cache_dir: str | None = None, aot_batch=None,
+              layout=None, rules=None,
               **driver_kwargs):
         """One call from model to mesh-resident driver: init the train
-        state sharded by the mesh rules (params over ``fsdp``/
-        ``tensor`` where the axes exist, replicated otherwise — see
-        ``param_sharding_rules``), build the pinned-sharding step
-        (``fused=True`` for packed tile/pal streams), and wrap the
-        driver. ``example_batch`` is one host batch of the stream's
-        image field (shapes only; values never train).
+        state sharded by the layout's partition rules (params over
+        ``fsdp``/``tp`` where the axes exist, replicated otherwise —
+        see ``param_sharding_rules``/``resolve_rules``), build the
+        pinned-sharding step (``fused=True`` for packed tile/pal
+        streams), and wrap the driver. ``example_batch`` is one host
+        batch of the stream's image field (shapes only; values never
+        train).
+
+        ``layout`` (a :class:`blendjax.parallel.Layout`, a name like
+        ``"data×fsdp"``/``"data2xfsdp4"``, or an axis dict) selects
+        the mesh composition AND the partition rules in one spelling;
+        with ``mesh=None`` the mesh is created from it. ``rules``
+        overrides the rule set explicitly (a tuple of
+        :class:`~blendjax.parallel.PartitionRule`); without either the
+        model's own ``partition_rules()`` applies when it defines one.
 
         ``aot=True`` with ``aot_batch`` (a full example batch dict —
         image + the loss's fields) AOT-compiles the step for every
@@ -288,12 +298,39 @@ class MeshTrainDriver(TrainDriver):
         AOT applies to the supervised step only."""
         import time as _time
 
+        from blendjax.parallel.sharding import (
+            resolve_layout,
+            resolve_rules,
+            validate_batch_sharding,
+        )
         from blendjax.train.steps import make_train_state
 
         t0 = _time.monotonic()
+        data_axis = driver_kwargs.get("data_axis", "data")
+        if mesh is None:
+            if layout is None:
+                raise ValueError(
+                    "MeshTrainDriver.build needs a mesh or a layout — "
+                    "pass mesh=create_mesh(...) or layout='data×fsdp'"
+                )
+            mesh = resolve_layout(layout).create_mesh()
+        if example_batch is None:
+            raise ValueError("example_batch is required (shapes only)")
+        rules = resolve_rules(rules=rules, layout=layout, model=model)
+        if aot_batch is not None:
+            # build-time gate: a model-axis-sharded *batch* compiles a
+            # wrong program (satellite of the layout system; see
+            # validate_batch_sharding)
+            for k, v in aot_batch.items():
+                sh = getattr(v, "sharding", None)
+                if sh is not None:
+                    validate_batch_sharding(
+                        sh, data_axis=data_axis, what=f"aot_batch[{k!r}]"
+                    )
         state = make_train_state(
             model, example_batch, optimizer=optimizer,
             learning_rate=learning_rate, rng=rng, mesh=mesh,
+            rules=rules,
         )
         if fused:
             step = make_mesh_fused_step(
@@ -318,8 +355,9 @@ class MeshTrainDriver(TrainDriver):
                 cache_dir=aot_cache_dir,
                 key=cache_key(
                     model=model, mesh=mesh, buckets=buckets,
+                    layout=layout, rules=rules,
                 ) if aot_cache_dir else None,
-                mesh=mesh,
+                mesh=mesh, data_axis=data_axis,
                 ledger_name=f"{type(model).__name__}.mesh_supervised_step",
             )
         elif aot_batch is not None and not fused:
@@ -335,6 +373,12 @@ class MeshTrainDriver(TrainDriver):
                 step, state, aot_batch, mesh=mesh,
             )
         drv = cls(step, state, mesh, **driver_kwargs)
+        # the committed layout, by name — bench rows and fleet reports
+        # tag throughput/collective figures with it
+        drv.layout = (
+            resolve_layout(layout).name if layout is not None
+            else "×".join(mesh.axis_names)
+        )
         drv._adopt_cost_model_flops(
             step, {"image": example_batch},
             entries=[ledger_entry] if ledger_entry else None,
@@ -371,6 +415,8 @@ class MeshTrainDriver(TrainDriver):
     def stats(self) -> dict:
         s = TrainDriver.stats.fget(self)
         s["chips"] = self.chips
+        if getattr(self, "layout", None):
+            s["layout"] = self.layout
         try:
             s["processes"] = _require_jax().process_count()
         except Exception:

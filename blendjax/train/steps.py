@@ -26,20 +26,53 @@ def make_train_state(
     learning_rate: float = 1e-3,
     rng=None,
     mesh=None,
+    rules=None,
+    layout=None,
 ) -> TrainState:
-    """Init params (sharded onto ``mesh`` per the default rules) and wrap
-    them with an optax optimizer in a flax TrainState."""
+    """Init params (sharded onto ``mesh`` per the partition rules) and
+    wrap them with an optax optimizer in a flax TrainState.
+
+    ``rules``/``layout`` select the parameter layout
+    (:func:`blendjax.parallel.resolve_rules`: explicit rules win, then
+    the layout's, then the model's own ``partition_rules()``, then the
+    generic fsdp/tp defaults). Optimizer moments inherit the params'
+    shardings through ``optax``'s ``zeros_like`` init, so one
+    device_put here commits the WHOLE state to the layout."""
+    from blendjax.parallel.sharding import resolve_rules
+
     rng = rng if rng is not None else jax.random.key(0)
     optimizer = optimizer or optax.adamw(learning_rate)
     params = model.init(rng, example_input)["params"]
     if mesh is not None:
+        resolved = resolve_rules(rules=rules, layout=layout, model=model)
         params = jax.tree_util.tree_map_with_path(
             lambda p, v: jax.device_put(
-                v, param_sharding_rules(mesh, p, v)
+                v, param_sharding_rules(mesh, p, v, rules=resolved)
             ),
             params,
         )
-    return TrainState.create(apply_fn=model.apply, params=params, tx=optimizer)
+    state = TrainState.create(
+        apply_fn=model.apply, params=params, tx=optimizer
+    )
+    if mesh is not None:
+        # moments inherit the params' shardings via optax zeros_like,
+        # but optimizer scalars created fresh (adam's count) land on
+        # the default device — commit them replicated so the WHOLE
+        # state lives on the mesh and pinned jit shardings stay
+        # mesh-uniform
+        rep = jax.sharding.NamedSharding(
+            mesh, jax.sharding.PartitionSpec()
+        )
+        state = jax.tree_util.tree_map(
+            lambda v: (
+                v
+                if not hasattr(v, "sharding")
+                or isinstance(v.sharding, jax.sharding.NamedSharding)
+                else jax.device_put(v, rep)
+            ),
+            state,
+        )
+    return state
 
 
 def corner_loss(pred, xy, image_shape=None, mask=None):

@@ -1259,7 +1259,7 @@ def test_every_rule_registered():
         "BJX101", "BJX102", "BJX103", "BJX104", "BJX105", "BJX106",
         "BJX107", "BJX108", "BJX109", "BJX110", "BJX111", "BJX112",
         "BJX113", "BJX114", "BJX115", "BJX116", "BJX117", "BJX118",
-        "BJX119", "BJX120", "BJX121", "BJX122", "BJX125",
+        "BJX119", "BJX120", "BJX121", "BJX122", "BJX125", "BJX126",
     }
 
 
@@ -2690,3 +2690,65 @@ def test_cli_full_repo_lint_within_budget():
         cwd=REPO_ROOT,
     )
     assert r.returncode == 0, r.stdout + r.stderr
+
+
+# -- BJX126 mesh-axis-literal -------------------------------------------------
+
+AXIS_LITERAL = """
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    def pin(mesh, x):
+        import jax
+        return jax.device_put(x, NamedSharding(mesh, P("data")))
+
+    def fold(mesh):
+        return P(("data", "fsdp"), None)
+"""
+
+
+def test_bjx126_flags_axis_literals_in_library_code():
+    got = findings(
+        AXIS_LITERAL, relpath="blendjax/train/foo.py", select=["BJX126"]
+    )
+    assert [f.rule for f in got] == ["BJX126"] * 2
+    assert "fsdp" in got[1].message
+
+
+def test_bjx126_layout_layer_and_tests_are_exempt():
+    assert rule_ids(
+        AXIS_LITERAL, relpath="blendjax/parallel/foo.py",
+        select=["BJX126"],
+    ) == []
+    assert rule_ids(
+        AXIS_LITERAL, relpath="tests/test_foo.py", select=["BJX126"]
+    ) == []
+
+
+def test_bjx126_negatives_threaded_axis_and_non_axis_strings():
+    clean = """
+        from jax.sharding import PartitionSpec as P
+
+        def pin(mesh, data_axis):
+            return P(data_axis)
+
+        def not_an_axis():
+            return P("batch")
+
+        def not_a_spec():
+            return dict(axis="data")
+    """
+    assert rule_ids(
+        clean, relpath="blendjax/train/foo.py", select=["BJX126"]
+    ) == []
+
+
+def test_bjx126_inline_suppression():
+    src = """
+        from jax.sharding import PartitionSpec as P
+
+        def fixture(mesh):
+            return P("data")  # bjx: ignore[BJX126]
+    """
+    assert rule_ids(
+        src, relpath="blendjax/train/foo.py", select=["BJX126"]
+    ) == []

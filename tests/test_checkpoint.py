@@ -740,3 +740,54 @@ def test_orbax_async_save_overlaps_subsequent_step(tmp_path):
                     jax.tree.leaves(jax.device_get(restored.params))):
         assert np.array_equal(np.asarray(a), np.asarray(b))
     mgr.close()
+
+
+def test_cross_layout_resume_f32_identical(tmp_path):
+    """Cross-layout resume (the layout-system satellite): train under
+    data×fsdp, snapshot, restore onto a pure-data mesh (counted as a
+    resharded restore), and the continuation is f32-identical to the
+    leg that never stopped."""
+    from blendjax.parallel import resolve_layout
+    from blendjax.train.mesh_driver import make_mesh_supervised_step
+
+    reg.reset()
+    img = np.zeros((B, HW, HW, 4), np.uint8)
+    model = CubeRegressor(features=(8,), dtype=np.float32)
+    mesh_f = resolve_layout("data2xfsdp4").create_mesh()
+    state = make_train_state(
+        model, img, mesh=mesh_f, layout="data2xfsdp4"
+    )
+    step_f = make_mesh_supervised_step(state, mesh_f)
+    bs_f = batch_sharding(mesh_f)
+    batches = list(_batches(4, seed=3))
+    for b in batches[:2]:
+        state, _ = step_f(
+            state, {k: jax.device_put(v, bs_f) for k, v in b.items()}
+        )
+    with SnapshotManager(str(tmp_path)) as mgr:
+        mgr.save_async(2, state)
+        mgr.wait()
+        mesh_d = _mesh(8)
+        template = make_train_state(model, img, mesh=mesh_d)
+        res = mgr.restore(template, mesh=mesh_d)
+    assert res.resharded
+    assert reg.report()["counters"]["ckpt.resharded_restores"] >= 1
+    # every restored leaf landed on the pure-data mesh
+    leaf = jax.tree_util.tree_leaves(res.state.params)[0]
+    assert len(leaf.sharding.device_set) == 8
+    # continue both legs on identical data: losses equal to f32
+    # reduction rounding (cross-layout reordering, same program)
+    step_d = make_mesh_supervised_step(res.state, mesh_d)
+    bs_d = batch_sharding(mesh_d)
+    st_f, st_d = state, res.state
+    for b in batches[2:]:
+        st_f, mf = step_f(
+            st_f, {k: jax.device_put(v, bs_f) for k, v in b.items()}
+        )
+        st_d, md = step_d(
+            st_d, {k: jax.device_put(v, bs_d) for k, v in b.items()}
+        )
+        np.testing.assert_allclose(
+            np.asarray(mf["loss"]), np.asarray(md["loss"]),
+            rtol=0, atol=5e-5,
+        )
